@@ -1,0 +1,241 @@
+"""Columnar Dataset: the DataFrame-equivalent that stages transform.
+
+TPU-native re-design of the reference's Spark DataFrame substrate. Spark rows on
+JVM executors become host-resident columnar numpy arrays that models shard onto
+the JAX device mesh (host = data loading, device = compute). A "column" is a
+numpy array whose first axis is the row axis (scalars: shape ``(n,)``; vector
+columns: ``(n, d)``) or a Python list for ragged/object data (strings, variable
+length feature lists).
+
+The transform verbs cover what the reference's stages actually use of the
+DataFrame API: select/drop/withColumn/filter/sample/repartition-equivalents
+(reference: stages/DropColumns.scala, stages/SelectColumns.scala,
+core/spark/FluentAPI.scala:13-30 for the ``mlTransform`` sugar).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+ColumnData = Union[np.ndarray, list]
+
+
+def _length(col: ColumnData) -> int:
+    return len(col)
+
+
+def _take(col: ColumnData, idx: np.ndarray) -> ColumnData:
+    if isinstance(col, np.ndarray):
+        return col[idx]
+    return [col[i] for i in idx]
+
+
+class Dataset:
+    """Immutable-ish columnar table. Cheap column ops, numpy-backed."""
+
+    def __init__(self, columns: Dict[str, ColumnData]):
+        self._cols: Dict[str, ColumnData] = {}
+        n = None
+        for k, v in columns.items():
+            if isinstance(v, (np.ndarray, np.generic)):
+                v = np.asarray(v)
+            elif not isinstance(v, list):
+                v = list(v)
+            if n is None:
+                n = _length(v)
+            elif _length(v) != n:
+                raise ValueError(
+                    f"column {k!r} has length {_length(v)}, expected {n}")
+            self._cols[k] = v
+        self._n = n or 0
+
+    # -- constructors ----------------------------------------------------------
+    @staticmethod
+    def from_pandas(df) -> "Dataset":
+        import pandas.api.types as ptypes
+
+        cols = {}
+        for name in df.columns:
+            s = df[name]
+            if ptypes.is_numeric_dtype(s.dtype) or ptypes.is_bool_dtype(s.dtype):
+                cols[name] = s.to_numpy()
+            else:
+                cols[name] = s.tolist()
+        return Dataset(cols)
+
+    @staticmethod
+    def from_rows(rows: Sequence[Dict[str, Any]]) -> "Dataset":
+        if not rows:
+            return Dataset({})
+        keys = list(rows[0].keys())
+        out: Dict[str, list] = {k: [] for k in keys}
+        for r in rows:
+            for k in keys:
+                out[k].append(r.get(k))
+        cols: Dict[str, ColumnData] = {}
+        for k, vals in out.items():
+            try:
+                arr = np.asarray(vals)
+                cols[k] = arr if arr.dtype != object else vals
+            except Exception:
+                cols[k] = vals
+        return Dataset(cols)
+
+    # -- basics ----------------------------------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return list(self._cols.keys())
+
+    def __len__(self) -> int:
+        return self._n
+
+    @property
+    def num_rows(self) -> int:
+        return self._n
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._cols
+
+    def __getitem__(self, name: str) -> ColumnData:
+        if name not in self._cols:
+            raise KeyError(f"no column {name!r}; have {self.columns}")
+        return self._cols[name]
+
+    def column(self, name: str) -> ColumnData:
+        return self[name]
+
+    def array(self, name: str, dtype=None) -> np.ndarray:
+        """Column as a dense numpy array (raises for ragged object columns)."""
+        v = self[name]
+        arr = np.asarray(v) if not isinstance(v, np.ndarray) else v
+        if dtype is not None:
+            arr = arr.astype(dtype)
+        return arr
+
+    def schema(self) -> Dict[str, str]:
+        out = {}
+        for k, v in self._cols.items():
+            if isinstance(v, np.ndarray):
+                out[k] = f"{v.dtype.name}{list(v.shape[1:])}" if v.ndim > 1 else v.dtype.name
+            else:
+                out[k] = "object"
+        return out
+
+    # -- transform verbs -------------------------------------------------------
+    def select(self, *names: str) -> "Dataset":
+        return Dataset({k: self._cols[k] for k in names})
+
+    def drop(self, *names: str) -> "Dataset":
+        return Dataset({k: v for k, v in self._cols.items() if k not in names})
+
+    def with_column(self, name: str, data: ColumnData) -> "Dataset":
+        cols = dict(self._cols)
+        cols[name] = data
+        return Dataset(cols)
+
+    def with_columns(self, new: Dict[str, ColumnData]) -> "Dataset":
+        cols = dict(self._cols)
+        cols.update(new)
+        return Dataset(cols)
+
+    def rename(self, old: str, new: str) -> "Dataset":
+        cols = {}
+        for k, v in self._cols.items():
+            cols[new if k == old else k] = v
+        return Dataset(cols)
+
+    def filter(self, mask: np.ndarray) -> "Dataset":
+        mask = np.asarray(mask, dtype=bool)
+        idx = np.nonzero(mask)[0]
+        return self.take(idx)
+
+    def take(self, idx: np.ndarray) -> "Dataset":
+        idx = np.asarray(idx)
+        return Dataset({k: _take(v, idx) for k, v in self._cols.items()})
+
+    def head(self, n: int = 5) -> "Dataset":
+        return self.take(np.arange(min(n, self._n)))
+
+    def sample(self, fraction: float, seed: int = 0) -> "Dataset":
+        rng = np.random.default_rng(seed)
+        mask = rng.random(self._n) < fraction
+        return self.filter(mask)
+
+    def shuffle(self, seed: int = 0) -> "Dataset":
+        rng = np.random.default_rng(seed)
+        return self.take(rng.permutation(self._n))
+
+    def split(self, fractions: Sequence[float], seed: int = 0) -> List["Dataset"]:
+        """Random split, parity with DataFrame.randomSplit."""
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self._n)
+        fr = np.asarray(fractions, dtype=float)
+        fr = fr / fr.sum()
+        bounds = np.floor(np.cumsum(fr) * self._n).astype(int)
+        bounds[-1] = self._n  # cumsum can float below 1.0; never drop rows
+        out, start = [], 0
+        for b in bounds:
+            out.append(self.take(perm[start:b]))
+            start = b
+        return out
+
+    def union(self, other: "Dataset") -> "Dataset":
+        cols = {}
+        for k in self.columns:
+            a, b = self._cols[k], other._cols[k]
+            if isinstance(a, np.ndarray) and isinstance(b, np.ndarray):
+                cols[k] = np.concatenate([a, np.asarray(b)], axis=0)
+            else:
+                cols[k] = list(a) + list(b)
+        return Dataset(cols)
+
+    def sort(self, name: str, ascending: bool = True) -> "Dataset":
+        key = self.array(name)
+        idx = np.argsort(key, kind="stable")
+        if not ascending:
+            idx = idx[::-1]
+        return self.take(idx)
+
+    # -- row access / batching -------------------------------------------------
+    def row(self, i: int) -> Dict[str, Any]:
+        return {k: v[i] for k, v in self._cols.items()}
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for i in range(self._n):
+            yield self.row(i)
+
+    def to_rows(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def batches(self, batch_size: int) -> Iterator["Dataset"]:
+        for start in range(0, self._n, batch_size):
+            yield self.take(np.arange(start, min(start + batch_size, self._n)))
+
+    def to_pandas(self):
+        import pandas as pd
+
+        out = {}
+        for k, v in self._cols.items():
+            if isinstance(v, np.ndarray) and v.ndim > 1:
+                out[k] = list(v)
+            else:
+                out[k] = v
+        return pd.DataFrame(out)
+
+    # -- fluent API sugar (reference: core/spark/FluentAPI.scala:13-30) --------
+    def ml_transform(self, stage) -> "Dataset":
+        return stage.transform(self)
+
+    def ml_fit(self, estimator):
+        return estimator.fit(self)
+
+    def __repr__(self):
+        return f"Dataset({self._n} rows, columns={self.schema()})"
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    """Rows needed so every mesh shard is equal-sized (SPMD needs static shapes;
+    the reference instead tolerated empty partitions — TrainUtils.scala:539-554)."""
+    return ((n + multiple - 1) // multiple) * multiple

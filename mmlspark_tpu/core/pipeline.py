@@ -1,0 +1,241 @@
+"""Estimator / Transformer / Model / Pipeline — the stage contract.
+
+TPU-native equivalent of the Spark ML pipeline layer the reference builds on
+(reference: every stage extends Spark's Estimator/Transformer; pipeline
+persistence via org/apache/spark/ml/Serializer.scala:21-130). Our runtime owns
+the contract, so no namespace injection is needed: persistence is a directory of
+``metadata.json`` + per-param payloads, and any class importable by qualified
+name can be restored.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .dataset import Dataset
+from .params import Param, Params
+
+
+class PipelineStage(Params):
+    """Common base: anything placeable in a Pipeline."""
+
+    uid_counter = 0
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        PipelineStage.uid_counter += 1
+        self.uid = f"{type(self).__name__}_{PipelineStage.uid_counter:04d}"
+
+    # -- persistence -----------------------------------------------------------
+    def save(self, path: str) -> None:
+        save_stage(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineStage":
+        stage = load_stage(path)
+        if cls is not PipelineStage and not isinstance(stage, cls):
+            raise TypeError(f"loaded {type(stage).__name__}, expected {cls.__name__}")
+        return stage
+
+    # Complex (non-JSON) state beyond params; subclasses override.
+    # Mirrors ComplexParam persistence (reference: core/serialize/ComplexParam.scala:13-34).
+    def _save_extra(self, path: str) -> None:
+        pass
+
+    def _load_extra(self, path: str) -> None:
+        pass
+
+
+class Transformer(PipelineStage):
+    def transform(self, dataset: Dataset) -> Dataset:
+        raise NotImplementedError
+
+    def __call__(self, dataset: Dataset) -> Dataset:
+        return self.transform(dataset)
+
+
+class Estimator(PipelineStage):
+    def fit(self, dataset: Dataset) -> "Model":
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    """A fitted Transformer produced by an Estimator."""
+
+
+class UnaryTransformer(Transformer):
+    """inputCol -> outputCol via :meth:`_transform_column`."""
+
+    def _transform_column(self, col):
+        raise NotImplementedError
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        in_col = self.get_or_default("inputCol")
+        out_col = self.get_or_default("outputCol") or f"{in_col}_out"
+        return dataset.with_column(out_col, self._transform_column(dataset[in_col]))
+
+
+class Pipeline(Estimator):
+    """Sequential stages; estimators are fit then their models transform.
+
+    Parity with Spark ML Pipeline semantics used throughout the reference.
+    """
+
+    def __init__(self, stages: Optional[List[PipelineStage]] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.stages: List[PipelineStage] = list(stages or [])
+
+    def set_stages(self, stages: List[PipelineStage]) -> "Pipeline":
+        self.stages = list(stages)
+        return self
+
+    def get_stages(self) -> List[PipelineStage]:
+        return self.stages
+
+    def fit(self, dataset: Dataset) -> "PipelineModel":
+        fitted: List[Transformer] = []
+        current = dataset
+        for i, stage in enumerate(self.stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(current)
+                fitted.append(model)
+                if i < len(self.stages) - 1:
+                    current = model.transform(current)
+            elif isinstance(stage, Transformer):
+                fitted.append(stage)
+                if i < len(self.stages) - 1:
+                    current = stage.transform(current)
+            else:
+                raise TypeError(f"stage {stage!r} is neither Estimator nor Transformer")
+        return PipelineModel(fitted)
+
+    def _save_extra(self, path: str) -> None:
+        _save_stage_list(self.stages, os.path.join(path, "stages"))
+
+    def _load_extra(self, path: str) -> None:
+        self.stages = _load_stage_list(os.path.join(path, "stages"))
+
+
+class PipelineModel(Model):
+    def __init__(self, stages: Optional[List[Transformer]] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.stages: List[Transformer] = list(stages or [])
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        current = dataset
+        for stage in self.stages:
+            current = stage.transform(current)
+        return current
+
+    def _save_extra(self, path: str) -> None:
+        _save_stage_list(self.stages, os.path.join(path, "stages"))
+
+    def _load_extra(self, path: str) -> None:
+        self.stages = _load_stage_list(os.path.join(path, "stages"))
+
+
+class Lambda(Transformer):
+    """Arbitrary Dataset -> Dataset function as a (picklable) pipeline stage.
+
+    Parity: stages/Lambda.scala:21. The function is persisted with pickle, the
+    same trade-off as the reference's UDF serialization.
+    """
+
+    def __init__(self, fn=None, **kwargs):
+        super().__init__(**kwargs)
+        self.fn = fn
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        return self.fn(dataset)
+
+    def _save_extra(self, path: str) -> None:
+        with open(os.path.join(path, "fn.pkl"), "wb") as f:
+            pickle.dump(self.fn, f)
+
+    def _load_extra(self, path: str) -> None:
+        with open(os.path.join(path, "fn.pkl"), "rb") as f:
+            self.fn = pickle.load(f)
+
+
+# ---------------------------------------------------------------------------
+# Persistence (reference: org/apache/spark/ml/Serializer.scala:52-130 — here a
+# plain directory format: metadata.json with class + simple params; numpy /
+# pickle payloads for complex params; nested dirs for sub-stages).
+# ---------------------------------------------------------------------------
+
+
+def _is_jsonable(v: Any) -> bool:
+    try:
+        json.dumps(v)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def save_stage(stage: PipelineStage, path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    simple, complex_names = {}, []
+    for name, value in stage._paramMap.items():
+        if _is_jsonable(value):
+            simple[name] = value
+        else:
+            complex_names.append(name)
+            payload = os.path.join(path, f"param_{name}")
+            if isinstance(value, np.ndarray):
+                np.save(payload + ".npy", value)
+            else:
+                with open(payload + ".pkl", "wb") as f:
+                    pickle.dump(value, f)
+    meta = {
+        "class": f"{type(stage).__module__}.{type(stage).__qualname__}",
+        "uid": stage.uid,
+        "params": simple,
+        "complexParams": complex_names,
+        "formatVersion": 1,
+    }
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=1, sort_keys=True)
+    stage._save_extra(path)
+
+
+def load_stage(path: str) -> PipelineStage:
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    module, _, qualname = meta["class"].rpartition(".")
+    cls = importlib.import_module(module)
+    for part in qualname.split("."):
+        cls = getattr(cls, part)
+    stage = cls.__new__(cls)
+    PipelineStage.__init__(stage)
+    stage.uid = meta["uid"]
+    stage.set(**meta["params"])
+    for name in meta["complexParams"]:
+        npy = os.path.join(path, f"param_{name}.npy")
+        pkl = os.path.join(path, f"param_{name}.pkl")
+        if os.path.exists(npy):
+            stage._paramMap[name] = np.load(npy, allow_pickle=False)
+        else:
+            with open(pkl, "rb") as f:
+                stage._paramMap[name] = pickle.load(f)
+    stage._load_extra(path)
+    return stage
+
+
+def _save_stage_list(stages: List[PipelineStage], path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "order.json"), "w") as f:
+        json.dump([f"{i:03d}" for i in range(len(stages))], f)
+    for i, s in enumerate(stages):
+        save_stage(s, os.path.join(path, f"{i:03d}"))
+
+
+def _load_stage_list(path: str) -> List[PipelineStage]:
+    with open(os.path.join(path, "order.json")) as f:
+        order = json.load(f)
+    return [load_stage(os.path.join(path, name)) for name in order]

@@ -1,0 +1,104 @@
+"""Quantile feature binning for histogram GBDT.
+
+Host-side (numpy) equivalent of LightGBM's dataset construction: features are
+discretized into at most ``max_bin`` bins using sample quantiles, and training
+then operates on the integer bin indices only (reference: dataset creation via
+LGBM_DatasetCreateFromMat at lightgbm/LightGBMUtils.scala:227,256 with
+``max_bin``/``bin_construct_sample_cnt`` params, LightGBMUtils.scala:218-221).
+
+Bins are defined by upper bounds: value v falls in bin b iff
+``upper[b-1] < v <= upper[b]`` (searchsorted left on upper bounds). NaN maps to
+bin 0 (its own region at the low end), matching the "missing goes to a fixed
+side" convention; the split rule ``bin <= threshold_bin`` then sends NaN left.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class QuantileBinner:
+    """Fit per-feature quantile bin boundaries; transform floats -> bin indices."""
+
+    def __init__(self, max_bin: int = 255, sample_count: int = 200_000, seed: int = 0):
+        self.max_bin = int(max_bin)
+        self.sample_count = int(sample_count)
+        self.seed = seed
+        self.upper_bounds: Optional[np.ndarray] = None  # [F, max_bin-1] f32
+        self.num_features: Optional[int] = None
+
+    def fit(self, X: np.ndarray) -> "QuantileBinner":
+        X = np.asarray(X, dtype=np.float32)
+        n, F = X.shape
+        self.num_features = F
+        if n > self.sample_count:
+            rng = np.random.default_rng(self.seed)
+            X = X[rng.choice(n, self.sample_count, replace=False)]
+        B = self.max_bin
+        bounds = np.empty((F, B - 1), dtype=np.float32)
+        qs = np.linspace(0.0, 1.0, B + 1)[1:-1]  # interior quantiles
+        for f in range(F):
+            col = X[:, f]
+            col = col[~np.isnan(col)]
+            if col.size == 0:
+                bounds[f] = 0.0
+                continue
+            uniq = np.unique(col)
+            if uniq.size <= B - 1:
+                # few distinct values: one bin per value; boundaries at midpoints
+                mids = (uniq[:-1] + uniq[1:]) / 2.0 if uniq.size > 1 else np.array([uniq[0]])
+                pad = np.full(B - 1 - mids.size, np.float32(np.inf))
+                bounds[f] = np.concatenate([mids.astype(np.float32), pad])
+            else:
+                q = np.quantile(col, qs).astype(np.float32)
+                # strictly increasing boundaries; collapse duplicates to the right
+                q = np.maximum.accumulate(q)
+                bounds[f] = q
+        self.upper_bounds = bounds
+        return self
+
+    def transform(self, X: np.ndarray) -> np.ndarray:
+        """floats [n, F] -> int32 bins [n, F] in [0, max_bin-1]; NaN -> 0."""
+        assert self.upper_bounds is not None, "fit first"
+        X = np.asarray(X, dtype=np.float32)
+        n, F = X.shape
+        out = np.empty((n, F), dtype=np.int32)
+        for f in range(F):
+            col = X[:, f]
+            b = np.searchsorted(self.upper_bounds[f], col, side="left")
+            b[np.isnan(col)] = 0
+            out[:, f] = b
+        return out
+
+    def fit_transform(self, X: np.ndarray) -> np.ndarray:
+        return self.fit(X).transform(X)
+
+    def bin_upper_raw(self) -> np.ndarray:
+        """Raw-value threshold for "bin <= t": upper_bounds[f, t] (inf for last bin).
+
+        Used to translate bin-space splits back into raw-feature thresholds so a
+        trained model predicts directly on floats (the reference's native model
+        string stores raw thresholds the same way).
+        """
+        F = self.upper_bounds.shape[0]
+        inf = np.full((F, 1), np.float32(np.inf))
+        return np.concatenate([self.upper_bounds, inf], axis=1)  # [F, max_bin]
+
+    # -- persistence ------------------------------------------------------------
+    def state(self) -> dict:
+        return {
+            "max_bin": self.max_bin,
+            "sample_count": self.sample_count,
+            "seed": self.seed,
+            "upper_bounds": self.upper_bounds,
+            "num_features": self.num_features,
+        }
+
+    @staticmethod
+    def from_state(state: dict) -> "QuantileBinner":
+        b = QuantileBinner(state["max_bin"], state["sample_count"], state["seed"])
+        b.upper_bounds = state["upper_bounds"]
+        b.num_features = state["num_features"]
+        return b

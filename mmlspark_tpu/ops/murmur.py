@@ -1,0 +1,79 @@
+"""MurmurHash3 (x86, 32-bit) — feature identity for the VW-style featurizer.
+
+The reference exposes VW's murmur hash to the JVM for featurization
+(reference: vw/VowpalWabbitMurmurWithPrefix.scala, JNI class
+``VowpalWabbitMurmur``). Hashing defines feature identity, so the TPU build
+implements the same public MurmurHash3_x86_32 algorithm (Austin Appleby,
+public domain) in pure Python/numpy — host-side, cached per distinct string;
+the training loop itself only ever sees integer indices.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Union
+
+import numpy as np
+
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+_MASK = 0xFFFFFFFF
+
+
+def _rotl32(x: int, r: int) -> int:
+    return ((x << r) | (x >> (32 - r))) & _MASK
+
+
+def murmur3_32(data: Union[bytes, str], seed: int = 0) -> int:
+    """MurmurHash3_x86_32 of ``data`` with ``seed``; returns uint32."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    n = len(data)
+    h = seed & _MASK
+    nblocks = n // 4
+    for i in range(nblocks):
+        k = int.from_bytes(data[4 * i:4 * i + 4], "little")
+        k = (k * _C1) & _MASK
+        k = _rotl32(k, 15)
+        k = (k * _C2) & _MASK
+        h ^= k
+        h = _rotl32(h, 13)
+        h = (h * 5 + 0xE6546B64) & _MASK
+    k = 0
+    tail = data[nblocks * 4:]
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * _C1) & _MASK
+        k = _rotl32(k, 15)
+        k = (k * _C2) & _MASK
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _MASK
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _MASK
+    h ^= h >> 16
+    return h
+
+
+@lru_cache(maxsize=1 << 20)
+def hash_namespace(name: str) -> int:
+    """VW namespace seed: murmur of the namespace string with seed 0."""
+    return murmur3_32(name, 0)
+
+
+@lru_cache(maxsize=1 << 20)
+def hash_feature(name: str, namespace_hash: int) -> int:
+    """VW feature hash: numeric names index directly (offset by the namespace
+    seed), everything else is murmur-hashed with the namespace seed."""
+    if name.isdigit():
+        return (int(name) + namespace_hash) & _MASK
+    return murmur3_32(name, namespace_hash)
+
+
+def mask_bits(h: Union[int, np.ndarray], num_bits: int):
+    return h & ((1 << num_bits) - 1)

@@ -1,0 +1,61 @@
+"""Multi-host initialization — replaces the driver-socket rendezvous protocol.
+
+The reference bootstraps distributed training with a driver ServerSocket that
+collects each task's host:port and broadcasts ring membership
+(reference: lightgbm/LightGBMUtils.scala:116-185, LightGBMConstants.scala:34-40),
+then hands off to per-learner TCP collectives. On TPU the runtime already has a
+gang-scheduled SPMD world: ``jax.distributed.initialize`` plus a Mesh spanning
+all hosts' devices gives membership, barriers, and collectives over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Initialize the multi-host JAX runtime (no-op on a single process).
+
+    On Cloud TPU all three arguments are auto-detected from the metadata server;
+    elsewhere they mirror the reference's (driverHost, numTasks, partitionId)
+    triple (LightGBMUtils.scala:116-185) but with exactly-once semantics and no
+    bespoke socket protocol.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    if coordinator_address is None and "JAX_COORDINATOR_ADDRESS" not in os.environ \
+            and num_processes is None:
+        return  # single-process run: nothing to do
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "barrier") -> None:
+    """Global barrier (gang scheduling is inherent on TPU; this is for host code).
+
+    Replaces Spark barrier execution mode (reference: TrainUtils.scala:476-483).
+    """
+    if jax.process_count() == 1:
+        return
+    client = jax.lib.xla_bridge.get_backend().distributed_client  # pragma: no cover
+    client.wait_at_barrier(name, 60_000)  # pragma: no cover

@@ -1,0 +1,137 @@
+"""Mini-batching transformers — rows <-> batches.
+
+TPU-native equivalent of the reference's batching stages (reference:
+stages/MiniBatchTransformer.scala:14-204 — FixedMiniBatchTransformer:139,
+DynamicMiniBatchTransformer:43, TimeIntervalMiniBatchTransformer:66,
+FlattenBatch:174; iterator machinery in stages/Batchers.scala:12-131).
+Batched columns hold one ndarray/list per row; FlattenBatch inverts. On TPU
+these bound the shapes fed to jitted programs — FixedMiniBatch with padding is
+what keeps recompiles away (static shapes), which is why ``padToSize`` exists
+here but not in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..core.dataset import Dataset
+from ..core.params import Param, TypeConverters
+from ..core.pipeline import Transformer
+
+
+def _batch_column(col, bounds: List[int]):
+    out = []
+    for i in range(len(bounds) - 1):
+        sl = slice(bounds[i], bounds[i + 1])
+        if isinstance(col, np.ndarray):
+            out.append(col[sl])
+        else:
+            out.append(list(col[sl]))
+    return out
+
+
+class FixedMiniBatchTransformer(Transformer):
+    """Group every ``batchSize`` rows into one batch row
+    (reference: MiniBatchTransformer.scala:139)."""
+
+    batchSize = Param("batchSize", "rows per batch", 256, TypeConverters.to_int)
+    maxBufferSize = Param("maxBufferSize", "compat no-op (host memory is the buffer)",
+                          2147483647, TypeConverters.to_int)
+    buffered = Param("buffered", "compat no-op", False, TypeConverters.to_bool)
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        bs = self.get_or_default("batchSize")
+        n = len(dataset)
+        bounds = list(range(0, n, bs)) + [n]
+        return Dataset({k: _batch_column(dataset[k], bounds)
+                        for k in dataset.columns})
+
+
+class DynamicMiniBatchTransformer(Transformer):
+    """Batch whatever is available up to ``maxBatchSize`` (streaming semantics;
+    reference: MiniBatchTransformer.scala:43). On a materialized dataset this
+    yields one batch capped at maxBatchSize per group."""
+
+    maxBatchSize = Param("maxBatchSize", "max rows per batch", 2147483647,
+                         TypeConverters.to_int)
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        bs = min(self.get_or_default("maxBatchSize"), max(len(dataset), 1))
+        return FixedMiniBatchTransformer(batchSize=bs).transform(dataset)
+
+
+class TimeIntervalMiniBatchTransformer(Transformer):
+    """reference: MiniBatchTransformer.scala:66 — batches rows arriving within
+    ``millisToWait``. Materialized datasets have no arrival times; behaves as a
+    single batch (the streaming runtime in io.serving drives real batching)."""
+
+    millisToWait = Param("millisToWait", "batching window", 1000, TypeConverters.to_int)
+    maxBatchSize = Param("maxBatchSize", "max rows per batch", 2147483647,
+                         TypeConverters.to_int)
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        return DynamicMiniBatchTransformer(
+            maxBatchSize=self.get_or_default("maxBatchSize")).transform(dataset)
+
+
+class FlattenBatch(Transformer):
+    """Invert batching: one row per element (reference: MiniBatchTransformer.scala:174)."""
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        cols: Dict[str, list] = {k: [] for k in dataset.columns}
+        n = len(dataset)
+        for i in range(n):
+            row = {k: dataset[k][i] for k in dataset.columns}
+            lengths = {len(v) for v in row.values()
+                       if isinstance(v, (list, np.ndarray))}
+            m = max(lengths) if lengths else 1
+            for k, v in row.items():
+                if isinstance(v, (list, np.ndarray)) and len(v) == m:
+                    cols[k].extend(list(v))
+                else:  # scalar or mismatched: replicate
+                    cols[k].extend([v] * m)
+        out: Dict[str, object] = {}
+        for k, vals in cols.items():
+            try:
+                arr = np.asarray(vals)
+                out[k] = arr if arr.dtype != object else vals
+            except Exception:
+                out[k] = vals
+        return Dataset(out)
+
+
+class PadBatch(Transformer):
+    """Pad every batched column to a fixed batch size with a fill value — keeps
+    downstream jitted programs at one static shape (TPU-specific; no reference
+    equivalent because the JVM never recompiled per shape)."""
+
+    padToSize = Param("padToSize", "target batch size", 256, TypeConverters.to_int)
+    fillValue = Param("fillValue", "pad fill", 0.0, TypeConverters.to_float)
+    maskCol = Param("maskCol", "output validity-mask column", "__mask",
+                    TypeConverters.to_string)
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        size = self.get_or_default("padToSize")
+        fill = self.get_or_default("fillValue")
+        new_cols: Dict[str, list] = {k: [] for k in dataset.columns}
+        masks = []
+        for i in range(len(dataset)):
+            m = None
+            for k in dataset.columns:
+                v = dataset[k][i]
+                if isinstance(v, np.ndarray):
+                    m = v.shape[0]
+                    pad = [(0, size - v.shape[0])] + [(0, 0)] * (v.ndim - 1)
+                    new_cols[k].append(np.pad(v, pad, constant_values=fill))
+                elif isinstance(v, list):
+                    m = len(v)
+                    new_cols[k].append(v + [None] * (size - len(v)))
+                else:
+                    new_cols[k].append(v)
+            mask = np.zeros(size, dtype=np.float32)
+            mask[:m if m is not None else size] = 1.0
+            masks.append(mask)
+        new_cols[self.get_or_default("maskCol")] = masks
+        return Dataset(new_cols)

@@ -1,0 +1,256 @@
+"""Auto-train API: TrainClassifier / TrainRegressor + model statistics.
+
+TPU-native equivalents of the reference's train package (reference:
+train/TrainClassifier.scala:53-374 — auto featurize + label indexing + fit any
+classifier; TrainRegressor.scala:24-178; ComputeModelStatistics.scala:22-466 —
+classification/regression metric tables incl. confusion matrix and ROC;
+ComputePerInstanceStatistics.scala:16-42).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.dataset import Dataset
+from ..core.params import (HasFeaturesCol, HasLabelCol, Param, TypeConverters)
+from ..core.pipeline import Estimator, Model, Transformer
+from ..featurize.core import Featurize, ValueIndexer
+
+
+class TrainClassifier(Estimator, HasLabelCol):
+    """Auto-featurize + label-index + fit the wrapped classifier
+    (reference: train/TrainClassifier.scala:53-374)."""
+
+    model = Param("model", "inner classifier estimator", None, is_complex=True)
+    featuresCol = Param("featuresCol", "assembled features column",
+                        "TrainClassifier_features", TypeConverters.to_string)
+    numFeatures = Param("numFeatures", "hash space for string features", 262144,
+                        TypeConverters.to_int)
+    reindexLabel = Param("reindexLabel", "index the label column", True,
+                         TypeConverters.to_bool)
+
+    def __init__(self, model=None, **kwargs):
+        super().__init__(**kwargs)
+        if model is not None:
+            self.set(model=model)
+
+    def fit(self, dataset: Dataset) -> "TrainedClassifierModel":
+        label = self.get_or_default("labelCol")
+        fcol = self.get_or_default("featuresCol")
+        levels = None
+        ds = dataset
+        if self.get_or_default("reindexLabel"):
+            indexer = ValueIndexer(inputCol=label, outputCol=label).fit(ds)
+            levels = indexer.get_or_default("levels")
+            ds = indexer.transform(ds)
+        feat_model = Featurize(
+            labelCol=label, outputCol=fcol,
+            numberOfFeatures=self.get_or_default("numFeatures")).fit(ds)
+        ds = feat_model.transform(ds)
+        inner = self.get_or_default("model").copy(
+            {"labelCol": label, "featuresCol": fcol})
+        fitted = inner.fit(ds)
+        model = TrainedClassifierModel(featurizer=feat_model, inner=fitted,
+                                       levels=levels)
+        self._copy_params_to(model)
+        return model
+
+
+class TrainedClassifierModel(Model, HasLabelCol):
+    featurizer = Param("featurizer", "fitted featurize model", None, is_complex=True)
+    inner = Param("inner", "fitted classifier", None, is_complex=True)
+    levels = Param("levels", "label levels", None, is_complex=True)
+    featuresCol = Param("featuresCol", "assembled features column",
+                        "TrainClassifier_features", TypeConverters.to_string)
+
+    def __init__(self, featurizer=None, inner=None, levels=None, **kwargs):
+        super().__init__(**kwargs)
+        if featurizer is not None:
+            self.set(featurizer=featurizer)
+        if inner is not None:
+            self.set(inner=inner)
+        if levels is not None:
+            self.set(levels=levels)
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        label = self.get_or_default("labelCol")
+        ds = dataset
+        levels = self.get_if_set("levels")
+        if levels and label in ds:
+            lookup = {v: i for i, v in enumerate(levels)}
+            y = ds[label]
+            idx = np.asarray([lookup.get(
+                float(v) if isinstance(v, (int, float, np.number)) else str(v),
+                len(levels)) for v in y], dtype=np.float64)
+            ds = ds.with_column(label, idx)
+        ds = self.get_or_default("featurizer").transform(ds)
+        out = self.get_or_default("inner").transform(ds)
+        return out.drop(self.get_or_default("featuresCol"))
+
+
+class TrainRegressor(Estimator, HasLabelCol):
+    """reference: train/TrainRegressor.scala:24-178"""
+
+    model = Param("model", "inner regressor estimator", None, is_complex=True)
+    featuresCol = Param("featuresCol", "assembled features column",
+                        "TrainRegressor_features", TypeConverters.to_string)
+    numFeatures = Param("numFeatures", "hash space for string features", 262144,
+                        TypeConverters.to_int)
+
+    def __init__(self, model=None, **kwargs):
+        super().__init__(**kwargs)
+        if model is not None:
+            self.set(model=model)
+
+    def fit(self, dataset: Dataset) -> "TrainedRegressorModel":
+        label = self.get_or_default("labelCol")
+        fcol = self.get_or_default("featuresCol")
+        feat_model = Featurize(
+            labelCol=label, outputCol=fcol,
+            numberOfFeatures=self.get_or_default("numFeatures")).fit(dataset)
+        ds = feat_model.transform(dataset)
+        inner = self.get_or_default("model").copy(
+            {"labelCol": label, "featuresCol": fcol})
+        fitted = inner.fit(ds)
+        model = TrainedRegressorModel(featurizer=feat_model, inner=fitted)
+        self._copy_params_to(model)
+        return model
+
+
+class TrainedRegressorModel(Model, HasLabelCol):
+    featurizer = Param("featurizer", "fitted featurize model", None, is_complex=True)
+    inner = Param("inner", "fitted regressor", None, is_complex=True)
+    featuresCol = Param("featuresCol", "assembled features column",
+                        "TrainRegressor_features", TypeConverters.to_string)
+
+    def __init__(self, featurizer=None, inner=None, **kwargs):
+        super().__init__(**kwargs)
+        if featurizer is not None:
+            self.set(featurizer=featurizer)
+        if inner is not None:
+            self.set(inner=inner)
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        ds = self.get_or_default("featurizer").transform(dataset)
+        out = self.get_or_default("inner").transform(ds)
+        return out.drop(self.get_or_default("featuresCol"))
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+
+def _roc_curve(y: np.ndarray, score: np.ndarray):
+    order = np.argsort(-score, kind="stable")
+    y = y[order]
+    tps = np.cumsum(y)
+    fps = np.cumsum(1 - y)
+    P, N = max(tps[-1], 1e-12), max(fps[-1], 1e-12)
+    tpr = np.concatenate([[0.0], tps / P])
+    fpr = np.concatenate([[0.0], fps / N])
+    return fpr, tpr
+
+
+def _auc(fpr: np.ndarray, tpr: np.ndarray) -> float:
+    return float(np.trapezoid(tpr, fpr))
+
+
+class ComputeModelStatistics(Transformer):
+    """Evaluation metrics as a Dataset (reference:
+    train/ComputeModelStatistics.scala:22-466 — classification: accuracy,
+    precision, recall, AUC, confusion matrix; regression: mse, rmse, r2, mae)."""
+
+    evaluationMetric = Param("evaluationMetric", "classification | regression | auto",
+                             "auto", TypeConverters.to_string)
+    labelCol = Param("labelCol", "label column", "label", TypeConverters.to_string)
+    scoresCol = Param("scoresCol", "probability/scores column", "probability",
+                      TypeConverters.to_string)
+    scoredLabelsCol = Param("scoredLabelsCol", "prediction column", "prediction",
+                            TypeConverters.to_string)
+    # confusion matrix made available after transform (reference exposes it too)
+    confusion_matrix: Optional[np.ndarray] = None
+    roc_curve: Optional[Dataset] = None
+
+    def _is_classification(self, y: np.ndarray) -> bool:
+        metric = self.get_or_default("evaluationMetric")
+        if metric != "auto":
+            return metric.startswith("class")
+        vals = np.unique(y)
+        return len(vals) <= max(20, int(np.sqrt(len(y)))) and \
+            np.allclose(vals, vals.astype(int))
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        y = dataset.array(self.get_or_default("labelCol"), np.float64)
+        pred = dataset.array(self.get_or_default("scoredLabelsCol"), np.float64)
+        if self._is_classification(y):
+            k = int(max(y.max(), pred.max())) + 1
+            cm = np.zeros((k, k), np.int64)
+            for t, p in zip(y.astype(int), pred.astype(int)):
+                cm[t, p] += 1
+            self.confusion_matrix = cm
+            acc = float((y == pred).mean())
+            # macro precision/recall (reference reports weighted variants too)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                prec_k = np.diag(cm) / np.maximum(cm.sum(axis=0), 1)
+                rec_k = np.diag(cm) / np.maximum(cm.sum(axis=1), 1)
+            out = {
+                "accuracy": np.asarray([acc]),
+                "precision": np.asarray([float(np.nanmean(prec_k))]),
+                "recall": np.asarray([float(np.nanmean(rec_k))]),
+            }
+            scol = self.get_or_default("scoresCol")
+            if k == 2 and scol in dataset:
+                scores = np.asarray(dataset[scol], np.float64)
+                p1 = scores[:, 1] if scores.ndim == 2 else scores
+                fpr, tpr = _roc_curve(y, p1)
+                out["AUC"] = np.asarray([_auc(fpr, tpr)])
+                self.roc_curve = Dataset({"false_positive_rate": fpr,
+                                          "true_positive_rate": tpr})
+            return Dataset(out)
+        # regression
+        err = pred - y
+        mse = float(np.mean(err ** 2))
+        var = float(np.var(y))
+        return Dataset({
+            "mean_squared_error": np.asarray([mse]),
+            "root_mean_squared_error": np.asarray([mse ** 0.5]),
+            "mean_absolute_error": np.asarray([float(np.mean(np.abs(err)))]),
+            "R^2": np.asarray([1.0 - mse / var if var > 0 else 0.0]),
+        })
+
+
+class ComputePerInstanceStatistics(Transformer):
+    """Per-row loss/error columns (reference:
+    train/ComputePerInstanceStatistics.scala:16-42)."""
+
+    labelCol = Param("labelCol", "label column", "label", TypeConverters.to_string)
+    scoresCol = Param("scoresCol", "probability column", "probability",
+                      TypeConverters.to_string)
+    scoredLabelsCol = Param("scoredLabelsCol", "prediction column", "prediction",
+                            TypeConverters.to_string)
+    evaluationMetric = Param("evaluationMetric", "classification | regression | auto",
+                             "auto", TypeConverters.to_string)
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        y = dataset.array(self.get_or_default("labelCol"), np.float64)
+        pred = dataset.array(self.get_or_default("scoredLabelsCol"), np.float64)
+        scol = self.get_or_default("scoresCol")
+        helper = ComputeModelStatistics(
+            evaluationMetric=self.get_or_default("evaluationMetric"))
+        if helper._is_classification(y):
+            if scol in dataset:
+                scores = np.asarray(dataset[scol], np.float64)
+                if scores.ndim == 2:
+                    picked = scores[np.arange(len(y)), y.astype(int).clip(
+                        0, scores.shape[1] - 1)]
+                else:
+                    picked = np.where(y > 0, scores, 1 - scores)
+                logloss = -np.log(np.clip(picked, 1e-15, 1.0))
+                return dataset.with_column("log_loss", logloss)
+            return dataset.with_column("correct", (y == pred).astype(np.float64))
+        err = pred - y
+        return dataset.with_columns({
+            "L1_loss": np.abs(err), "L2_loss": err ** 2})

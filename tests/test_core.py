@@ -1,0 +1,171 @@
+"""Core layer tests: params, dataset, pipeline, persistence.
+
+Modeled on the reference's serialization fuzzing
+(core/test/fuzzing/Fuzzing.scala: save/load round-trips for stages).
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.core.params import (HasInputCol, HasOutputCol, Param, Params,
+                                      TypeConverters, make_params)
+from mmlspark_tpu.core.pipeline import (Estimator, Lambda, Model, Pipeline,
+                                        PipelineModel, Transformer, load_stage)
+
+
+class _Scaler(Estimator, HasInputCol, HasOutputCol):
+    factor = Param("factor", "scale factor", 2.0, TypeConverters.to_float)
+
+    def fit(self, ds):
+        m = float(np.mean(ds.array(self.get_or_default("inputCol"))))
+        model = _ScalerModel(mean=m)
+        self._copy_params_to(model)
+        return model
+
+
+class _ScalerModel(Model, HasInputCol, HasOutputCol):
+    factor = Param("factor", "scale factor", 2.0, TypeConverters.to_float)
+    mean = Param("mean", "fitted mean", 0.0, TypeConverters.to_float)
+
+    def transform(self, ds):
+        x = ds.array(self.get_or_default("inputCol"))
+        out = (x - self.get_or_default("mean")) * self.get_or_default("factor")
+        return ds.with_column(self.get_or_default("outputCol"), out)
+
+
+def _add_z(d):
+    return d.with_column("z", d.array("y") + 1)
+
+
+class _Holder(Transformer):
+    data = Param("data", "array payload", None, is_complex=True)
+
+    def transform(self, ds):
+        return ds
+
+
+class TestParams:
+    def test_defaults_and_set(self):
+        s = _Scaler(inputCol="x")
+        assert s.get_or_default("factor") == 2.0
+        assert s.get_or_default("inputCol") == "x"
+        s.set(factor=3)
+        assert s.get_or_default("factor") == 3.0  # converter applied
+        assert s.is_set("factor") and not s.is_set("outputCol")
+
+    def test_descriptor_access(self):
+        s = _Scaler(factor=5.0)
+        assert s.factor == 5.0
+        assert isinstance(_Scaler.factor, Param)
+        s.factor = 7
+        assert s.factor == 7.0
+
+    def test_unknown_param_raises(self):
+        with pytest.raises(AttributeError):
+            _Scaler(nope=1)
+
+    def test_explain_params(self):
+        text = _Scaler(inputCol="x").explain_params()
+        assert "factor" in text and "scale factor" in text
+
+    def test_copy_isolation(self):
+        a = _Scaler(factor=2.0)
+        b = a.copy({"factor": 9.0})
+        assert a.factor == 2.0 and b.factor == 9.0
+
+    def test_make_params_decorator(self):
+        @make_params(alpha=(0.5, "mix", float), n=(3, "count", int))
+        class S(Params):
+            pass
+
+        s = S(alpha="0.25")
+        assert s.get_or_default("alpha") == 0.25
+        assert s.get_or_default("n") == 3
+
+
+class TestDataset:
+    def test_construction_and_schema(self):
+        ds = Dataset({"a": np.arange(5), "b": np.ones((5, 3)), "s": list("abcde")})
+        assert len(ds) == 5
+        assert ds.schema()["s"] == "object"
+        assert ds.schema()["b"].startswith("float")
+
+    def test_length_mismatch(self):
+        with pytest.raises(ValueError):
+            Dataset({"a": np.arange(5), "b": np.arange(4)})
+
+    def test_verbs(self):
+        ds = Dataset({"a": np.arange(10), "b": np.arange(10) * 2.0})
+        assert ds.select("a").columns == ["a"]
+        assert ds.drop("a").columns == ["b"]
+        assert np.all(ds.filter(ds["a"] > 5)["a"] == np.array([6, 7, 8, 9]))
+        ds2 = ds.with_column("c", ds.array("a") + 1)
+        assert np.all(ds2["c"] == np.arange(1, 11))
+        assert ds.rename("a", "z").columns == ["z", "b"]
+        assert len(ds.head(3)) == 3
+
+    def test_split_union_sort(self):
+        ds = Dataset({"a": np.arange(100)})
+        tr, te = ds.split([0.8, 0.2], seed=1)
+        assert len(tr) + len(te) == 100
+        assert len(ds.union(ds)) == 200
+        srt = ds.shuffle(3).sort("a")
+        assert np.all(srt["a"] == np.arange(100))
+
+    def test_pandas_roundtrip(self):
+        ds = Dataset({"a": np.arange(4), "s": list("abcd")})
+        df = ds.to_pandas()
+        ds2 = Dataset.from_pandas(df)
+        assert np.all(ds2.array("a") == ds.array("a"))
+        assert ds2["s"] == ["a", "b", "c", "d"]
+
+    def test_rows(self):
+        ds = Dataset.from_rows([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}])
+        assert ds.row(1) == {"a": 2, "b": "y"}
+        assert len(list(ds.batches(1))) == 2
+
+
+class TestPipeline:
+    def test_fit_transform(self):
+        ds = Dataset({"x": np.arange(10, dtype=np.float64)})
+        pipe = Pipeline([_Scaler(inputCol="x", outputCol="y", factor=2.0)])
+        model = pipe.fit(ds)
+        out = model.transform(ds)
+        assert np.allclose(out["y"], (np.arange(10) - 4.5) * 2.0)
+
+    def test_fluent_api(self):
+        ds = Dataset({"x": np.arange(4, dtype=np.float64)})
+        model = ds.ml_fit(_Scaler(inputCol="x", outputCol="y"))
+        out = ds.ml_transform(model)
+        assert "y" in out.columns
+
+    def test_persistence_roundtrip(self, tmp_path):
+        ds = Dataset({"x": np.arange(10, dtype=np.float64)})
+        pipe = Pipeline([
+            _Scaler(inputCol="x", outputCol="y", factor=3.0),
+            Lambda(_add_z),  # picklable module-level fn (UDF persistence parity)
+        ])
+        model = pipe.fit(ds)
+        expected = model.transform(ds)
+
+        p = str(tmp_path / "pm")
+        model.save(p)
+        loaded = PipelineModel.load(p)
+        out = loaded.transform(ds)
+        assert np.allclose(out["z"], expected["z"])
+
+    def test_estimator_persistence(self, tmp_path):
+        est = _Scaler(inputCol="x", outputCol="y", factor=4.0)
+        p = str(tmp_path / "est")
+        est.save(p)
+        loaded = load_stage(p)
+        assert isinstance(loaded, _Scaler)
+        assert loaded.factor == 4.0
+
+    def test_complex_param_persistence(self, tmp_path):
+        h = _Holder(data=np.arange(12).reshape(3, 4))
+        p = str(tmp_path / "h")
+        h.save(p)
+        loaded = load_stage(p)
+        assert np.all(loaded.get_or_default("data") == np.arange(12).reshape(3, 4))

@@ -1,0 +1,281 @@
+"""GBDT tests: accuracy-regression baselines + API behavior.
+
+Modeled on the reference's LightGBM suite
+(lightgbm/split1/VerifyLightGBMClassifier.scala — 29+ scenarios incl. weights,
+unbalance, early stopping, saved native models, CV interop) and its checked-in
+metric baselines with tolerances
+(core/test/benchmarks/Benchmarks.scala, benchmarks_VerifyLightGBMClassifier.csv).
+"""
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer, load_diabetes, load_iris
+from sklearn.metrics import accuracy_score, mean_squared_error, roc_auc_score
+from sklearn.model_selection import train_test_split
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.models.gbdt.api import (LightGBMClassificationModel,
+                                          LightGBMClassifier,
+                                          LightGBMRegressionModel,
+                                          LightGBMRegressor)
+from mmlspark_tpu.models.gbdt.booster import Booster, train_booster
+from mmlspark_tpu.models.gbdt.growth import GrowConfig
+
+# Checked-in metric baselines with tolerances (Benchmarks.scala parity):
+# reference AUC on its breast-cancer benchmark is 0.9925 (tol 0.1);
+# we gate tighter since this exact dataset differs.
+BASELINE_BINARY_AUC = 0.98
+BASELINE_MULTI_ACC = 0.90
+BASELINE_REG_RMSE = 70.0
+
+
+def _binary_data():
+    X, y = load_breast_cancer(return_X_y=True)
+    return train_test_split(X, y, test_size=0.3, random_state=0)
+
+
+def _to_ds(X, y, **extra):
+    cols = {"features": np.asarray(X, np.float32), "label": np.asarray(y, np.float64)}
+    cols.update(extra)
+    return Dataset(cols)
+
+
+@pytest.fixture(scope="module")
+def binary_fitted():
+    Xtr, Xte, ytr, yte = _binary_data()
+    clf = LightGBMClassifier(numIterations=20, numLeaves=15, minDataInLeaf=5,
+                             maxBin=63)
+    model = clf.fit(_to_ds(Xtr, ytr))
+    return model, Xte, yte
+
+
+class TestClassifier:
+    def test_auc_baseline(self, binary_fitted):
+        model, Xte, yte = binary_fitted
+        out = model.transform(_to_ds(Xte, yte))
+        probs = np.asarray(out["probability"])
+        assert roc_auc_score(yte, probs[:, 1]) > BASELINE_BINARY_AUC
+
+    def test_output_columns(self, binary_fitted):
+        model, Xte, yte = binary_fitted
+        out = model.transform(_to_ds(Xte, yte))
+        assert set(["rawPrediction", "probability", "prediction"]) <= set(out.columns)
+        probs = np.asarray(out["probability"])
+        assert probs.shape == (len(yte), 2)
+        assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+        raw = np.asarray(out["rawPrediction"])
+        assert np.all((raw[:, 1] > 0) == (probs[:, 1] > 0.5))
+
+    def test_accuracy(self, binary_fitted):
+        model, Xte, yte = binary_fitted
+        out = model.transform(_to_ds(Xte, yte))
+        assert accuracy_score(yte, out["prediction"]) > 0.93
+
+    def test_multiclass(self):
+        X, y = load_iris(return_X_y=True)
+        Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=0.3, random_state=0)
+        model = LightGBMClassifier(numIterations=30, numLeaves=7, minDataInLeaf=3,
+                                   maxBin=63).fit(_to_ds(Xtr, ytr))
+        out = model.transform(_to_ds(Xte, yte))
+        assert accuracy_score(yte, out["prediction"]) > BASELINE_MULTI_ACC
+        assert np.asarray(out["probability"]).shape == (len(yte), 3)
+
+    def test_early_stopping_with_validation_indicator(self):
+        Xtr, Xte, ytr, yte = _binary_data()
+        X = np.concatenate([Xtr, Xte])
+        y = np.concatenate([ytr, yte])
+        vi = np.concatenate([np.zeros(len(ytr)), np.ones(len(yte))]).astype(bool)
+        clf = LightGBMClassifier(numIterations=120, numLeaves=15, minDataInLeaf=5,
+                                 maxBin=63, earlyStoppingRound=5,
+                                 validationIndicatorCol="isVal")
+        model = clf.fit(_to_ds(X, y, isVal=vi))
+        assert model.booster.num_iterations < 120
+        assert model.booster.best_iteration >= 0
+        assert len(model.booster.eval_history["binary_logloss"]) > 0
+
+    def test_is_unbalance(self):
+        rng = np.random.default_rng(0)
+        n = 2000
+        X = rng.normal(size=(n, 5)).astype(np.float32)
+        y = (X[:, 0] + rng.normal(scale=2.0, size=n) > 2.2).astype(float)  # rare
+        model = LightGBMClassifier(numIterations=20, numLeaves=7, isUnbalance=True,
+                                   maxBin=63).fit(_to_ds(X, y))
+        out = model.transform(_to_ds(X, y))
+        # unbalance weighting must push predicted positive rate up toward recall
+        recall = ((np.asarray(out["prediction"]) == 1) & (y == 1)).sum() / max(y.sum(), 1)
+        assert recall > 0.5
+
+    def test_sample_weights(self):
+        # upweighting one class should move predictions toward it
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(500, 3)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(float)
+        w = np.where(y == 1, 10.0, 1.0)
+        m_w = LightGBMClassifier(numIterations=10, numLeaves=7, maxBin=63,
+                                 weightCol="w").fit(_to_ds(X, y, w=w))
+        m_u = LightGBMClassifier(numIterations=10, numLeaves=7, maxBin=63).fit(
+            _to_ds(X, y))
+        p_w = np.asarray(m_w.transform(_to_ds(X, y))["probability"])[:, 1].mean()
+        p_u = np.asarray(m_u.transform(_to_ds(X, y))["probability"])[:, 1].mean()
+        assert p_w > p_u
+
+    def test_feature_importances(self, binary_fitted):
+        model, _, _ = binary_fitted
+        imp_split = model.get_feature_importances("split")
+        imp_gain = model.get_feature_importances("gain")
+        assert len(imp_split) == 30
+        assert sum(imp_split) > 0 and sum(imp_gain) > 0
+
+    def test_native_model_roundtrip(self, binary_fitted, tmp_path):
+        model, Xte, yte = binary_fitted
+        p = str(tmp_path / "model.txt")
+        model.save_native_model(p)
+        loaded = LightGBMClassificationModel.load_native_model(p)
+        a = np.asarray(model.transform(_to_ds(Xte, yte))["probability"])
+        b = np.asarray(loaded.transform(_to_ds(Xte, yte))["probability"])
+        assert np.allclose(a, b, atol=1e-6)
+
+    def test_stage_persistence(self, binary_fitted, tmp_path):
+        model, Xte, yte = binary_fitted
+        p = str(tmp_path / "stage")
+        model.save(p)
+        loaded = LightGBMClassificationModel.load(p)
+        a = np.asarray(model.transform(_to_ds(Xte, yte))["probability"])
+        b = np.asarray(loaded.transform(_to_ds(Xte, yte))["probability"])
+        assert np.allclose(a, b, atol=1e-6)
+
+    def test_thresholds(self, binary_fitted):
+        model, Xte, yte = binary_fitted
+        model2 = model.copy({"thresholds": [0.01, 0.99]})
+        out2 = model2.transform(_to_ds(Xte, yte))
+        # heavy threshold on class 1 shifts predictions toward class 0
+        assert np.asarray(out2["prediction"]).mean() <= \
+            np.asarray(model.transform(_to_ds(Xte, yte))["prediction"]).mean()
+
+
+class TestRegressor:
+    def test_rmse_baseline(self):
+        X, y = load_diabetes(return_X_y=True)
+        Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=0.3, random_state=0)
+        model = LightGBMRegressor(numIterations=60, numLeaves=15, minDataInLeaf=10,
+                                  maxBin=63).fit(_to_ds(Xtr, ytr))
+        out = model.transform(_to_ds(Xte, yte))
+        rmse = mean_squared_error(yte, out["prediction"]) ** 0.5
+        assert rmse < BASELINE_REG_RMSE
+
+    @pytest.mark.parametrize("objective", ["regression_l1", "huber", "fair", "mape"])
+    def test_robust_objectives(self, objective):
+        X, y = load_diabetes(return_X_y=True)
+        model = LightGBMRegressor(objective=objective, numIterations=30,
+                                  numLeaves=15, maxBin=63).fit(_to_ds(X, y))
+        pred = np.asarray(model.transform(_to_ds(X, y))["prediction"])
+        assert mean_squared_error(y, pred) ** 0.5 < 120.0
+
+    def test_quantile(self):
+        X, y = load_diabetes(return_X_y=True)
+        for alpha, lo, hi in [(0.1, 0.7, 1.0), (0.9, 0.0, 0.3)]:
+            model = LightGBMRegressor(objective="quantile", alpha=alpha,
+                                      numIterations=50, numLeaves=15,
+                                      maxBin=63).fit(_to_ds(X, y))
+            pred = np.asarray(model.transform(_to_ds(X, y))["prediction"])
+            frac_above = (y > pred).mean()
+            assert lo <= frac_above <= hi
+
+    def test_poisson_tweedie_positive(self):
+        X, y = load_diabetes(return_X_y=True)
+        for obj in ["poisson", "tweedie"]:
+            model = LightGBMRegressor(objective=obj, numIterations=25,
+                                      numLeaves=15, maxBin=63).fit(_to_ds(X, y))
+            pred = np.asarray(model.transform(_to_ds(X, y))["prediction"])
+            assert np.all(pred > 0)
+
+    def test_num_batches_warm_start(self):
+        X, y = load_diabetes(return_X_y=True)
+        model = LightGBMRegressor(numIterations=30, numLeaves=7, maxBin=63,
+                                  numBatches=3).fit(_to_ds(X, y))
+        assert model.booster.num_iterations == 90  # 3 batches x 30 iters
+
+    def test_model_string_warm_start(self):
+        X, y = load_diabetes(return_X_y=True)
+        m1 = LightGBMRegressor(numIterations=20, numLeaves=7, maxBin=63).fit(
+            _to_ds(X, y))
+        m2 = LightGBMRegressor(numIterations=20, numLeaves=7, maxBin=63,
+                               modelString=m1.get_native_model()).fit(_to_ds(X, y))
+        assert m2.booster.num_iterations == 40
+        r1 = mean_squared_error(y, np.asarray(m1.transform(_to_ds(X, y))["prediction"]))
+        r2 = mean_squared_error(y, np.asarray(m2.transform(_to_ds(X, y))["prediction"]))
+        assert r2 < r1  # continued training improves train fit
+
+
+class TestBoosterInternals:
+    def test_bagging_feature_fraction(self):
+        X, y = load_diabetes(return_X_y=True)
+        b = train_booster(X, y, objective="regression", num_iterations=30,
+                          cfg=GrowConfig(num_leaves=7), max_bin=63,
+                          feature_fraction=0.6, bagging_fraction=0.7, bagging_freq=1)
+        rmse = mean_squared_error(y, b.predict(X)) ** 0.5
+        assert rmse < 100
+
+    def test_predict_leaf_shape(self):
+        X, y = load_diabetes(return_X_y=True)
+        b = train_booster(X[:100], y[:100], objective="regression",
+                          num_iterations=5, cfg=GrowConfig(num_leaves=7), max_bin=31)
+        leaves = b.predict_leaf(X[:10])
+        assert leaves.shape == (10, 5)
+        is_leaf = np.asarray(b.trees.is_leaf)
+        for t in range(5):
+            assert np.all(is_leaf[t][leaves[:, t].astype(int)])
+
+    def test_max_depth_respected(self):
+        X, y = load_diabetes(return_X_y=True)
+        b = train_booster(X, y, objective="regression", num_iterations=3,
+                          cfg=GrowConfig(num_leaves=31, max_depth=2), max_bin=63)
+        # depth-2 tree has at most 4 leaves => at most 7 nodes
+        assert np.all(np.asarray(b.trees.node_count) <= 7)
+
+    def test_deterministic(self):
+        X, y = load_diabetes(return_X_y=True)
+        b1 = train_booster(X, y, objective="regression", num_iterations=5,
+                           cfg=GrowConfig(num_leaves=7), max_bin=31, seed=1)
+        b2 = train_booster(X, y, objective="regression", num_iterations=5,
+                           cfg=GrowConfig(num_leaves=7), max_bin=31, seed=1)
+        assert np.allclose(b1.predict(X), b2.predict(X))
+
+    def test_min_data_in_leaf(self):
+        X, y = load_diabetes(return_X_y=True)
+        b = train_booster(X, y, objective="regression", num_iterations=3,
+                          cfg=GrowConfig(num_leaves=31, min_data_in_leaf=50),
+                          max_bin=63)
+        cnt = np.asarray(b.trees.node_cnt)
+        leaf = np.asarray(b.trees.is_leaf) & (cnt > 0)
+        assert cnt[leaf].min() >= 50
+
+
+class TestBinning:
+    def test_quantile_binner(self):
+        from mmlspark_tpu.ops.binning import QuantileBinner
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(1000, 3)).astype(np.float32)
+        b = QuantileBinner(max_bin=16).fit(X)
+        Xb = b.transform(X)
+        assert Xb.min() >= 0 and Xb.max() <= 15
+        # roughly uniform occupancy for continuous data
+        counts = np.bincount(Xb[:, 0], minlength=16)
+        assert counts.min() > 20
+
+    def test_nan_goes_to_bin0(self):
+        from mmlspark_tpu.ops.binning import QuantileBinner
+
+        X = np.array([[1.0], [2.0], [np.nan], [3.0]], dtype=np.float32)
+        b = QuantileBinner(max_bin=4).fit(X)
+        assert b.transform(X)[2, 0] == 0
+
+    def test_few_distinct_values(self):
+        from mmlspark_tpu.ops.binning import QuantileBinner
+
+        X = np.array([[0.0], [1.0], [0.0], [1.0], [2.0]], dtype=np.float32)
+        b = QuantileBinner(max_bin=255).fit(X)
+        Xb = b.transform(X)
+        # each distinct value gets its own bin
+        assert len(np.unique(Xb)) == 3
