@@ -234,8 +234,3 @@ class Dataset:
     def __repr__(self):
         return f"Dataset({self._n} rows, columns={self.schema()})"
 
-
-def pad_to_multiple(n: int, multiple: int) -> int:
-    """Rows needed so every mesh shard is equal-sized (SPMD needs static shapes;
-    the reference instead tolerated empty partitions — TrainUtils.scala:539-554)."""
-    return ((n + multiple - 1) // multiple) * multiple

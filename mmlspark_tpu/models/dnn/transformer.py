@@ -1,0 +1,269 @@
+"""Flagship deep model: SPMD transformer with dp x tp x sp mesh parallelism.
+
+This is the framework's DNN compute path — the TPU-native successor of the
+reference's CNTK evaluation engine (reference: cntk/CNTKModel.scala:30-532
+evaluates a serialized DNN per partition over JNI; no multi-device execution
+of a single model existed — SURVEY.md §2b). Here a single model spans the
+whole mesh:
+
+  * ``data``  — batch sharding (DP)
+  * ``model`` — Megatron-style tensor parallelism (TP): QKV/MLP column-split,
+    output projections row-split with one psum per block
+  * ``seq``   — sequence/context parallelism (SP): activations sharded over
+    sequence; exact attention via ring ppermute (parallel/ring_attention.py)
+
+Everything runs inside one ``shard_map``: collectives are explicit
+(psum/pmax/ppermute) and ride ICI. Params live sharded (TP dims) or
+replicated; gradients of replicated params are psum'd over (data, seq).
+bf16 activations, f32 params/optimizer — the standard TPU recipe.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...parallel.ring_attention import ring_attention
+
+
+class TransformerConfig(NamedTuple):
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    d_head: int = 64
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_len: int = 2048
+    dtype: Any = jnp.bfloat16
+
+
+def init_params(cfg: TransformerConfig, key) -> Dict:
+    """f32 parameters; layers stacked on a leading axis (scanned-friendly)."""
+    k_embed, k_pos, k_layers, k_head = jax.random.split(key, 4)
+    E, H, Dh, F, L = (cfg.d_model, cfg.n_heads, cfg.d_head, cfg.d_ff,
+                      cfg.n_layers)
+
+    def norm(k, shape, scale):
+        return jax.random.normal(k, shape, jnp.float32) * scale
+
+    ks = jax.random.split(k_layers, 6 * L).reshape(L, 6, 2)
+    layers = {
+        "ln1_scale": jnp.ones((L, E)), "ln1_bias": jnp.zeros((L, E)),
+        # [E, H, 3*Dh]: per-head q|k|v contiguous, so the head-axis TP shard
+        # is layout-invariant across tensor-parallel sizes (checkpoint portable)
+        "wqkv": jnp.stack([norm(ks[i, 0], (E, H, 3 * Dh), E ** -0.5)
+                           for i in range(L)]),
+        "wo": jnp.stack([norm(ks[i, 1], (H * Dh, E), (H * Dh) ** -0.5)
+                         for i in range(L)]),
+        "ln2_scale": jnp.ones((L, E)), "ln2_bias": jnp.zeros((L, E)),
+        "w1": jnp.stack([norm(ks[i, 2], (E, F), E ** -0.5) for i in range(L)]),
+        "b1": jnp.zeros((L, F)),
+        "w2": jnp.stack([norm(ks[i, 3], (F, E), F ** -0.5) for i in range(L)]),
+        "b2": jnp.zeros((L, E)),
+    }
+    return {
+        "embed": norm(k_embed, (cfg.vocab_size, E), 1.0),
+        "pos": norm(k_pos, (cfg.max_len, E), 0.02),
+        "layers": layers,
+        "lnf_scale": jnp.ones((E,)), "lnf_bias": jnp.zeros((E,)),
+        "head": norm(k_head, (E, cfg.vocab_size), E ** -0.5),
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> Dict:
+    """PartitionSpecs mirroring init_params: TP dims sharded over 'model'."""
+    return {
+        "embed": P(None, "model"),
+        "pos": P(None, "model"),
+        "layers": {
+            "ln1_scale": P(None, None), "ln1_bias": P(None, None),
+            "wqkv": P(None, None, "model", None),
+            "wo": P(None, "model", None),
+            "ln2_scale": P(None, None), "ln2_bias": P(None, None),
+            "w1": P(None, None, "model"), "b1": P(None, "model"),
+            "w2": P(None, "model", None), "b2": P(None, None),
+        },
+        "lnf_scale": P(None), "lnf_bias": P(None),
+        "head": P(None, "model"),
+    }
+
+
+def _layer_norm(x, scale, bias):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * lax.rsqrt(var + 1e-5) * scale + bias).astype(x.dtype)
+
+
+def forward_local(params, tokens, cfg: TransformerConfig,
+                  causal: bool = True):
+    """Local-shard forward inside shard_map; returns vocab-sharded logits.
+
+    tokens: [B_local, S_local] int32. Axes: data/seq/model as module docstring.
+    """
+    H, Dh, E = cfg.n_heads, cfg.d_head, cfg.d_model
+    tp = lax.axis_size("model")
+    sp_idx = lax.axis_index("seq")
+    Hl = H // tp
+    B, S = tokens.shape
+    dt = cfg.dtype
+
+    # embedding: table is E-sharded; gather rows then all-gather E
+    emb_local = jnp.take(params["embed"], tokens, axis=0)  # [B, S, E/tp]
+    pos0 = sp_idx * S
+    pos_local = lax.dynamic_slice_in_dim(params["pos"], pos0, S, axis=0)
+    x_local = emb_local + pos_local[None]
+    x = lax.all_gather(x_local, "model", axis=2, tiled=True).astype(dt)  # [B,S,E]
+
+    def block(x, lp):
+        h = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
+        qkv = jnp.einsum("bse,ehk->bshk", h, lp["wqkv"].astype(dt),
+                         preferred_element_type=jnp.float32)  # [B,S,Hl,3*Dh]
+        qkv = qkv.reshape(B, S, Hl, 3, Dh).astype(dt)
+        q = qkv[:, :, :, 0].transpose(0, 2, 1, 3)  # [B, Hl, S, Dh]
+        k = qkv[:, :, :, 1].transpose(0, 2, 1, 3)
+        v = qkv[:, :, :, 2].transpose(0, 2, 1, 3)
+        att = ring_attention(q, k, v, axis_name="seq", causal=causal)
+        att = att.transpose(0, 2, 1, 3).reshape(B, S, Hl * Dh)
+        out = jnp.einsum("bsk,ke->bse", att, lp["wo"].astype(dt),
+                         preferred_element_type=jnp.float32)
+        out = lax.psum(out, "model").astype(dt)
+        x = x + out
+        h = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
+        m = jnp.einsum("bse,ef->bsf", h, lp["w1"].astype(dt),
+                       preferred_element_type=jnp.float32) + lp["b1"]
+        m = jax.nn.gelu(m.astype(jnp.float32)).astype(dt)
+        m = jnp.einsum("bsf,fe->bse", m, lp["w2"].astype(dt),
+                       preferred_element_type=jnp.float32)
+        m = lax.psum(m, "model").astype(dt) + lp["b2"].astype(dt)
+        return x + m, None
+
+    x, _ = lax.scan(block, x, params["layers"])
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    logits_local = jnp.einsum("bse,ev->bsv", x, params["head"].astype(dt),
+                              preferred_element_type=jnp.float32)
+    return logits_local  # [B, S, V/tp] f32
+
+
+def sharded_xent(logits_local, targets, cfg: TransformerConfig):
+    """Cross-entropy over vocab-sharded logits (stable log-sum-exp with
+    pmax/psum over 'model'); mean over all tokens via pmean over data x seq."""
+    tp = lax.axis_size("model")
+    v_local = cfg.vocab_size // tp
+    v0 = lax.axis_index("model") * v_local
+    # stability shift only — constant w.r.t. differentiation (pmax has no JVP,
+    # so stop the gradient BEFORE it enters the collective)
+    lmax = lax.pmax(lax.stop_gradient(logits_local.max(-1)), "model")
+    z = jnp.exp(logits_local - lmax[..., None])
+    log_z = jnp.log(lax.psum(z.sum(-1), "model")) + lmax
+    t_local = targets - v0
+    in_range = (t_local >= 0) & (t_local < v_local)
+    picked = jnp.take_along_axis(
+        logits_local, jnp.clip(t_local, 0, v_local - 1)[..., None], axis=-1)[..., 0]
+    picked = lax.psum(jnp.where(in_range, picked, 0.0), "model")
+    nll = log_z - picked
+    return lax.pmean(lax.pmean(nll.mean(), "data"), "seq")
+
+
+# ---------------------------------------------------------------------------
+# hand-rolled AdamW (full sharding control over optimizer state)
+# ---------------------------------------------------------------------------
+
+
+def adamw_init(params):
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    return {"mu": zeros, "nu": jax.tree_util.tree_map(jnp.zeros_like, params),
+            "count": jnp.zeros((), jnp.int32)}
+
+
+def adamw_update(grads, state, params, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8,
+                 weight_decay=0.01):
+    c = state["count"] + 1
+    mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g,
+                                state["mu"], grads)
+    nu = jax.tree_util.tree_map(lambda n, g: b2 * n + (1 - b2) * g * g,
+                                state["nu"], grads)
+    cf = c.astype(jnp.float32)
+    bc1 = 1 - b1 ** cf
+    bc2 = 1 - b2 ** cf
+
+    def upd(p, m, n):
+        return p - lr * (m / bc1 / (jnp.sqrt(n / bc2) + eps) + weight_decay * p)
+
+    new_params = jax.tree_util.tree_map(upd, params, mu, nu)
+    return new_params, {"mu": mu, "nu": nu, "count": c}
+
+
+# ---------------------------------------------------------------------------
+# jit-able train / forward steps over a mesh
+# ---------------------------------------------------------------------------
+
+
+def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-3):
+    """Returns jitted (params, opt_state, tokens, targets) -> (params, opt_state, loss).
+
+    Replicated-param gradients are psum'd over (data, seq); TP-sharded params
+    update locally. One compiled SPMD program, collectives over ICI.
+    """
+    specs = param_specs(cfg)
+
+    def step_local(params, opt_state, tokens, targets):
+        def loss_fn(p):
+            logits = forward_local(p, tokens, cfg)
+            return sharded_xent(logits, targets, cfg)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # TP-sharded params get complete local grads (loss aggregates over
+        # 'model' via psum in the forward); params REPLICATED across 'model'
+        # (layernorms, b2) only get partial contributions per shard — sum them
+        # or the replicas silently diverge.
+        grads = jax.tree_util.tree_map(
+            lambda g, s: g if "model" in tuple(s) else lax.psum(g, "model"),
+            grads, specs)
+        # all params are replicated across data & seq: average contributions
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.pmean(lax.pmean(g, "data"), "seq"), grads)
+        new_params, new_opt = adamw_update(grads, opt_state, params, lr=lr)
+        return new_params, new_opt, loss
+
+    opt_specs = {"mu": specs, "nu": specs, "count": P()}
+    data_spec = P("data", "seq")
+    fn = jax.shard_map(
+        step_local, mesh=mesh,
+        in_specs=(specs, opt_specs, data_spec, data_spec),
+        out_specs=(specs, opt_specs, P()),
+        check_vma=False)
+    return jax.jit(fn, donate_argnums=(0, 1))
+
+
+def make_forward(cfg: TransformerConfig, mesh: Mesh, causal: bool = True):
+    """Jitted forward: (params, tokens [B, S]) -> full logits [B, S, V]."""
+    specs = param_specs(cfg)
+
+    def fwd_local(params, tokens):
+        logits_local = forward_local(params, tokens, cfg, causal=causal)
+        return logits_local
+
+    fn = jax.shard_map(
+        fwd_local, mesh=mesh,
+        in_specs=(specs, P("data", "seq")),
+        out_specs=P("data", "seq", "model"),
+        check_vma=False)
+    return jax.jit(fn)
+
+
+def shard_params(params, cfg: TransformerConfig, mesh: Mesh):
+    specs = param_specs(cfg)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+def shard_opt_state(opt_state, cfg: TransformerConfig, mesh: Mesh):
+    specs = {"mu": param_specs(cfg), "nu": param_specs(cfg), "count": P()}
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), opt_state, specs)

@@ -15,7 +15,6 @@ arrays per iteration and are stacked into the Booster.
 from __future__ import annotations
 
 import json
-from functools import partial
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -28,6 +27,9 @@ from ...parallel import mesh as meshlib
 from .growth import (GrowConfig, Tree, grow_tree, predict_forest_raw,
                      predict_tree_binned)
 from .objectives import Objective, eval_metric, get_objective
+
+
+_STEP_CACHE: Dict = {}
 
 
 class Booster:
@@ -345,9 +347,18 @@ def train_booster(
     out_specs = (row2_spec, row2_spec if has_valid else P(), P(), P())
 
     dummy = np.zeros((), np.float32)
-    step = jax.jit(jax.shard_map(
-        step_local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-        check_vma=False))
+    # cache the compiled step across train_booster calls: the closure is fresh
+    # per call, so jit's identity-keyed cache would otherwise recompile
+    cache_key = (cfg, K, objective, tuple(sorted(objective_kwargs.items())),
+                 Xb_d.shape, None if not has_valid else Xvb_d.shape,
+                 use_bagging, bagging_fraction, feature_fraction, depth_cap,
+                 mesh)
+    step = _STEP_CACHE.get(cache_key)
+    if step is None:
+        step = jax.jit(jax.shard_map(
+            step_local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False))
+        _STEP_CACHE[cache_key] = step
 
     all_trees: List[Tree] = []
     history: Dict[str, List[float]] = {metric_name: []}

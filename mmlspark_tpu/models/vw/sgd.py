@@ -16,7 +16,6 @@ Adaptive (AdaGrad) and normalized updates mirror VW's ``--adaptive``
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple, Optional
 
 import jax

@@ -20,8 +20,6 @@ TCP ring all-reduce (LGBM_NetworkInit, TrainUtils.scala:496-512).
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -102,8 +100,3 @@ def _hist_row_blocks(binned, stats, B, rows_per_block):
     acc, _ = lax.scan(body, acc0, (binned_b, stats_b))
     return acc
 
-
-def masked_stats(grad: jnp.ndarray, hess: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
-    """Stack [grad, hess, 1] masked — the 3 stats every histogram needs."""
-    m = mask.astype(grad.dtype)
-    return jnp.stack([grad * m, hess * m, m], axis=1)  # [n, 3]

@@ -28,14 +28,17 @@ def initialize(coordinator_address: Optional[str] = None,
     """
     if jax.process_count() > 1:
         return  # already initialized
-    if coordinator_address is None and "JAX_COORDINATOR_ADDRESS" not in os.environ \
-            and num_processes is None:
-        return  # single-process run: nothing to do
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except Exception:
+        if coordinator_address is not None or num_processes is not None or \
+                "JAX_COORDINATOR_ADDRESS" in os.environ:
+            raise  # explicit multi-host request must not be swallowed
+        # auto-detection unavailable (single host, no metadata server): fine
 
 
 def process_index() -> int:

@@ -1,0 +1,104 @@
+"""Ring attention: exact attention over sequence shards via ICI ppermute.
+
+Long-context / sequence parallelism is a first-class capability of this
+framework (the reference has none — SURVEY.md §5 "long-context: absent"; its
+only distributed axis was data). Design follows the blockwise/ring formulation
+(Liu et al., Ring Attention; flash-style online softmax): each device holds a
+sequence shard of Q, K, V; K/V blocks rotate around the ring while every
+device accumulates its Q-block's attention with running max/denominator, so
+memory stays O(S_local) and the collective is a neighbor ppermute that rides
+ICI.
+
+Causal masking uses global positions, so rotating blocks preserve exact
+semantics. Works inside ``shard_map`` with a named sequence axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attend(q, k, v, bias, acc, m, denom, scale):
+    """One blockwise attention accumulation step (online softmax).
+
+    q: [B, H, Sq, D]; k/v: [B, H, Sk, D]; bias: [Sq, Sk] additive (-inf masks)
+    acc: [B, H, Sq, D] running numerator; m: [B, H, Sq] running max;
+    denom: [B, H, Sq] running denominator.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    s = s + bias[None, None, :, :]
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard fully-masked rows: exp(-inf - -inf) -> exp(0); zero them via where
+    alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    acc = acc * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    denom = denom * alpha + p.sum(axis=-1)
+    return acc, m_new, denom
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str, causal: bool = True,
+                   scale: Optional[float] = None) -> jnp.ndarray:
+    """Exact (flash-equivalent) attention with K/V rotating over ``axis_name``.
+
+    q, k, v: [B, H, S_local, D] — the local sequence shard, inside shard_map.
+    Returns [B, H, S_local, D] in q's dtype.
+    """
+    B, H, S, D = q.shape
+    n_shards = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+
+    q32 = q.astype(jnp.float32)
+    acc = jnp.zeros((B, H, S, D), jnp.float32)
+    m = jnp.full((B, H, S), -jnp.inf, jnp.float32)
+    denom = jnp.zeros((B, H, S), jnp.float32)
+
+    q_pos = my_idx * S + jnp.arange(S)
+
+    def body(i, carry):
+        acc, m, denom, k_blk, v_blk = carry
+        # block i currently holds the shard that started at ring position
+        # (my_idx - i) mod n
+        src = (my_idx - i) % n_shards
+        k_pos = src * S + jnp.arange(S)
+        if causal:
+            bias = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, -jnp.inf)
+        else:
+            bias = jnp.zeros((S, S), jnp.float32)
+        acc, m, denom = _block_attend(q32, k_blk, v_blk, bias, acc, m, denom, scale)
+        # rotate K/V to the next device (neighbor exchange on the ring)
+        perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return acc, m, denom, k_blk, v_blk
+
+    acc, m, denom, _, _ = lax.fori_loop(
+        0, n_shards, body, (acc, m, denom, k.astype(jnp.float32),
+                            v.astype(jnp.float32)))
+    out = acc / jnp.maximum(denom[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def local_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = True,
+                    scale: Optional[float] = None) -> jnp.ndarray:
+    """Single-shard reference attention (same math, no ring) for testing."""
+    B, H, S, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

@@ -146,8 +146,13 @@ class TrainedRegressorModel(Model, HasLabelCol):
 def _roc_curve(y: np.ndarray, score: np.ndarray):
     order = np.argsort(-score, kind="stable")
     y = y[order]
-    tps = np.cumsum(y)
-    fps = np.cumsum(1 - y)
+    s = score[order]
+    # one ROC point per DISTINCT threshold — tied scores must move together,
+    # else AUC becomes order-dependent and biased
+    boundary = np.nonzero(np.diff(s))[0]
+    idx = np.concatenate([boundary, [len(y) - 1]])
+    tps = np.cumsum(y)[idx]
+    fps = (idx + 1) - tps
     P, N = max(tps[-1], 1e-12), max(fps[-1], 1e-12)
     tpr = np.concatenate([[0.0], tps / P])
     fpr = np.concatenate([[0.0], fps / N])
