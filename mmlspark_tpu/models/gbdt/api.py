@@ -17,8 +17,8 @@ from typing import Optional
 import numpy as np
 
 from ...core.dataset import Dataset
-from ...core.params import (HasFeaturesCol, HasInitScoreCol, HasLabelCol,
-                            HasPredictionCol, HasProbabilityCol,
+from ...core.params import (HasFeaturesCol, HasGroupCol, HasInitScoreCol,
+                            HasLabelCol, HasPredictionCol, HasProbabilityCol,
                             HasRawPredictionCol, HasValidationIndicatorCol,
                             HasWeightCol, Param, Params, TypeConverters)
 from ...core.pipeline import Estimator, Model
@@ -71,6 +71,13 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
     parallelism = Param("parallelism", "data_parallel or voting_parallel "
                         "(mesh collectives implement both)", "data_parallel",
                         TypeConverters.to_string)
+    topK = Param("topK", "Features each shard votes for under voting_parallel "
+                 "(reference: LightGBMConstants.scala:24 DefaultTopK)", 20,
+                 TypeConverters.to_int)
+    topRate = Param("topRate", "GOSS: top-gradient retain fraction", 0.2,
+                    TypeConverters.to_float)
+    otherRate = Param("otherRate", "GOSS: random retain fraction of the rest", 0.1,
+                      TypeConverters.to_float)
     defaultListenPort = Param("defaultListenPort", "Ignored on TPU (no socket ring)",
                               12400, TypeConverters.to_int)
     timeout = Param("timeout", "Ignored on TPU (no rendezvous)", 1200.0,
@@ -80,6 +87,13 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
                                     False, TypeConverters.to_bool)
     boostFromAverage = Param("boostFromAverage", "Init score from label mean", True,
                              TypeConverters.to_bool)
+    leafPredictionCol = Param(
+        "leafPredictionCol", "If set, output per-tree leaf indices here "
+        "(reference: LightGBMModelMethods predLeaf)", None, TypeConverters.to_string)
+    featuresShapCol = Param(
+        "featuresShapCol", "If set, output per-feature SHAP-style contributions "
+        "here (reference: LightGBMBooster.scala:250-269)", None,
+        TypeConverters.to_string)
 
     def _grow_config(self) -> GrowConfig:
         return GrowConfig(
@@ -92,6 +106,8 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
             min_data_in_leaf=self.get_or_default("minDataInLeaf"),
             min_sum_hessian_in_leaf=self.get_or_default("minSumHessianInLeaf"),
             min_gain_to_split=self.get_or_default("minGainToSplit"),
+            voting=self.get_or_default("parallelism") == "voting_parallel",
+            top_k=self.get_or_default("topK"),
         )
 
     def _extract_arrays(self, dataset: Dataset):
@@ -136,6 +152,9 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
             metric_eval_period=self.get_or_default("metricEvalPeriod"),
             boost_from_average=self.get_or_default("boostFromAverage"),
             objective_kwargs=objective_kwargs or {},
+            boosting_type=self.get_or_default("boostingType"),
+            top_rate=self.get_or_default("topRate"),
+            other_rate=self.get_or_default("otherRate"),
         )
         num_iterations = self.get_or_default("numIterations")
         if num_batches and num_batches > 1:
@@ -161,6 +180,17 @@ class _LightGBMModelBase(Model, _LightGBMParams):
     def __init__(self, booster: Optional[Booster] = None, **kwargs):
         super().__init__(**kwargs)
         self.booster = booster
+
+    def _add_introspection_cols(self, dataset: Dataset, X) -> Dataset:
+        leaf_col = self.get_or_default("leafPredictionCol")
+        if leaf_col:
+            dataset = dataset.with_column(
+                leaf_col, self.booster.predict_leaf(X).astype(np.float64))
+        shap_col = self.get_or_default("featuresShapCol")
+        if shap_col:
+            dataset = dataset.with_column(
+                shap_col, self.booster.predict_contrib(X).astype(np.float64))
+        return dataset
 
     def get_feature_importances(self, importance_type: str = "split"):
         return self.booster.feature_importances(importance_type).tolist()
@@ -243,11 +273,12 @@ class LightGBMClassificationModel(_LightGBMModelBase, HasRawPredictionCol,
         th = self.get_or_default("thresholds")
         scaled = probs / np.asarray(th)[None, :] if th else probs
         pred = scaled.argmax(axis=1).astype(np.float64)
-        return dataset.with_columns({
+        out = dataset.with_columns({
             self.get_or_default("rawPredictionCol"): margins,
             self.get_or_default("probabilityCol"): probs,
             self.get_or_default("predictionCol"): pred,
         })
+        return self._add_introspection_cols(out, X)
 
     @staticmethod
     def load_native_model(path: str) -> "LightGBMClassificationModel":
@@ -285,9 +316,136 @@ class LightGBMRegressionModel(_LightGBMModelBase):
     def transform(self, dataset: Dataset) -> Dataset:
         X = dataset.array(self.get_or_default("featuresCol"), np.float32)
         pred = self.booster.predict(X).astype(np.float64)
-        return dataset.with_column(self.get_or_default("predictionCol"), pred)
+        out = dataset.with_column(self.get_or_default("predictionCol"), pred)
+        return self._add_introspection_cols(out, X)
 
     @staticmethod
     def load_native_model(path: str) -> "LightGBMRegressionModel":
         with open(path) as f:
             return LightGBMRegressionModel(Booster.from_string(f.read()))
+
+
+def _pad_groups(X: np.ndarray, y: np.ndarray, w: Optional[np.ndarray],
+                group: np.ndarray, S: int, n_shard_multiple: int):
+    """Sort rows by group and pad every query group to a static width S.
+
+    The TPU replacement for the reference's group-aware repartition
+    (lightgbm/LightGBMRanker.scala:80-98 keeps each query's rows inside one
+    partition): each group becomes a fixed [S] block, groups are padded to a
+    multiple of the shard count, so shard boundaries never cut a group and
+    every shard sees an identical static shape.
+
+    Returns (Xp, yp, wp, valid, n_groups) with Xp of shape [G_pad*S, F].
+    """
+    group = np.asarray(group)
+    order = np.argsort(group, kind="stable")
+    X, y = X[order], y[order]
+    w = None if w is None else w[order]
+    _, starts, counts = np.unique(group[order], return_index=True,
+                                  return_counts=True)
+    G = len(starts)
+    G_pad = -(-G // n_shard_multiple) * n_shard_multiple
+    F = X.shape[1]
+    Xp = np.zeros((G_pad * S, F), dtype=np.float32)
+    yp = np.zeros(G_pad * S, dtype=np.float32)
+    wp = np.zeros(G_pad * S, dtype=np.float32)
+    valid = np.zeros(G_pad * S, dtype=np.float32)
+    for g in range(G):
+        c = min(int(counts[g]), S)  # truncate oversize groups
+        sl = slice(starts[g], starts[g] + c)
+        dst = slice(g * S, g * S + c)
+        Xp[dst], yp[dst] = X[sl], y[sl]
+        wp[dst] = 1.0 if w is None else w[sl]
+        valid[dst] = 1.0
+    return Xp, yp, wp, valid, G
+
+
+class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
+    """Distributed LambdaRank (reference: lightgbm/LightGBMRanker.scala).
+
+    Groups are padded to ``maxGroupSize`` static blocks so the pairwise
+    lambda computation is one dense MXU batch; each shard holds whole groups
+    (the reference's group-aware repartition, LightGBMRanker.scala:80-98).
+    """
+
+    objective = Param("objective", "ranking objective", "lambdarank",
+                      TypeConverters.to_string)
+    maxPosition = Param("maxPosition", "NDCG truncation position "
+                        "(reference: TrainParams maxPosition)", 20,
+                        TypeConverters.to_int)
+    evalAt = Param("evalAt", "Positions for NDCG evaluation", [1, 3, 5, 10],
+                   TypeConverters.to_list_int)
+    maxGroupSize = Param("maxGroupSize",
+                         "Static padded width per query group (rows beyond "
+                         "this are truncated)", 128, TypeConverters.to_int)
+    sigma = Param("sigma", "LambdaRank sigmoid steepness", 1.0,
+                  TypeConverters.to_float)
+
+    def fit(self, dataset: Dataset) -> "LightGBMRankerModel":
+        from ...parallel import mesh as meshlib
+
+        train_ds, valid_ds = self._split_validation(dataset)
+        gcol = self.get_or_default("groupCol")
+        if not gcol:
+            raise ValueError("LightGBMRanker requires groupCol")
+        nshards = meshlib.num_shards(meshlib.get_default_mesh())
+
+        X, y, w = self._extract_arrays(train_ds)
+        group = np.asarray(train_ds[gcol])
+        sizes = np.unique(group, return_counts=True)[1]
+        S = int(min(self.get_or_default("maxGroupSize"),
+                    1 << int(np.ceil(np.log2(max(sizes.max(), 2))))))
+        Xp, yp, wp, valid, _ = _pad_groups(X, y, w, group, S, nshards)
+
+        valid_set = None
+        if valid_ds is not None and len(valid_ds) > 0:
+            Xv, yv, _ = self._extract_arrays(valid_ds)
+            gv = np.asarray(valid_ds[gcol])
+            Xvp, yvp, _, validv, _ = _pad_groups(Xv, yv, None, gv, S, nshards)
+            # per-row metric weight 1/group_size -> weighted mean == mean NDCG
+            # over groups (see objectives._ndcg_metric)
+            gsz = validv.reshape(-1, S).sum(axis=1)
+            wv = (validv.reshape(-1, S)
+                  / np.maximum(gsz, 1.0)[:, None]).reshape(-1)
+            valid_set = (Xvp, yvp, wv.astype(np.float32))
+
+        eval_at = self.get_or_default("evalAt") or []
+        kwargs = dict(group_size=S,
+                      max_position=self.get_or_default("maxPosition"),
+                      sigma=self.get_or_default("sigma"),
+                      eval_at=int(max(eval_at)) if eval_at else 0)
+        booster = train_booster(
+            Xp, yp, wp,
+            objective="lambdarank", num_class=1,
+            cfg=self._grow_config(),
+            max_bin=self.get_or_default("maxBin"),
+            bin_sample_count=self.get_or_default("binSampleCount"),
+            feature_fraction=self.get_or_default("featureFraction"),
+            bagging_fraction=self.get_or_default("baggingFraction"),
+            bagging_freq=self.get_or_default("baggingFreq"),
+            seed=self.get_or_default("baggingSeed"),
+            num_iterations=self.get_or_default("numIterations"),
+            valid_set=valid_set,
+            early_stopping_rounds=self.get_or_default("earlyStoppingRound"),
+            metric_eval_period=self.get_or_default("metricEvalPeriod"),
+            boost_from_average=False,
+            objective_kwargs=kwargs,
+            row_valid=valid,
+            boosting_type=self.get_or_default("boostingType"),
+        )
+        model = LightGBMRankerModel(booster)
+        self._copy_params_to(model)
+        return model
+
+
+class LightGBMRankerModel(_LightGBMModelBase):
+    def transform(self, dataset: Dataset) -> Dataset:
+        X = dataset.array(self.get_or_default("featuresCol"), np.float32)
+        score = self.booster.predict_raw(X)[:, 0].astype(np.float64)
+        out = dataset.with_column(self.get_or_default("predictionCol"), score)
+        return self._add_introspection_cols(out, X)
+
+    @staticmethod
+    def load_native_model(path: str) -> "LightGBMRankerModel":
+        with open(path) as f:
+            return LightGBMRankerModel(Booster.from_string(f.read()))

@@ -15,6 +15,7 @@ arrays per iteration and are stacked into the Booster.
 from __future__ import annotations
 
 import json
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -26,10 +27,24 @@ from ...ops.binning import QuantileBinner
 from ...parallel import mesh as meshlib
 from .growth import (GrowConfig, Tree, grow_tree, predict_forest_raw,
                      predict_tree_binned)
-from .objectives import Objective, eval_metric, get_objective
+from .objectives import (HIGHER_IS_BETTER, Objective, eval_metric,
+                         get_objective)
 
 
-_STEP_CACHE: Dict = {}
+# bounded LRU of compiled boosting steps: one executable per
+# (shape, config, mesh) combination; evict oldest so long-lived processes
+# (sweeps, services) don't pin executables forever
+_STEP_CACHE: "OrderedDict" = OrderedDict()
+_STEP_CACHE_MAX = 32
+
+
+def _with_tree_defaults(fields: Dict) -> Dict:
+    """Backfill tree fields added after format v1 (e.g. node_value) so models
+    saved by older versions still load; node_value falls back to leaf_value
+    (SHAP contributions then attribute only at leaves)."""
+    if "node_value" not in fields:
+        fields["node_value"] = np.asarray(fields["leaf_value"])
+    return fields
 
 
 class Booster:
@@ -87,6 +102,49 @@ class Booster:
         if self.num_class > 1:
             return np.asarray(jax.nn.softmax(raw, axis=-1))
         return np.asarray(obj.transform(jnp.asarray(raw[:, 0])))
+
+    def predict_contrib(self, X: np.ndarray) -> np.ndarray:
+        """Per-feature contributions (SHAP-style, Saabas path attribution).
+
+        Parity with predict(predictContrib) of the reference
+        (lightgbm/LightGBMBooster.scala:250-269 ``featuresShapCol``): for each
+        tree, walking root->leaf attributes the change in expected node value
+        to the split feature. Returns [n, (F+1) * num_class]; the last slot of
+        each class block is the bias (base score + root expectations).
+        """
+        X = np.asarray(X, dtype=np.float32)
+        Xd = jnp.asarray(X)
+        trees = jax.tree_util.tree_map(jnp.asarray, self.trees)
+        thr = jnp.asarray(self.thr_raw)
+        n, F = X.shape
+
+        def one_tree(ts, thr_t):
+            node = jnp.zeros(n, dtype=jnp.int32)
+            contrib = jnp.zeros((n, F), dtype=jnp.float32)
+
+            def body(_, carry):
+                node, contrib = carry
+                f = ts.feat[node]
+                x = jnp.take_along_axis(Xd, f[:, None], axis=1)[:, 0]
+                nxt = jnp.where(x > thr_t[node], ts.right[node], ts.left[node])
+                internal = ~ts.is_leaf[node]
+                delta = ts.node_value[nxt] - ts.node_value[node]
+                contrib = contrib.at[jnp.arange(n), f].add(
+                    jnp.where(internal, delta, 0.0))
+                return jnp.where(internal, nxt, node), contrib
+
+            _, contrib = jax.lax.fori_loop(0, self.depth_cap, body,
+                                           (node, contrib))
+            return contrib, ts.node_value[0]
+
+        contribs, roots = jax.vmap(one_tree)(trees, thr)  # [T, n, F], [T]
+        contribs, roots = np.asarray(contribs), np.asarray(roots)
+        K = self.num_class
+        out = np.zeros((n, (F + 1) * K), dtype=np.float32)
+        for k in range(K):
+            out[:, k * (F + 1):k * (F + 1) + F] = contribs[k::K].sum(axis=0)
+            out[:, k * (F + 1) + F] = self.base_score[k] + roots[k::K].sum()
+        return out
 
     def predict_leaf(self, X: np.ndarray) -> np.ndarray:
         """Per-tree leaf index for each row: [n, T] (predLeaf parity,
@@ -148,7 +206,8 @@ class Booster:
             path = str(path) + ".npz"
         z = np.load(path, allow_pickle=False)
         meta = json.loads(bytes(z["meta_json"]).decode())
-        trees = Tree(**{k: z[f"tree_{k}"] for k in Tree._fields})
+        trees = Tree(**_with_tree_defaults(
+            {k: z[f"tree_{k}"] for k in Tree._fields if f"tree_{k}" in z}))
         binner_state = dict(meta["binner"])
         binner_state["upper_bounds"] = z["binner_upper_bounds"]
         return Booster(
@@ -179,7 +238,8 @@ class Booster:
     @staticmethod
     def from_string(s: str) -> "Booster":
         d = json.loads(s)
-        trees = Tree(**{k: np.asarray(v) for k, v in d["trees"].items()})
+        trees = Tree(**_with_tree_defaults(
+            {k: np.asarray(v) for k, v in d["trees"].items()}))
         binner_state = dict(d["binner"])
         binner_state["upper_bounds"] = np.asarray(
             binner_state["upper_bounds"], dtype=np.float32)
@@ -217,6 +277,10 @@ def train_booster(
     objective_kwargs: Optional[dict] = None,
     iteration_callback: Optional[Callable[[int, Dict[str, float]], None]] = None,
     metric_eval_period: int = 1,
+    row_valid: Optional[np.ndarray] = None,
+    boosting_type: str = "gbdt",
+    top_rate: float = 0.2,
+    other_rate: float = 0.1,
 ) -> Booster:
     """Train a boosted ensemble, rows sharded over the mesh ``data`` axis.
 
@@ -245,7 +309,11 @@ def train_booster(
     Xb_d, _ = meshlib.shard_rows(Xb, mesh)
     y_d, _ = meshlib.shard_rows(y, mesh)
     w_d, _ = meshlib.shard_rows(w, mesh)
-    vmask_d, _ = meshlib.shard_rows(meshlib.validity_mask(n, Xb_d.shape[0]), mesh)
+    vmask = meshlib.validity_mask(n, Xb_d.shape[0])
+    if row_valid is not None:
+        # in-group padding rows (ranker) are dead for counts and histograms
+        vmask[:n] *= np.asarray(row_valid, np.float32)
+    vmask_d, _ = meshlib.shard_rows(vmask, mesh)
 
     # base score (replicated scalar per class)
     if init_booster is not None:
@@ -283,14 +351,37 @@ def train_booster(
     depth_cap = cfg.max_depth if cfg.max_depth > 0 else max(1, cfg.num_leaves - 1)
     depth_cap = min(depth_cap, 2 * cfg.num_leaves)
 
-    use_bagging = bagging_fraction < 1.0 and bagging_freq > 0
+    use_goss = boosting_type == "goss"
+    use_bagging = (not use_goss) and bagging_fraction < 1.0 and bagging_freq > 0
     metric_name = eval_metric(obj, jnp.zeros((1, K)) if K > 1 else jnp.zeros(1),
-                              jnp.zeros(1), jnp.ones(1))[0]
+                              jnp.zeros(1), jnp.ones(1), **objective_kwargs)[0]
 
     def step_local(binned, yl, wl, vmask, scores, vbinned, vy, vw, vscores,
                    key, bag_key):
         """One boosting iteration on local shard rows (inside shard_map)."""
-        if use_bagging:
+        if K > 1:
+            grad, hess = obj.grad_hess(scores, yl, wl)
+        else:
+            grad, hess = obj.grad_hess(scores[:, 0], yl, wl)
+            grad, hess = grad[:, None], hess[:, None]
+        if use_goss:
+            # GOSS (boostingType=goss): keep the top_rate fraction by |grad|,
+            # sample other_rate of the rest amplified by (1-a)/b. The
+            # amplification rides the row mask, so weighted counts see it too
+            # (a documented deviation from LightGBM's unweighted counts).
+            absg = jnp.abs(grad).sum(axis=1) * vmask
+            n_valid = jnp.maximum(jnp.sum(vmask), 1.0)
+            # keep top_rate*n_valid rows of an N-row shard (padded rows have
+            # absg 0 and cluster at the bottom of the quantile)
+            q = jnp.clip(1.0 - top_rate * n_valid / vmask.shape[0], 0.0, 1.0)
+            top = absg >= jnp.quantile(absg, q)
+            k2 = jax.random.fold_in(bag_key, jax.lax.axis_index("data"))
+            keep_p = other_rate / max(1.0 - top_rate, 1e-6)
+            rest_keep = jax.random.uniform(k2, vmask.shape) < keep_p
+            amp = (1.0 - top_rate) / max(other_rate, 1e-6)
+            row_mask = vmask * jnp.where(top, 1.0,
+                                         jnp.where(rest_keep, amp, 0.0))
+        elif use_bagging:
             # bag_key changes only every bagging_freq iterations (LightGBM
             # semantics: the subsample is reused for baggingFreq rounds)
             k = jax.random.fold_in(bag_key, jax.lax.axis_index("data"))
@@ -298,11 +389,6 @@ def train_booster(
             row_mask = vmask * bag.astype(jnp.float32)
         else:
             row_mask = vmask
-        if K > 1:
-            grad, hess = obj.grad_hess(scores, yl, wl)
-        else:
-            grad, hess = obj.grad_hess(scores[:, 0], yl, wl)
-            grad, hess = grad[:, None], hess[:, None]
 
         trees_out = []
         fmask = jnp.ones(F, dtype=bool)
@@ -327,7 +413,7 @@ def train_booster(
                 vscores = vscores.at[:, k].add(
                     predict_tree_binned(tr, vbinned, depth_cap))
             sc = vscores if K > 1 else vscores[:, 0]
-            _, num = eval_metric(obj, sc, vy, vw)
+            _, num = eval_metric(obj, sc, vy, vw, **objective_kwargs)
             # metric is a weighted mean: combine across shards
             wsum = jax.lax.psum(jnp.sum(vw), "data")
             local_wsum = jnp.sum(vw)
@@ -352,24 +438,32 @@ def train_booster(
     cache_key = (cfg, K, objective, tuple(sorted(objective_kwargs.items())),
                  Xb_d.shape, None if not has_valid else Xvb_d.shape,
                  use_bagging, bagging_fraction, feature_fraction, depth_cap,
-                 mesh)
+                 use_goss, top_rate, other_rate, mesh)
     step = _STEP_CACHE.get(cache_key)
     if step is None:
         step = jax.jit(jax.shard_map(
             step_local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False))
         _STEP_CACHE[cache_key] = step
+        while len(_STEP_CACHE) > _STEP_CACHE_MAX:
+            _STEP_CACHE.popitem(last=False)
+    else:
+        _STEP_CACHE.move_to_end(cache_key)
 
     all_trees: List[Tree] = []
     history: Dict[str, List[float]] = {metric_name: []}
-    best_metric, best_iter, rounds_no_improve = np.inf, -1, 0
-    higher_is_better = False  # logloss/rmse: lower is better
+    higher_is_better = metric_name in HIGHER_IS_BETTER
+    best_metric = -np.inf if higher_is_better else np.inf
+    best_iter, rounds_no_improve = -1, 0
 
     base_key = jax.random.PRNGKey(seed)
     for it in range(num_iterations):
         key = jax.random.fold_in(base_key, it)
-        bag_key = jax.random.fold_in(
-            base_key, 1_000_003 + (it // max(bagging_freq, 1) if use_bagging else 0))
+        # GOSS resamples every iteration; bagging reuses its subsample for
+        # bagging_freq rounds (LightGBM semantics)
+        bag_step = (it if use_goss
+                    else it // max(bagging_freq, 1) if use_bagging else 0)
+        bag_key = jax.random.fold_in(base_key, 1_000_003 + bag_step)
         scores_d, vscores_d_new, trees_stacked, metrics = step(
             Xb_d, y_d, w_d, vmask_d, scores_d,
             Xvb_d if has_valid else dummy, yv_d if has_valid else dummy,
@@ -384,7 +478,8 @@ def train_booster(
         if has_valid and (it % metric_eval_period == 0 or it == num_iterations - 1):
             m = float(metrics["valid"])
             history[metric_name].append(m)
-            improved = m < best_metric - 1e-12
+            improved = (m > best_metric + 1e-12 if higher_is_better
+                        else m < best_metric - 1e-12)
             if improved:
                 best_metric, best_iter, rounds_no_improve = m, it, 0
             else:

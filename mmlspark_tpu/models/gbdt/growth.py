@@ -43,6 +43,12 @@ class GrowConfig(NamedTuple):
     min_data_in_leaf: int = 20
     min_sum_hessian_in_leaf: float = 1e-3
     min_gain_to_split: float = 0.0
+    # voting_parallel (reference: lightgbm/LightGBMParams.scala:13-27,
+    # LightGBMConstants.scala:24 DefaultTopK): shards vote on locally-best
+    # top_k features; only the globally top 2k features' histograms are
+    # all-reduced — two small collectives instead of one [F,3,B] psum.
+    voting: bool = False
+    top_k: int = 20
 
 
 def _soft_threshold(g, l1):
@@ -91,6 +97,7 @@ class Tree(NamedTuple):
     node_hess: jnp.ndarray  # [M] f32
     node_cnt: jnp.ndarray   # [M] f32
     split_gain: jnp.ndarray  # [M] f32 gain of the split at internal nodes
+    node_value: jnp.ndarray  # [M] f32 expected value at every node (SHAP path)
 
 
 def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
@@ -108,21 +115,59 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     M = 2 * L - 1
     B = int(cfg.num_bins)
 
+    def _feature_best_gains(hist, fm):
+        """[F] best local split gain per feature (for the voting step)."""
+        gl = jnp.cumsum(hist[:, 0, :], axis=-1)
+        hl = jnp.cumsum(hist[:, 1, :], axis=-1)
+        cl = jnp.cumsum(hist[:, 2, :], axis=-1)
+        tg, th, tc = gl[:, -1:], hl[:, -1:], cl[:, -1:]
+        gr, hr, cr = tg - gl, th - hl, tc - cl
+        gain = (_leaf_objective(gl, hl, cfg) + _leaf_objective(gr, hr, cfg)
+                - _leaf_objective(tg, th, cfg))
+        ok = ((cl >= cfg.min_data_in_leaf) & (cr >= cfg.min_data_in_leaf)
+              & (hl >= cfg.min_sum_hessian_in_leaf)
+              & (hr >= cfg.min_sum_hessian_in_leaf) & fm[:, None])
+        ok = ok.at[:, B - 1].set(False)
+        return jnp.max(jnp.where(ok, gain, NEG_INF), axis=-1)
+
     def all_hist(stats):
+        """Global histogram + selected-feature mask.
+
+        data_parallel: one full [F, C, B] psum. voting_parallel: vote top_k
+        locally, psum the votes, psum only the global top-2k features'
+        histograms (scattered back into a zeroed full array so downstream
+        split search keeps static shapes; unselected features are masked)."""
         h = histogram(binned, stats, B)
-        if axis_name is not None:
-            h = lax.psum(h, axis_name)
-        return h
+        if axis_name is None:
+            return h, jnp.ones(F, dtype=bool)
+        if not cfg.voting:
+            return lax.psum(h, axis_name), jnp.ones(F, dtype=bool)
+        gains = _feature_best_gains(h[:, 0:3], feat_mask)
+        if h.shape[1] == 6:
+            gains = jnp.maximum(gains, _feature_best_gains(h[:, 3:6], feat_mask))
+        k = min(int(cfg.top_k), F)
+        _, local_top = lax.top_k(gains, k)
+        votes = lax.psum(jnp.zeros(F).at[local_top].add(1.0), axis_name)
+        # deterministic tie-break toward low feature index on every shard
+        _, sel = lax.top_k(votes - jnp.arange(F) * 1e-6, min(2 * k, F))
+        sel = jnp.sort(sel)
+        hsel = lax.psum(h[sel], axis_name)
+        hfull = jnp.zeros_like(h).at[sel].set(hsel)
+        return hfull, jnp.zeros(F, dtype=bool).at[sel].set(True)
 
     vm = valid.astype(jnp.float32)
-    root_hist = all_hist(jnp.stack([grad * vm, hess * vm, vm], axis=1))
-    tot = root_hist[0].sum(axis=-1)  # bins of feature 0 partition all rows
+    root_hist, sel0 = all_hist(jnp.stack([grad * vm, hess * vm, vm], axis=1))
+    # totals from the raw stats (not the histogram: under voting_parallel an
+    # unselected feature's rows are zeroed there)
+    tot = jnp.stack([jnp.sum(grad * vm), jnp.sum(hess * vm), jnp.sum(vm)])
+    if axis_name is not None:
+        tot = lax.psum(tot, axis_name)
     tot_g, tot_h, tot_c = tot[0], tot[1], tot[2]
 
     # cfg is static Python config: root may split unless max_depth == 0
     root_allow = jnp.bool_(cfg.max_depth < 0 or cfg.max_depth >= 1)
     g0, f0, b0, lg0, lh0, lc0 = _best_split(
-        root_hist, tot_g, tot_h, tot_c, cfg, feat_mask, root_allow)
+        root_hist, tot_g, tot_h, tot_c, cfg, feat_mask & sel0, root_allow)
 
     zi = jnp.zeros(M, dtype=jnp.int32)
     zf = jnp.zeros(M, dtype=jnp.float32)
@@ -154,7 +199,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         mr = (in_node & ~go_left).astype(jnp.float32) * vm
         stats6 = jnp.stack(
             [grad * ml, hess * ml, ml, grad * mr, hess * mr, mr], axis=1)
-        h2 = all_hist(stats6)
+        h2, sel = all_hist(stats6)
         hist_l, hist_r = h2[:, 0:3, :], h2[:, 3:6, :]
 
         lg, lh, lc = st["clg"][node], st["clh"][node], st["clc"][node]
@@ -163,9 +208,9 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         can_split_child = jnp.where(
             cfg.max_depth < 0, True, child_depth + 1 <= cfg.max_depth)
         gL, fL, bL, lgL, lhL, lcL = _best_split(
-            hist_l, lg, lh, lc, cfg, feat_mask, can_split_child)
+            hist_l, lg, lh, lc, cfg, feat_mask & sel, can_split_child)
         gR, fR, bR, lgR, lhR, lcR = _best_split(
-            hist_r, rg, rh, rc, cfg, feat_mask, can_split_child)
+            hist_r, rg, rh, rc, cfg, feat_mask & sel, can_split_child)
 
         new = dict(st)
         new["row_node"] = jnp.where(
@@ -196,12 +241,14 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     raw_val = -_soft_threshold(state["ng"], cfg.lambda_l1) / (
         state["nh"] + cfg.lambda_l2 + 1e-38)
     leaf_value = jnp.where(state["is_leaf"] & (state["nc"] > 0), raw_val * lr, 0.0)
+    node_value = jnp.where(state["nc"] > 0, raw_val * lr, 0.0)
 
     tree = Tree(
         feat=state["feat"], thr_bin=state["thr"], left=state["left"],
         right=state["right"], is_leaf=state["is_leaf"], leaf_value=leaf_value,
         node_count=state["num_nodes"], node_grad=state["ng"],
-        node_hess=state["nh"], node_cnt=state["nc"], split_gain=state["gain"])
+        node_hess=state["nh"], node_cnt=state["nc"], split_gain=state["gain"],
+        node_value=node_value)
     # row_node is each row's final leaf: leaf_value[row_node] is this tree's
     # prediction for the training rows — no traversal needed during boosting.
     return tree, state["row_node"]

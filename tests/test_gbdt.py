@@ -279,3 +279,115 @@ class TestBinning:
         Xb = b.transform(X)
         # each distinct value gets its own bin
         assert len(np.unique(Xb)) == 3
+
+
+def _ranking_data(seed=0, n_groups=60):
+    rng = np.random.default_rng(seed)
+    groups, ys, feats = [], [], []
+    for g in range(n_groups):
+        sz = int(rng.integers(3, 12))
+        rel = rng.integers(0, 4, sz)
+        x = rng.normal(size=(sz, 5)).astype(np.float32)
+        x[:, 0] += rel  # feature 0 carries the relevance signal
+        groups += [g] * sz
+        ys += rel.tolist()
+        feats.append(x)
+    return np.concatenate(feats), np.asarray(ys, np.float64), np.asarray(groups)
+
+
+class TestRanker:
+    """reference: lightgbm/LightGBMRanker.scala + group handling :80-98"""
+
+    def test_lambdarank_learns_ranking(self):
+        from mmlspark_tpu.models.gbdt.api import LightGBMRanker
+
+        X, y, g = _ranking_data()
+        ds = _to_ds(X, y, query=g)
+        model = LightGBMRanker(groupCol="query", numIterations=20,
+                               numLeaves=7, minDataInLeaf=2).fit(ds)
+        score = model.transform(ds)["prediction"]
+        # within-group concordance: higher label should score higher
+        concordant = total = 0
+        for gid in np.unique(g):
+            m = g == gid
+            s, yy = score[m], y[m]
+            for i in range(len(s)):
+                for j in range(len(s)):
+                    if yy[i] > yy[j]:
+                        total += 1
+                        concordant += s[i] > s[j]
+        assert concordant / total > 0.75
+
+    def test_ranker_early_stopping_ndcg(self):
+        from mmlspark_tpu.models.gbdt.api import LightGBMRanker
+
+        X, y, g = _ranking_data()
+        vmask = (g % 5 == 0).astype(np.float64)
+        ds = _to_ds(X, y, query=g, isVal=vmask)
+        model = LightGBMRanker(groupCol="query", numIterations=50,
+                               numLeaves=7, minDataInLeaf=2,
+                               validationIndicatorCol="isVal",
+                               earlyStoppingRound=5).fit(ds)
+        hist = model.booster.eval_history["ndcg"]
+        assert len(hist) >= 1
+        # ndcg must improve over training (higher_is_better path)
+        assert max(hist) >= hist[0]
+
+    def test_ranker_native_model_roundtrip(self, tmp_path):
+        from mmlspark_tpu.models.gbdt.api import (LightGBMRanker,
+                                                  LightGBMRankerModel)
+
+        X, y, g = _ranking_data()
+        ds = _to_ds(X, y, query=g)
+        model = LightGBMRanker(groupCol="query", numIterations=5,
+                               numLeaves=7, minDataInLeaf=2).fit(ds)
+        p = str(tmp_path / "ranker.txt")
+        model.save_native_model(p)
+        loaded = LightGBMRankerModel.load_native_model(p)
+        np.testing.assert_allclose(loaded.booster.predict_raw(X),
+                                   model.booster.predict_raw(X), rtol=1e-6)
+
+
+class TestShapAndLeaf:
+    """reference: LightGBMBooster.scala:250-269 predict contribs / leaf"""
+
+    def test_shap_sums_to_raw_prediction(self):
+        Xtr, Xte, ytr, yte = _binary_data()
+        model = LightGBMClassifier(numIterations=10).fit(_to_ds(Xtr, ytr))
+        contrib = model.booster.predict_contrib(Xte.astype(np.float32))
+        raw = model.booster.predict_raw(Xte.astype(np.float32))[:, 0]
+        assert contrib.shape == (len(Xte), Xte.shape[1] + 1)
+        np.testing.assert_allclose(contrib.sum(axis=1), raw, atol=1e-3)
+
+    def test_shap_and_leaf_columns(self):
+        Xtr, Xte, ytr, yte = _binary_data()
+        model = LightGBMClassifier(numIterations=5).fit(_to_ds(Xtr, ytr))
+        model.set(featuresShapCol="shap", leafPredictionCol="leaves")
+        out = model.transform(_to_ds(Xte, yte))
+        assert out["shap"].shape == (len(Xte), Xte.shape[1] + 1)
+        assert out["leaves"].shape == (len(Xte), model.booster.num_trees)
+
+    def test_multiclass_shap_shape(self):
+        X, y = load_iris(return_X_y=True)
+        model = LightGBMClassifier(numIterations=4).fit(_to_ds(X, y))
+        contrib = model.booster.predict_contrib(X.astype(np.float32))
+        assert contrib.shape == (len(X), (X.shape[1] + 1) * 3)
+
+
+class TestParallelModes:
+    """reference: lightgbm/LightGBMParams.scala:13-27 parallelism + topK"""
+
+    def test_voting_parallel_matches_quality(self):
+        Xtr, Xte, ytr, yte = _binary_data()
+        model = LightGBMClassifier(numIterations=15,
+                                   parallelism="voting_parallel",
+                                   topK=5).fit(_to_ds(Xtr, ytr))
+        p = model.transform(_to_ds(Xte, yte))["probability"][:, 1]
+        assert roc_auc_score(yte, p) > BASELINE_BINARY_AUC
+
+    def test_goss(self):
+        Xtr, Xte, ytr, yte = _binary_data()
+        model = LightGBMClassifier(numIterations=15,
+                                   boostingType="goss").fit(_to_ds(Xtr, ytr))
+        p = model.transform(_to_ds(Xte, yte))["probability"][:, 1]
+        assert roc_auc_score(yte, p) > 0.95
