@@ -117,13 +117,19 @@ class Booster:
         trees = jax.tree_util.tree_map(jnp.asarray, self.trees)
         thr = jnp.asarray(self.thr_raw)
         n, F = X.shape
+        K = self.num_class
+        T = self.num_trees
+        class_of_tree = jnp.arange(T, dtype=jnp.int32) % K
 
-        def one_tree(ts, thr_t):
+        def scan_body(carry, xs):
+            # accumulate per-class sums: peak memory [K, n, F], not [T, n, F]
+            csum, rsum = carry
+            ts, thr_t, k = xs
             node = jnp.zeros(n, dtype=jnp.int32)
             contrib = jnp.zeros((n, F), dtype=jnp.float32)
 
-            def body(_, carry):
-                node, contrib = carry
+            def body(_, st):
+                node, contrib = st
                 f = ts.feat[node]
                 x = jnp.take_along_axis(Xd, f[:, None], axis=1)[:, 0]
                 nxt = jnp.where(x > thr_t[node], ts.right[node], ts.left[node])
@@ -135,15 +141,17 @@ class Booster:
 
             _, contrib = jax.lax.fori_loop(0, self.depth_cap, body,
                                            (node, contrib))
-            return contrib, ts.node_value[0]
+            return (csum.at[k].add(contrib),
+                    rsum.at[k].add(ts.node_value[0])), None
 
-        contribs, roots = jax.vmap(one_tree)(trees, thr)  # [T, n, F], [T]
-        contribs, roots = np.asarray(contribs), np.asarray(roots)
-        K = self.num_class
+        init = (jnp.zeros((K, n, F), jnp.float32), jnp.zeros(K, jnp.float32))
+        (csum, rsum), _ = jax.lax.scan(scan_body, init,
+                                       (trees, thr, class_of_tree))
+        csum, rsum = np.asarray(csum), np.asarray(rsum)
         out = np.zeros((n, (F + 1) * K), dtype=np.float32)
         for k in range(K):
-            out[:, k * (F + 1):k * (F + 1) + F] = contribs[k::K].sum(axis=0)
-            out[:, k * (F + 1) + F] = self.base_score[k] + roots[k::K].sum()
+            out[:, k * (F + 1):k * (F + 1) + F] = csum[k]
+            out[:, k * (F + 1) + F] = self.base_score[k] + rsum[k]
         return out
 
     def predict_leaf(self, X: np.ndarray) -> np.ndarray:
@@ -351,6 +359,10 @@ def train_booster(
     depth_cap = cfg.max_depth if cfg.max_depth > 0 else max(1, cfg.num_leaves - 1)
     depth_cap = min(depth_cap, 2 * cfg.num_leaves)
 
+    if boosting_type not in ("gbdt", "goss"):
+        raise ValueError(
+            f"boosting_type {boosting_type!r} is not supported yet "
+            "(supported: gbdt, goss)")
     use_goss = boosting_type == "goss"
     use_bagging = (not use_goss) and bagging_fraction < 1.0 and bagging_freq > 0
     metric_name = eval_metric(obj, jnp.zeros((1, K)) if K > 1 else jnp.zeros(1),

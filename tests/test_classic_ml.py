@@ -1,0 +1,217 @@
+"""Classic-ML subsystems: KNN, IsolationForest, AutoML, LIME.
+
+Modeled on the reference suites (nn/BallTreeTest + KNNTest, isolationforest,
+automl/VerifyTuneHyperparameters + VerifyFindBestModel, lime/LIMESuite).
+"""
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer
+
+from mmlspark_tpu.core.dataset import Dataset
+
+
+def _blobs(seed=0, n=200, d=4):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(0, 1, (n // 2, d)) + 4.0
+    b = rng.normal(0, 1, (n // 2, d)) - 4.0
+    X = np.concatenate([a, b]).astype(np.float32)
+    y = np.concatenate([np.ones(n // 2), np.zeros(n // 2)])
+    return X, y
+
+
+class TestKNN:
+    """reference: nn/KNN.scala:18-115, nn/BallTree.scala:32-272"""
+
+    def test_knn_exact_neighbors(self):
+        from mmlspark_tpu.nn.knn import KNN
+
+        X, _ = _blobs()
+        ds = Dataset({"features": X, "values": list(range(len(X)))})
+        model = KNN(featuresCol="features", valuesCol="values", k=3,
+                    outputCol="matches").fit(ds)
+        out = model.transform(Dataset({"features": X[:5]}))
+        for i, row in enumerate(out["matches"]):
+            assert row[0]["value"] == i  # nearest neighbor of a point is itself
+            assert row[0]["distance"] == pytest.approx(0.0, abs=1e-4)
+            assert len(row) == 3
+            # distances ascending
+            dd = [m["distance"] for m in row]
+            assert dd == sorted(dd)
+
+    def test_knn_matches_brute_force(self):
+        from mmlspark_tpu.nn.knn import KNN
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(100, 6)).astype(np.float32)
+        Q = rng.normal(size=(10, 6)).astype(np.float32)
+        model = KNN(k=4, outputCol="matches").fit(
+            Dataset({"features": X, "values": list(range(100))}))
+        out = model.transform(Dataset({"features": Q}))["matches"]
+        d2 = ((Q[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        expect = np.argsort(d2, axis=1)[:, :4]
+        for r, row in enumerate(out):
+            got = [m["value"] for m in row]
+            assert got == list(expect[r])
+
+    def test_conditional_knn_respects_labels(self):
+        from mmlspark_tpu.nn.knn import ConditionalKNN
+
+        X, y = _blobs()
+        labels = ["pos" if v > 0 else "neg" for v in y]
+        ds = Dataset({"features": X, "values": list(range(len(X))),
+                      "label": labels})
+        model = ConditionalKNN(k=3, labelCol="label",
+                               conditionerCol="conditioner").fit(ds)
+        # query near the "pos" blob but restrict to "neg" labels
+        q = Dataset({"features": X[:4],
+                     "conditioner": [["neg"]] * 4})
+        out = model.transform(q)
+        for row in out[model.get_or_default("outputCol") or "matches"]:
+            assert all(m["label"] == "neg" for m in row)
+
+    def test_ball_tree_matches_brute_force(self):
+        from mmlspark_tpu.nn.knn import BallTree
+
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(300, 5))
+        bt = BallTree(X, leaf_size=16)
+        q = rng.normal(size=5)
+        ids, dists = bt.query(q, k=5)
+        expect = np.argsort(((X - q) ** 2).sum(axis=1))[:5]
+        assert set(ids) == set(expect)
+
+
+class TestIsolationForest:
+    """reference: isolationforest/IsolationForest.scala:15-58"""
+
+    def test_outliers_score_higher(self):
+        from mmlspark_tpu.models.isolation_forest import IsolationForest
+
+        rng = np.random.default_rng(0)
+        inliers = rng.normal(0, 1, (300, 3))
+        outliers = rng.normal(0, 1, (10, 3)) * 8 + 15
+        X = np.concatenate([inliers, outliers]).astype(np.float32)
+        ds = Dataset({"features": X})
+        model = IsolationForest(numEstimators=50, maxSamples=128.0,
+                                contamination=10 / 310).fit(ds)
+        out = model.transform(ds)
+        scores = out["outlierScore"]
+        assert scores[300:].mean() > scores[:300].mean() + 0.1
+        pred = out["prediction"]
+        # most flagged rows should be true outliers
+        assert pred[300:].mean() > 0.8
+        assert pred[:300].mean() < 0.1
+
+    def test_save_load_roundtrip(self, tmp_path):
+        from mmlspark_tpu.models.isolation_forest import (IsolationForest,
+                                                          IsolationForestModel)
+
+        X = np.random.default_rng(1).normal(size=(100, 3)).astype(np.float32)
+        ds = Dataset({"features": X})
+        model = IsolationForest(numEstimators=10).fit(ds)
+        p = str(tmp_path / "iforest")
+        model.save(p)
+        loaded = IsolationForestModel.load(p)
+        np.testing.assert_allclose(loaded.transform(ds)["outlierScore"],
+                                   model.transform(ds)["outlierScore"],
+                                   rtol=1e-6)
+
+
+class TestAutoML:
+    """reference: automl/TuneHyperparameters.scala, FindBestModel.scala"""
+
+    def test_tune_hyperparameters(self):
+        from mmlspark_tpu.automl.core import (DiscreteHyperParam,
+                                              HyperparamBuilder, RandomSpace,
+                                              TuneHyperparameters)
+        from mmlspark_tpu.models.gbdt.api import LightGBMClassifier
+
+        X, y = _blobs(n=120)
+        ds = Dataset({"features": X, "label": y.astype(np.float64)})
+        space = (HyperparamBuilder()
+                 .add_hyperparam("numLeaves", DiscreteHyperParam([3, 7]))
+                 .add_hyperparam("numIterations", DiscreteHyperParam([3]))
+                 .build())
+        tuned = TuneHyperparameters(
+            models=[LightGBMClassifier(minDataInLeaf=2)],
+            evaluationMetric="accuracy", numFolds=2, numRuns=2,
+            paramSpace=RandomSpace(space, seed=0)).fit(ds)
+        assert tuned.get_or_default("bestMetric") > 0.9
+        out = tuned.transform(ds)
+        assert (out["prediction"] == y).mean() > 0.9
+
+    def test_grid_space(self):
+        from mmlspark_tpu.automl.core import (DiscreteHyperParam, GridSpace,
+                                              RangeHyperParam)
+
+        space = {"a": DiscreteHyperParam([1, 2]),
+                 "b": RangeHyperParam(0.0, 1.0)}
+        maps = list(GridSpace(space, num_range_points=3).param_maps())
+        assert len(maps) == 6
+        assert {m["a"] for m in maps} == {1, 2}
+
+    def test_find_best_model(self):
+        from mmlspark_tpu.automl.core import FindBestModel
+        from mmlspark_tpu.models.gbdt.api import LightGBMClassifier
+
+        X, y = _blobs(n=120)
+        ds = Dataset({"features": X, "label": y.astype(np.float64)})
+        fbm = FindBestModel(
+            models=[LightGBMClassifier(numIterations=1, numLeaves=2,
+                                       minDataInLeaf=2),
+                    LightGBMClassifier(numIterations=10, numLeaves=7,
+                                       minDataInLeaf=2)],
+            evaluationMetric="accuracy").fit(ds)
+        assert fbm.get_or_default("bestMetric") > 0.9
+        table = fbm.get_evaluation_results()
+        assert len(table) == 2
+
+
+class TestLIME:
+    """reference: lime/LIME.scala:28-320, Superpixel.scala:46-329"""
+
+    def test_tabular_lime_finds_informative_feature(self):
+        from mmlspark_tpu.explain.lime import TabularLIME
+        from mmlspark_tpu.models.gbdt.api import LightGBMClassifier
+
+        rng = np.random.default_rng(0)
+        n = 400
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        y = (X[:, 2] > 0).astype(np.float64)  # only feature 2 matters
+        ds = Dataset({"features": X, "label": y})
+        inner = LightGBMClassifier(numIterations=10, minDataInLeaf=5).fit(ds)
+        lime = TabularLIME(model=inner, inputCol="features",
+                           outputCol="weights", nSamples=200).fit(ds)
+        out = lime.transform(Dataset({"features": X[:3]}))
+        W = np.abs(np.asarray(out["weights"]))
+        assert (W.argmax(axis=1) == 2).all()
+
+    def test_superpixel_clustering(self):
+        from mmlspark_tpu.explain.lime import Superpixel
+
+        img = np.zeros((32, 32, 3), np.float32)
+        img[:, 16:] = 1.0
+        assign = Superpixel(cell_size=8).cluster(img)
+        assert assign.shape == (32, 32)
+        assert assign.max() >= 3  # several superpixels
+        # left and right halves should not share most clusters
+        left, right = set(assign[:, :12].ravel()), set(assign[:, 20:].ravel())
+        assert len(left & right) <= 2
+
+    def test_text_lime(self):
+        from mmlspark_tpu.core.pipeline import Transformer
+        from mmlspark_tpu.explain.lime import TextLIME
+
+        class KeywordModel(Transformer):
+            def transform(self, ds):
+                score = np.asarray(
+                    [1.0 if "good" in t else 0.0 for t in ds["text"]])
+                return ds.with_column("probability", score)
+
+        lime = TextLIME(model=KeywordModel(), inputCol="text",
+                        outputCol="weights", tokensCol="tokens", nSamples=100)
+        out = lime.transform(Dataset({"text": ["a good movie overall"]}))
+        w = out["weights"][0]
+        toks = out["tokens"][0]
+        assert toks[int(np.argmax(w))] == "good"

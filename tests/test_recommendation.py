@@ -1,0 +1,135 @@
+"""Recommendation tests: SAR + ranking evaluation.
+
+Modeled on the reference suites (recommendation/SARSpec, RankingAdapterSpec,
+RankingTrainValidationSplitSpec).
+"""
+
+import numpy as np
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.recommendation.ranking import (RankingAdapter,
+                                                 RankingEvaluator,
+                                                 RankingTrainValidationSplit)
+from mmlspark_tpu.recommendation.sar import (SAR, RecommendationIndexer,
+                                             SARModel)
+
+
+def _interactions(seed=0, n_users=30, n_items=20):
+    """Two taste clusters: users 0..14 like items 0..9, rest like 10..19."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for u in range(n_users):
+        pool = range(0, 10) if u < n_users // 2 else range(10, 20)
+        liked = rng.choice(list(pool), 6, replace=False)
+        for it in liked:
+            rows.append({"user_idx": u, "item_idx": int(it), "rating": 1.0})
+    cols = {k: np.asarray([r[k] for r in rows]) for k in rows[0]}
+    return Dataset(cols)
+
+
+class TestSAR:
+    def test_similarity_within_cluster(self):
+        ds = _interactions()
+        model = SAR(supportThreshold=1).fit(ds)
+        sim = model.itemSimilarity
+        within = sim[:10, :10][np.triu_indices(10, 1)].mean()
+        across = sim[:10, 10:].mean()
+        assert within > across + 0.05
+
+    def test_recommendations_come_from_user_cluster(self):
+        ds = _interactions()
+        model = SAR(supportThreshold=1).fit(ds)
+        recs = model.recommend_for_all_users(3)
+        rec_lists = recs["recommendations"]
+        for u in range(15):
+            assert all(int(i) < 10 for i in rec_lists[u])
+        for u in range(15, 30):
+            assert all(int(i) >= 10 for i in rec_lists[u])
+
+    def test_remove_seen(self):
+        ds = _interactions()
+        model = SAR(supportThreshold=1).fit(ds)
+        seen = model.seen
+        recs = model.recommend_for_all_users(3)
+        for u in range(30):
+            for it in recs["recommendations"][u]:
+                assert not seen[u, int(it)]
+
+    def test_similarity_functions(self):
+        ds = _interactions()
+        for fn in ("cooccurrence", "jaccard", "lift"):
+            m = SAR(similarityFunction=fn, supportThreshold=1).fit(ds)
+            assert np.isfinite(m.itemSimilarity).all()
+
+    def test_time_decay(self):
+        rows = [
+            {"user_idx": 0, "item_idx": 0, "rating": 1.0, "ts": 0.0},
+            {"user_idx": 0, "item_idx": 1, "rating": 1.0, "ts": 30 * 86400.0},
+        ]
+        ds = Dataset({k: np.asarray([r[k] for r in rows]) for k in rows[0]})
+        m = SAR(timeCol="ts", timeDecayCoeff=30, supportThreshold=1).fit(ds)
+        aff = m.userAffinity[0]
+        # the 30-day-old event decays to half the fresh one
+        assert aff[0] == np.float32(0.5) * aff[1]
+
+    def test_indexer_roundtrip(self):
+        ds = Dataset({"user": ["alice", "bob", "alice"],
+                      "item": ["x", "y", "y"]})
+        idx = RecommendationIndexer().fit(ds)
+        out = idx.transform(ds)
+        assert out["user_idx"].tolist() == [0, 1, 0]
+        assert idx.recover_user(0) == "alice"
+        assert idx.recover_item(1) == "y"
+
+    def test_sar_model_roundtrip(self, tmp_path):
+        ds = _interactions()
+        model = SAR(supportThreshold=1).fit(ds)
+        p = str(tmp_path / "sar")
+        model.save(p)
+        loaded = SARModel.load(p)
+        np.testing.assert_allclose(loaded.itemSimilarity, model.itemSimilarity)
+
+
+class TestRankingEvaluator:
+    def test_ndcg_perfect_and_zero(self):
+        ds = Dataset({"recommendations": [[1, 2, 3], [7, 8, 9]],
+                      "labels": [[1, 2, 3], [1, 2, 3]]})
+        ev = RankingEvaluator(metricName="ndcgAt", k=3)
+        scores = [ev.copy().evaluate(ds.take(np.asarray([i]))) for i in (0, 1)]
+        assert scores[0] == 1.0
+        assert scores[1] == 0.0
+
+    def test_precision_recall_map(self):
+        ds = Dataset({"recommendations": [[1, 2, 3, 4]],
+                      "labels": [[1, 3]]})
+        assert RankingEvaluator(metricName="precisionAtk", k=4).evaluate(ds) == 0.5
+        assert RankingEvaluator(metricName="recallAtK", k=4).evaluate(ds) == 1.0
+        # map: hits at ranks 1 and 3 -> (1/1 + 2/3)/2
+        m = RankingEvaluator(metricName="map", k=4).evaluate(ds)
+        assert abs(m - (1.0 + 2 / 3) / 2) < 1e-9
+
+
+class TestRankingPipeline:
+    def test_adapter_plus_evaluator(self):
+        ds = _interactions()
+        split = RankingTrainValidationSplit(
+            estimator=SAR(supportThreshold=1), trainRatio=0.7, seed=1)
+        train, valid = split.split(ds)
+        # fit on the train half only: recommendations then exclude train-seen
+        # items but can (and should) surface the held-out validation items
+        adapter_model = RankingAdapter(
+            recommender=SAR(supportThreshold=1), k=5).fit(train)
+        evald = adapter_model.transform(valid)
+        ndcg = RankingEvaluator(metricName="ndcgAt", k=5).evaluate(evald)
+        # recommendations stay in-cluster, so held-out in-cluster items rank ok
+        assert ndcg > 0.1
+
+    def test_per_user_split(self):
+        ds = _interactions()
+        split = RankingTrainValidationSplit(
+            estimator=SAR(supportThreshold=1), trainRatio=0.5, seed=0)
+        train, valid = split.split(ds)
+        users_train = set(train["user_idx"].tolist())
+        users_valid = set(valid["user_idx"].tolist())
+        # every user appears on both sides (stratified)
+        assert users_train == users_valid == set(range(30))
