@@ -1,0 +1,26 @@
+"""Cognitive service transformers (reference: cognitive/ — SURVEY.md §2c).
+
+All services compose the io.http machinery; see base.CognitiveServicesBase.
+"""
+
+from .base import (CognitiveServicesBase, PollingCognitiveService,
+                   ServiceParam)
+from .services import (OCR, NER, AnalyzeImage, AzureSearchWriter,
+                       BingImageSearch, DescribeImage, DetectAnomalies,
+                       DetectFace, DetectLastAnomaly, EntityDetector,
+                       FindSimilarFace, GenerateThumbnails, GroupFaces,
+                       IdentifyFaces, KeyPhraseExtractor, LanguageDetector,
+                       RecognizeDomainSpecificContent, RecognizeText,
+                       SimpleDetectAnomalies, SpeechToText, TagImage,
+                       TextSentiment, VerifyFaces)
+
+__all__ = [
+    "AnalyzeImage", "AzureSearchWriter", "BingImageSearch",
+    "CognitiveServicesBase", "DescribeImage", "DetectAnomalies", "DetectFace",
+    "DetectLastAnomaly", "EntityDetector", "FindSimilarFace",
+    "GenerateThumbnails", "GroupFaces", "IdentifyFaces", "KeyPhraseExtractor",
+    "LanguageDetector", "NER", "OCR", "PollingCognitiveService",
+    "RecognizeDomainSpecificContent", "RecognizeText", "ServiceParam",
+    "SimpleDetectAnomalies", "SpeechToText", "TagImage", "TextSentiment",
+    "VerifyFaces",
+]

@@ -1,0 +1,314 @@
+"""Image pipeline stages: decode, resize, crop, color, flip, blur, threshold.
+
+TPU-native re-design of the reference's OpenCV layer (reference:
+opencv/ImageTransformer.scala:26-395 — stage classes ResizeImage, CenterCrop,
+ColorFormat, Flip, Blur, Threshold, GaussianKernel — and
+image/UnrollImage.scala:24-223, ResizeImageTransformer.scala:21-58,
+ImageSetAugmenter.scala:15-17). The JNI cv::Mat pipeline becomes batched
+device array math: decode happens on host (PIL/stdlib), everything after is
+vectorised numpy/jax on (N, H, W, C) float32 stacks — XLA fuses the chain of
+elementwise stages into the downstream matmuls.
+
+An "image column" is either a list of HxWxC uint8/float arrays (ragged sizes)
+or one stacked (N, H, W, C) array once sizes agree (post-resize).
+"""
+
+from __future__ import annotations
+
+import io as _io
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.dataset import Dataset
+from ..core.params import (HasInputCol, HasOutputCol, Param, TypeConverters)
+from ..core.pipeline import Transformer
+
+# ---------------------------------------------------------------------------
+# Decode (host side; reference decodes via ImageSchema/ImageInjections)
+# ---------------------------------------------------------------------------
+
+
+def decode_image(data: bytes) -> Optional[np.ndarray]:
+    """bytes -> HxWxC uint8 RGB array, or None if undecodable (the reference
+    emits null rows for bad images)."""
+    try:
+        from PIL import Image
+        img = Image.open(_io.BytesIO(data))
+        return np.asarray(img.convert("RGB"), dtype=np.uint8)
+    except Exception:
+        return None
+
+
+class DecodeImage(Transformer, HasInputCol, HasOutputCol):
+    """bytes column -> image arrays (io/image/ImageUtils.scala:26)."""
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        in_col = self.get_or_default("inputCol")
+        out_col = self.get_or_default("outputCol") or "image"
+        return dataset.with_column(
+            out_col, [decode_image(b) for b in dataset[in_col]])
+
+
+# ---------------------------------------------------------------------------
+# Stage functions (pure, act on one HxWxC float array or a stacked batch)
+# ---------------------------------------------------------------------------
+
+
+def _as_float(img: np.ndarray) -> np.ndarray:
+    return img.astype(np.float32) if img.dtype != np.float32 else img
+
+
+def resize_image(img: np.ndarray, height: int, width: int) -> np.ndarray:
+    """Bilinear resize on device via jax.image (replaces cv::resize).
+    Accepts one HxWxC image or a stacked NxHxWxC batch."""
+    import jax
+    shape = ((img.shape[0], height, width, img.shape[-1]) if img.ndim == 4
+             else (height, width, img.shape[-1]))
+    return np.asarray(jax.image.resize(_as_float(img), shape,
+                                       method="bilinear"))
+
+
+def center_crop(img: np.ndarray, height: int, width: int) -> np.ndarray:
+    h, w = img.shape[:2]
+    top = max(0, (h - height) // 2)
+    left = max(0, (w - width) // 2)
+    return img[top:top + height, left:left + width]
+
+
+def crop_image(img: np.ndarray, x: int, y: int, height: int, width: int
+               ) -> np.ndarray:
+    return img[y:y + height, x:x + width]
+
+
+def to_grayscale(img: np.ndarray) -> np.ndarray:
+    """ITU-R 601 luma (cv::cvtColor COLOR_RGB2GRAY coefficients)."""
+    f = _as_float(img)
+    gray = f[..., 0] * 0.299 + f[..., 1] * 0.587 + f[..., 2] * 0.114
+    return gray[..., None]
+
+def flip_image(img: np.ndarray, flip_code: int = 1) -> np.ndarray:
+    """cv::flip semantics: 1 = horizontal, 0 = vertical, -1 = both."""
+    if flip_code == 1:
+        return img[:, ::-1]
+    if flip_code == 0:
+        return img[::-1]
+    return img[::-1, ::-1]
+
+
+def gaussian_kernel(ksize: int, sigma: float) -> np.ndarray:
+    """cv::getGaussianKernel parity (opencv/ImageTransformer GaussianKernel)."""
+    if sigma <= 0:
+        sigma = 0.3 * ((ksize - 1) * 0.5 - 1) + 0.8
+    x = np.arange(ksize, dtype=np.float64) - (ksize - 1) / 2.0
+    k = np.exp(-(x ** 2) / (2 * sigma ** 2))
+    return (k / k.sum()).astype(np.float32)
+
+
+def blur_image(img: np.ndarray, ksize: int = 3, sigma: float = 0.0
+               ) -> np.ndarray:
+    """Separable gaussian blur as two 1-D convolutions (MXU-friendly: XLA
+    lowers conv to the systolic array; replaces cv::GaussianBlur)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    k = jnp.asarray(gaussian_kernel(ksize, sigma))
+    f = jnp.asarray(_as_float(img))
+    squeeze = False
+    if f.ndim == 3:
+        f = f[None]
+        squeeze = True
+    x = jnp.moveaxis(f, -1, 1)  # NCHW
+    pad = (ksize - 1) // 2
+    c = x.shape[1]
+    kh = jnp.tile(k.reshape(1, 1, ksize, 1), (c, 1, 1, 1))
+    kw = jnp.tile(k.reshape(1, 1, 1, ksize), (c, 1, 1, 1))
+    # reflect borders (cv::BORDER_REFLECT_101 default), then VALID convs
+    x = jnp.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)), mode="reflect")
+    dn = lax.conv_dimension_numbers(x.shape, kh.shape, ("NCHW", "OIHW", "NCHW"))
+    x = lax.conv_general_dilated(x, kh, (1, 1), [(0, 0), (0, 0)],
+                                 dimension_numbers=dn, feature_group_count=c)
+    x = lax.conv_general_dilated(x, kw, (1, 1), [(0, 0), (0, 0)],
+                                 dimension_numbers=dn, feature_group_count=c)
+    out = jnp.moveaxis(x, 1, -1)
+    return np.asarray(out[0] if squeeze else out)
+
+
+def threshold_image(img: np.ndarray, threshold: float, max_val: float = 255.0,
+                    method: str = "binary") -> np.ndarray:
+    """cv::threshold subset: binary / binary_inv / trunc / tozero."""
+    f = _as_float(img)
+    if method == "binary":
+        return np.where(f > threshold, max_val, 0.0).astype(np.float32)
+    if method == "binary_inv":
+        return np.where(f > threshold, 0.0, max_val).astype(np.float32)
+    if method == "trunc":
+        return np.minimum(f, threshold).astype(np.float32)
+    if method == "tozero":
+        return np.where(f > threshold, f, 0.0).astype(np.float32)
+    raise ValueError(f"unknown threshold method {method!r}")
+
+
+def normalize_image(img: np.ndarray, mean: Sequence[float],
+                    std: Sequence[float], scale: float = 1.0) -> np.ndarray:
+    f = _as_float(img) * scale
+    return ((f - np.asarray(mean, np.float32))
+            / np.asarray(std, np.float32)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# ImageTransformer: composable stage list (opencv/ImageTransformer.scala:26-395)
+# ---------------------------------------------------------------------------
+
+_STAGE_FNS: Dict[str, Callable] = {
+    "resize": lambda img, p: resize_image(img, p["height"], p["width"]),
+    "centerCrop": lambda img, p: center_crop(img, p["height"], p["width"]),
+    "crop": lambda img, p: crop_image(img, p["x"], p["y"], p["height"], p["width"]),
+    "colorFormat": lambda img, p: (to_grayscale(img) if p.get("format") == "gray"
+                                   else _as_float(img)),
+    "flip": lambda img, p: flip_image(img, p.get("flipCode", 1)),
+    "blur": lambda img, p: blur_image(img, int(p.get("ksize", 3)),
+                                      float(p.get("sigma", 0.0))),
+    "threshold": lambda img, p: threshold_image(
+        img, p["threshold"], p.get("maxVal", 255.0), p.get("method", "binary")),
+    "normalize": lambda img, p: normalize_image(
+        img, p.get("mean", (0, 0, 0)), p.get("std", (1, 1, 1)),
+        p.get("scale", 1.0)),
+}
+
+
+class ImageTransformer(Transformer, HasInputCol, HasOutputCol):
+    """Chain of image ops declared as (name, params) stages; fluent builders
+    mirror the reference's ImageTransformer.resize(...).crop(...) API."""
+
+    stages = Param("stages", "list of (op, params) stages", None)
+
+    def _stages(self) -> List[Tuple[str, dict]]:
+        return list(self.get_or_default("stages") or [])
+
+    def _add(self, op: str, **params) -> "ImageTransformer":
+        return self.set(stages=self._stages() + [(op, params)])
+
+    def resize(self, height: int, width: int):
+        return self._add("resize", height=height, width=width)
+
+    def center_crop(self, height: int, width: int):
+        return self._add("centerCrop", height=height, width=width)
+
+    def crop(self, x: int, y: int, height: int, width: int):
+        return self._add("crop", x=x, y=y, height=height, width=width)
+
+    def color_format(self, fmt: str):
+        return self._add("colorFormat", format=fmt)
+
+    def flip(self, flip_code: int = 1):
+        return self._add("flip", flipCode=flip_code)
+
+    def gaussian_blur(self, ksize: int = 3, sigma: float = 0.0):
+        return self._add("blur", ksize=ksize, sigma=sigma)
+
+    def threshold(self, threshold: float, max_val: float = 255.0,
+                  method: str = "binary"):
+        return self._add("threshold", threshold=threshold, maxVal=max_val,
+                         method=method)
+
+    def normalize(self, mean, std, scale: float = 1.0):
+        return self._add("normalize", mean=list(mean), std=list(std),
+                         scale=scale)
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        in_col = self.get_or_default("inputCol")
+        out_col = self.get_or_default("outputCol") or in_col
+        stages = self._stages()
+
+        def apply(img):
+            if img is None:
+                return None
+            for op, params in stages:
+                img = _STAGE_FNS[op](img, params)
+            return img
+
+        col = dataset[in_col]
+        if isinstance(col, np.ndarray) and col.ndim == 4:
+            # stacked batch: run every stage vectorised over N at once
+            out = apply(col)
+        else:
+            out = [apply(img) for img in col]
+            if out and all(o is not None for o in out):
+                shapes = {o.shape for o in out}
+                if len(shapes) == 1:
+                    out = np.stack(out)
+        return dataset.with_column(out_col, out)
+
+
+class ResizeImageTransformer(Transformer, HasInputCol, HasOutputCol):
+    """Standalone resize (image/ResizeImageTransformer.scala:21-58)."""
+
+    height = Param("height", "target height", None, TypeConverters.to_int)
+    width = Param("width", "target width", None, TypeConverters.to_int)
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        return (ImageTransformer()
+                .set(inputCol=self.get_or_default("inputCol"),
+                     outputCol=self.get_or_default("outputCol"))
+                .resize(self.get_or_default("height"),
+                        self.get_or_default("width"))
+                .transform(dataset))
+
+
+class UnrollImage(Transformer, HasInputCol, HasOutputCol):
+    """Image -> flat float vector (image/UnrollImage.scala:24-223). The
+    reference unrolls to CNTK's CHW plane order; we keep that convention so
+    featurizer vectors are comparable."""
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        in_col = self.get_or_default("inputCol")
+        out_col = self.get_or_default("outputCol") or "unrolled"
+        col = dataset[in_col]
+
+        def unroll(img):
+            if img is None:
+                return None
+            f = _as_float(img)
+            return np.moveaxis(f, -1, 0).reshape(-1)  # HWC -> CHW -> flat
+
+        if isinstance(col, np.ndarray) and col.ndim == 4:
+            out = np.moveaxis(_as_float(col), -1, 1).reshape(col.shape[0], -1)
+        else:
+            out = [unroll(img) for img in col]
+            if out and all(o is not None for o in out):
+                lens = {len(o) for o in out}
+                if len(lens) == 1:
+                    out = np.stack(out)
+        return dataset.with_column(out_col, out)
+
+
+class ImageSetAugmenter(Transformer, HasInputCol, HasOutputCol):
+    """Dataset augmentation by flips (image/ImageSetAugmenter.scala:15-17):
+    emits the original rows plus flipped copies."""
+
+    flipLeftRight = Param("flipLeftRight", "add horizontal flips", True,
+                          TypeConverters.to_bool)
+    flipUpDown = Param("flipUpDown", "add vertical flips", False,
+                       TypeConverters.to_bool)
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        in_col = self.get_or_default("inputCol")
+        out_col = self.get_or_default("outputCol") or in_col
+        base = dataset.with_column(out_col, dataset[in_col])
+        out = base
+        if self.get_or_default("flipLeftRight"):
+            flipped = base.with_column(
+                out_col, _flip_col(base[out_col], 1))
+            out = out.union(flipped)
+        if self.get_or_default("flipUpDown"):
+            flipped = base.with_column(
+                out_col, _flip_col(base[out_col], 0))
+            out = out.union(flipped)
+        return out
+
+
+def _flip_col(col, code):
+    if isinstance(col, np.ndarray) and col.ndim == 4:  # (N, H, W, C) batch
+        return col[:, :, ::-1] if code == 1 else col[:, ::-1]
+    return [None if img is None else flip_image(img, code) for img in col]

@@ -191,16 +191,22 @@ class AsyncHTTPClient:
 
     def send(self, requests: Sequence[Optional[HTTPRequestData]]
              ) -> List[Optional[HTTPResponseData]]:
-        with ThreadPoolExecutor(max_workers=self.concurrency) as pool:
+        pool = ThreadPoolExecutor(max_workers=self.concurrency)
+        try:
             futures = [None if r is None else pool.submit(self.handler, r)
                        for r in requests]
+            # one deadline for the whole exchange, not per-future
+            deadline = (None if self.concurrent_timeout is None
+                        else time.monotonic() + self.concurrent_timeout)
             out: List[Optional[HTTPResponseData]] = []
             for f in futures:
                 if f is None:
                     out.append(None)
                     continue
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - time.monotonic()))
                 try:
-                    out.append(f.result(timeout=self.concurrent_timeout))
+                    out.append(f.result(timeout=remaining))
                 except FuturesTimeoutError:
                     # Failures are data, not exceptions (matching send_request):
                     # a timed-out slot becomes a status-0 row, completed
@@ -208,6 +214,9 @@ class AsyncHTTPClient:
                     f.cancel()
                     out.append(HTTPResponseData(
                         status_code=0, reason="concurrentTimeout exceeded"))
+        finally:
+            # don't block on hung handlers past the deadline
+            pool.shutdown(wait=False, cancel_futures=True)
         return out
 
 
@@ -447,22 +456,20 @@ class PartitionConsolidator(Transformer, HasInputCol, HasOutputCol):
     """Funnel many shards' rows through one shared rate-limited service holder.
 
     In the columnar runtime "partitions" are row-shards of one host array, so
-    consolidation = processing the whole column through one holder serially
-    (one consumer per host). The holder is per-instance: the reference's
-    per-executor sharing keyed holders by stage uid too
-    (PartitionConsolidator.scala:19 uses a SharedSingleton per stage).
+    consolidation is inherent: the whole column already flows through this one
+    stage instance serially (one consumer per host), which is all the
+    reference's per-executor SharedSingleton machinery existed to guarantee.
     """
 
     def __init__(self, fn: Callable[[Any], Any] = None, **kwargs):
         super().__init__(**kwargs)
         self.fn = fn or (lambda v: v)
-        self._holder = SharedVariable(lambda: self.fn)
 
     def transform(self, dataset: Dataset) -> Dataset:
         in_col = self.get_or_default("inputCol")
         out_col = self.get_or_default("outputCol") or in_col
-        f = self._holder.get()
-        return dataset.with_column(out_col, [f(v) for v in dataset[in_col]])
+        return dataset.with_column(
+            out_col, [self.fn(v) for v in dataset[in_col]])
 
 
 def to_jsonable(v: Any) -> Any:

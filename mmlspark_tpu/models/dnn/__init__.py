@@ -1,0 +1,17 @@
+"""DNN scoring + training path (reference: cntk/ + image featurization).
+
+``transformer``: the flagship SPMD transformer (train + forward) with ring
+attention; ``cnn``: pure-JAX convnets for featurization; ``scoring``:
+DNNModel/ImageFeaturizer pipeline stages (CNTKModel parity); ``downloader``:
+pretrained-model repository.
+"""
+
+from .cnn import CNNConfig, apply_cnn, feature_dim, init_cnn_params
+from .downloader import ModelDownloader, ModelSchema, retry_with_timeout
+from .scoring import DNNModel, ImageFeaturizer
+
+__all__ = [
+    "CNNConfig", "DNNModel", "ImageFeaturizer", "ModelDownloader",
+    "ModelSchema", "apply_cnn", "feature_dim", "init_cnn_params",
+    "retry_with_timeout",
+]

@@ -1,0 +1,120 @@
+"""Pure-JAX convolutional networks for the image featurization path.
+
+The reference scores pretrained CNTK CNNs (AlexNet/ResNet-50, fetched by
+ModelDownloader — reference: cntk/CNTKModel.scala:30-532,
+downloader/ModelDownloader.scala:37-276). Here the model format is a JAX
+param pytree + a functional ``apply``; "model surgery" (pick an intermediate
+output node, ImageFeaturizer's layer cutting, image/ImageFeaturizer.scala:
+96-141) is a ``capture`` argument instead of graph editing: apply returns
+(logits, {node_name: activation}).
+
+Convs are NHWC bfloat16-friendly and lower straight onto the MXU; batch-norm
+is folded into inference scale/shift (no training here — this is the scoring
+path, like CNTK eval).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@dataclass(frozen=True)
+class CNNConfig:
+    """ResNet-v1-style config. stage_sizes=[2,2,2,2] ~ ResNet-18 shape."""
+
+    num_classes: int = 1000
+    stage_sizes: Tuple[int, ...] = (2, 2, 2, 2)
+    width: int = 64
+    input_hw: Tuple[int, int] = (224, 224)
+    dtype: Any = jnp.float32
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    w = jax.random.normal(key, (kh, kw, cin, cout)) * np.sqrt(2.0 / fan_in)
+    return w.astype(jnp.float32)
+
+
+def init_cnn_params(cfg: CNNConfig, key) -> Dict[str, Any]:
+    keys = iter(jax.random.split(key, 4 + 2 * sum(cfg.stage_sizes) * 2 + 2))
+    params: Dict[str, Any] = {
+        "stem": {"w": _conv_init(next(keys), 7, 7, 3, cfg.width),
+                 "scale": jnp.ones((cfg.width,)),
+                 "bias": jnp.zeros((cfg.width,))}}
+    cin = cfg.width
+    for s, n_blocks in enumerate(cfg.stage_sizes):
+        cout = cfg.width * (2 ** s)
+        for b in range(n_blocks):
+            blk = {
+                "conv1": {"w": _conv_init(next(keys), 3, 3, cin, cout),
+                          "scale": jnp.ones((cout,)), "bias": jnp.zeros((cout,))},
+                "conv2": {"w": _conv_init(next(keys), 3, 3, cout, cout),
+                          "scale": jnp.ones((cout,)), "bias": jnp.zeros((cout,))},
+            }
+            if cin != cout:
+                blk["proj"] = {"w": _conv_init(next(keys), 1, 1, cin, cout)}
+            params[f"stage{s}_block{b}"] = blk
+            cin = cout
+    params["head"] = {
+        "w": jax.random.normal(next(keys), (cin, cfg.num_classes))
+        * np.sqrt(1.0 / cin),
+        "b": jnp.zeros((cfg.num_classes,))}
+    return params
+
+
+def _conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _bn_relu(x, p):
+    return jax.nn.relu(x * p["scale"] + p["bias"])
+
+
+def apply_cnn(params: Dict[str, Any], x: jnp.ndarray, cfg: CNNConfig,
+              capture: Sequence[str] = ()) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Forward pass. ``x``: (N, H, W, 3) float in [0,1] or normalized.
+    ``capture`` names intermediate nodes to return: 'stem', 'stageS_blockB',
+    'pool' (global avg pool — the standard featurization layer), 'logits'.
+    """
+    acts: Dict[str, jnp.ndarray] = {}
+    x = x.astype(cfg.dtype)
+    stem = params["stem"]
+    x = _bn_relu(_conv(x, stem["w"], stride=2), stem)
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                          "SAME")
+    if "stem" in capture:
+        acts["stem"] = x
+    for s, n_blocks in enumerate(cfg.stage_sizes):
+        for b in range(n_blocks):
+            name = f"stage{s}_block{b}"
+            blk = params[name]
+            stride = 2 if (b == 0 and s > 0) else 1
+            h = _bn_relu(_conv(x, blk["conv1"]["w"], stride), blk["conv1"])
+            h = _conv(h, blk["conv2"]["w"]) * blk["conv2"]["scale"] + blk["conv2"]["bias"]
+            shortcut = x
+            if "proj" in blk:
+                shortcut = _conv(x, blk["proj"]["w"], stride)
+            elif stride != 1:
+                shortcut = x[:, ::stride, ::stride]
+            x = jax.nn.relu(h + shortcut)
+            if name in capture:
+                acts[name] = x
+    pooled = jnp.mean(x, axis=(1, 2))
+    if "pool" in capture:
+        acts["pool"] = pooled
+    logits = pooled @ params["head"]["w"] + params["head"]["b"]
+    if "logits" in capture:
+        acts["logits"] = logits
+    return logits, acts
+
+
+def feature_dim(cfg: CNNConfig) -> int:
+    return cfg.width * (2 ** (len(cfg.stage_sizes) - 1))

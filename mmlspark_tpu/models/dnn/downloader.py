@@ -1,0 +1,177 @@
+"""ModelDownloader: pretrained-model repository with hash check + retries.
+
+Parity: downloader/ModelDownloader.scala:37-276 (fetch CNTK models from the
+Azure blob repo with sha-hash verification and FaultToleranceUtils
+retry-with-timeout, downloader/Schema.scala:30 ``ModelSchema`` with
+layerNames). The TPU model format is a pickled JAX param pytree + CNNConfig;
+sources are ``file://`` paths or HTTP URLs (fetched through the io.http retry
+client), plus a *builtin* registry of deterministically-initialised
+architectures so the framework is usable with zero egress — materialising a
+builtin is the "download" and lands in the same local repository with the
+same hash bookkeeping.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class ModelSchema:
+    """downloader/Schema.scala:30 parity."""
+
+    name: str
+    dataset: str = ""
+    modelType: str = "image"
+    uri: str = ""
+    sha256: str = ""
+    inputDims: List[int] = field(default_factory=lambda: [224, 224, 3])
+    numLayers: int = 0
+    layerNames: List[str] = field(default_factory=list)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), sort_keys=True)
+
+
+def retry_with_timeout(fn, retries: int = 3, backoff: float = 0.5):
+    """FaultToleranceUtils.retryWithTimeout parity
+    (downloader/ModelDownloader.scala:37-53)."""
+    last = None
+    for attempt in range(retries):
+        try:
+            return fn()
+        except Exception as e:
+            last = e
+            if attempt < retries - 1:
+                time.sleep(backoff * (2 ** attempt))
+    raise last
+
+
+_BUILTIN = {
+    # name -> (stage_sizes, width, num_classes, input_hw)
+    "ResNet18Tiny": ((2, 2, 2, 2), 16, 1000, (224, 224)),
+    "ResNet10Micro": ((1, 1, 1, 1), 8, 1000, (64, 64)),
+    "ConvNetMNIST": ((1, 1), 8, 10, (28, 28)),
+}
+
+
+class ModelDownloader:
+    """Local model repository (``repo_dir``) + remote/builtin sources."""
+
+    def __init__(self, repo_dir: str):
+        self.repo_dir = repo_dir
+        os.makedirs(repo_dir, exist_ok=True)
+
+    # -- listing ------------------------------------------------------------
+    def local_models(self) -> List[ModelSchema]:
+        out = []
+        for name in sorted(os.listdir(self.repo_dir)):
+            meta = os.path.join(self.repo_dir, name, "schema.json")
+            if os.path.exists(meta):
+                with open(meta) as f:
+                    out.append(ModelSchema(**json.load(f)))
+        return out
+
+    def remote_models(self) -> List[ModelSchema]:
+        """The builtin catalog (the Azure-blob listing analog)."""
+        return [ModelSchema(name=n, modelType="image",
+                            uri=f"builtin://{n}",
+                            inputDims=[*_BUILTIN[n][3], 3],
+                            numLayers=2 * sum(_BUILTIN[n][0]) + 2,
+                            layerNames=["stem"]
+                            + [f"stage{s}_block{b}"
+                               for s, nb in enumerate(_BUILTIN[n][0])
+                               for b in range(nb)] + ["pool", "logits"])
+                for n in _BUILTIN]
+
+    # -- fetching -----------------------------------------------------------
+    def download_model(self, schema_or_name) -> ModelSchema:
+        schema = (self._builtin_schema(schema_or_name)
+                  if isinstance(schema_or_name, str) else schema_or_name)
+        target = os.path.join(self.repo_dir, schema.name)
+        payload = os.path.join(target, "model.pkl")
+        if os.path.exists(payload) and self._hash_ok(payload, schema.sha256):
+            return self._read_schema(schema.name)
+        os.makedirs(target, exist_ok=True)
+        data = retry_with_timeout(lambda: self._fetch(schema))
+        digest = hashlib.sha256(data).hexdigest()
+        if schema.sha256 and digest != schema.sha256:
+            raise IOError(f"hash mismatch for {schema.name}: "
+                          f"{digest} != {schema.sha256}")
+        with open(payload, "wb") as f:
+            f.write(data)
+        schema.sha256 = digest
+        with open(os.path.join(target, "schema.json"), "w") as f:
+            f.write(schema.to_json())
+        return schema
+
+    def load_model(self, name: str):
+        """-> (params, cfg, apply_fn) ready for DNNModel."""
+        from .cnn import CNNConfig, apply_cnn
+
+        payload = os.path.join(self.repo_dir, name, "model.pkl")
+        if not os.path.exists(payload):
+            self.download_model(name)
+        with open(payload, "rb") as f:
+            d = pickle.load(f)
+        cfg = CNNConfig(**d["config"])
+        apply_fn = lambda p, x, capture=(): apply_cnn(p, x, cfg, capture)  # noqa: E731
+        return d["params"], cfg, apply_fn
+
+    # -- internals ----------------------------------------------------------
+    def _builtin_schema(self, name: str) -> ModelSchema:
+        for s in self.remote_models():
+            if s.name == name:
+                return s
+        raise KeyError(f"unknown model {name!r}; "
+                       f"builtins: {sorted(_BUILTIN)}")
+
+    def _read_schema(self, name: str) -> ModelSchema:
+        with open(os.path.join(self.repo_dir, name, "schema.json")) as f:
+            return ModelSchema(**json.load(f))
+
+    def _hash_ok(self, path: str, expected: str) -> bool:
+        if not expected:
+            return True
+        with open(path, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest() == expected
+
+    def _fetch(self, schema: ModelSchema) -> bytes:
+        uri = schema.uri
+        if uri.startswith("builtin://"):
+            return self._materialize_builtin(uri[len("builtin://"):])
+        if uri.startswith("file://"):
+            with open(uri[len("file://"):], "rb") as f:
+                return f.read()
+        if uri.startswith("http://") or uri.startswith("https://"):
+            from ...io.http import HTTPRequestData, advanced_handling
+            resp = advanced_handling(HTTPRequestData(url=uri), timeout=120.0)
+            if not (200 <= resp.status_code < 300):
+                raise IOError(f"fetch failed: {resp.status_code} {resp.reason}")
+            return resp.entity or b""
+        raise ValueError(f"unsupported model uri {uri!r}")
+
+    def _materialize_builtin(self, name: str) -> bytes:
+        import jax
+
+        from .cnn import CNNConfig, init_cnn_params
+
+        stage_sizes, width, num_classes, hw = _BUILTIN[name]
+        cfg = CNNConfig(num_classes=num_classes, stage_sizes=stage_sizes,
+                        width=width, input_hw=hw)
+        params = init_cnn_params(cfg, jax.random.PRNGKey(
+            int(hashlib.sha256(name.encode()).hexdigest()[:8], 16)))
+        params = jax.tree_util.tree_map(np.asarray, params)
+        return pickle.dumps({
+            "params": params,
+            "config": {"num_classes": cfg.num_classes,
+                       "stage_sizes": cfg.stage_sizes, "width": cfg.width,
+                       "input_hw": cfg.input_hw}})

@@ -1,0 +1,285 @@
+"""Cognitive services tests against a local mock of the service endpoints.
+
+The reference's cognitive suites hit live Azure endpoints keyed by env vars
+(cognitive/split1 — e.g. TextAnalyticsSuite); here a stdlib HTTP server mocks
+the same wire contracts so the transformer composition (ServiceParam
+resolution, request building, polling, group batching, error column) is
+exercised hermetically.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.cognitive import (OCR, AnalyzeImage, AzureSearchWriter,
+                                    BingImageSearch, DetectFace,
+                                    LanguageDetector, RecognizeText,
+                                    SimpleDetectAnomalies, SpeechToText,
+                                    TextSentiment, VerifyFaces)
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.core.pipeline import load_stage, save_stage
+
+
+class _Mock(BaseHTTPRequestHandler):
+    ops = {}       # operation id -> polls remaining
+    indexes = set()
+    uploaded = []
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    def _send(self, code, obj=None, headers=None):
+        payload = json.dumps(obj).encode() if obj is not None else b""
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_POST(self):
+        body = self._body()
+        key = self.headers.get("Ocp-Apim-Subscription-Key")
+        path = self.path
+        if path.startswith("/vision/ocr"):
+            if key != "secret":
+                self._send(401, {"error": "bad key"})
+                return
+            payload = json.loads(body) if body.startswith(b"{") else {}
+            self._send(200, {"language": "en",
+                             "regions": [{"text": "HELLO"}],
+                             "echoUrl": payload.get("url"),
+                             "rawBytes": not body.startswith(b"{")})
+        elif path.startswith("/vision/recognizeText"):
+            op = f"op{len(self.ops)}"
+            self.ops[op] = 2  # two "running" polls before success
+            host = self.headers.get("Host")
+            self._send(202, None,
+                       {"Operation-Location": f"http://{host}/vision/op/{op}"})
+        elif path.startswith("/vision/analyze"):
+            q = path.split("?", 1)[1] if "?" in path else ""
+            self._send(200, {"query": q})
+        elif path.startswith("/text/sentiment"):
+            docs = json.loads(body)["documents"]
+            self._send(200, {"documents": [
+                {"id": d["id"], "score": 0.9 if "good" in d["text"] else 0.1}
+                for d in docs]})
+        elif path.startswith("/text/languages"):
+            docs = json.loads(body)["documents"]
+            self._send(200, {"documents": [
+                {"id": d["id"],
+                 "detectedLanguages": [{"iso6391Name": "en", "score": 1.0}]}
+                for d in docs]})
+        elif path.startswith("/face/detect"):
+            self._send(200, [{"faceId": "f1",
+                              "faceRectangle": {"top": 1, "left": 2}}])
+        elif path.startswith("/face/verify"):
+            b = json.loads(body)
+            same = b.get("faceId1") == b.get("faceId2")
+            self._send(200, {"isIdentical": same,
+                             "confidence": 1.0 if same else 0.1})
+        elif path.startswith("/speech"):
+            self._send(200, {"DisplayText": f"{len(body)} bytes heard"})
+        elif path.startswith("/anomaly/entire"):
+            series = json.loads(body)["series"]
+            n = len(series)
+            vals = [p["value"] for p in series]
+            med = sorted(vals)[n // 2]
+            self._send(200, {
+                "isAnomaly": [abs(v - med) > 50 for v in vals],
+                "expectedValues": [med] * n,
+                "upperMargins": [5.0] * n,
+                "lowerMargins": [5.0] * n})
+        elif path.startswith("/search/indexes") and path.count("/") == 2:
+            self.indexes.add(json.loads(body)["name"])
+            self._send(201, {"ok": True})
+        elif "/docs/index" in path:
+            docs = json.loads(body)["value"]
+            self.uploaded.extend(docs)
+            self._send(200, {"value": [{"status": True} for _ in docs]})
+        else:
+            self._send(404, {"error": path})
+
+    def do_GET(self):
+        path = self.path
+        if path.startswith("/vision/op/"):
+            op = path.rsplit("/", 1)[1]
+            if self.ops.get(op, 0) > 0:
+                self.ops[op] -= 1
+                self._send(200, {"status": "Running"})
+            else:
+                self._send(200, {"status": "Succeeded",
+                                 "recognitionResult": {"lines": ["done"]}})
+        elif path.startswith("/bing/images"):
+            q = path.split("q=", 1)[1].split("&")[0] if "q=" in path else ""
+            self._send(200, {"value": [
+                {"contentUrl": f"http://img/{q}/1"},
+                {"contentUrl": f"http://img/{q}/2"}]})
+        elif path.startswith("/search/indexes/"):
+            name = path.split("/indexes/", 1)[1].split("?")[0]
+            self._send(200 if name in self.indexes else 404, {})
+        else:
+            self._send(404, {"error": path})
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture(scope="module")
+def base():
+    httpd = ThreadingHTTPServer(("localhost", 0), _Mock)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    host, port = httpd.server_address[:2]
+    yield f"http://{host}:{port}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_ocr_url_and_bytes(base):
+    ds = Dataset({"url": ["http://x/1.png", "http://x/2.png"]})
+    t = (OCR().set_subscription_key("secret").set_url(f"{base}/vision/ocr")
+         .set(outputCol="ocr", errorCol="err"))
+    t.set_imageUrl_col("url")
+    out = t.transform(ds)
+    assert out["ocr"][0]["regions"][0]["text"] == "HELLO"
+    assert out["ocr"][1]["echoUrl"] == "http://x/2.png"
+
+    ds2 = Dataset({"img": [b"\x89PNGdata"]})
+    t2 = (OCR().set_subscription_key("secret").set_url(f"{base}/vision/ocr")
+          .set(outputCol="ocr", errorCol="err"))
+    t2.set_imageBytes_col("img")
+    assert t2.transform(ds2)["ocr"][0]["rawBytes"] is True
+
+
+def test_ocr_bad_key_goes_to_error_col(base):
+    ds = Dataset({"url": ["http://x/1.png"]})
+    t = (OCR().set_subscription_key("wrong").set_url(f"{base}/vision/ocr")
+         .set(outputCol="ocr", errorCol="err"))
+    t.set_imageUrl_col("url")
+    out = t.transform(ds)
+    assert out["ocr"][0] is None
+    assert out["err"][0]["statusCode"] == 401
+
+
+def test_recognize_text_polls_to_completion(base):
+    ds = Dataset({"url": ["http://x/h.png"]})
+    t = (RecognizeText().set_subscription_key("k")
+         .set_url(f"{base}/vision/recognizeText")
+         .set(outputCol="txt", errorCol="err", pollingDelay=0.01))
+    t.set_imageUrl_col("url")
+    out = t.transform(ds)
+    assert out["txt"][0]["status"] == "Succeeded"
+    assert out["txt"][0]["recognitionResult"]["lines"] == ["done"]
+
+
+def test_analyze_image_query_params(base):
+    ds = Dataset({"url": ["http://x/a.png"]})
+    t = (AnalyzeImage().set_subscription_key("k")
+         .set_url(f"{base}/vision/analyze")
+         .set(outputCol="a", errorCol="err"))
+    t.set_imageUrl_col("url")
+    t.set_visualFeatures(["Categories", "Tags"])
+    out = t.transform(ds)
+    assert "visualFeatures=Categories%2CTags" in out["a"][0]["query"]
+
+
+def test_text_sentiment_per_row_and_static(base):
+    ds = Dataset({"txt": ["good day", "bad day"]})
+    t = (TextSentiment().set_subscription_key("k")
+         .set_url(f"{base}/text/sentiment")
+         .set(outputCol="sent", errorCol="err", concurrency=2))
+    t.set_text_col("txt")
+    out = t.transform(ds)
+    assert out["sent"][0]["documents"][0]["score"] == 0.9
+    assert out["sent"][1]["documents"][0]["score"] == 0.1
+
+
+def test_language_detector(base):
+    ds = Dataset({"txt": ["hello world"]})
+    t = (LanguageDetector().set_subscription_key("k")
+         .set_url(f"{base}/text/languages").set(outputCol="lang", errorCol="err"))
+    t.set_text_col("txt")
+    out = t.transform(ds)
+    assert (out["lang"][0]["documents"][0]["detectedLanguages"][0]["iso6391Name"]
+            == "en")
+
+
+def test_face_detect_and_verify(base):
+    ds = Dataset({"url": ["http://x/f.png"]})
+    t = (DetectFace().set_subscription_key("k").set_url(f"{base}/face/detect")
+         .set(outputCol="faces", errorCol="err"))
+    t.set_imageUrl_col("url")
+    t.set_returnFaceId(True)
+    assert t.transform(ds)["faces"][0][0]["faceId"] == "f1"
+
+    ds2 = Dataset({"a": ["f1", "f1"], "b": ["f1", "f2"]})
+    v = (VerifyFaces().set_subscription_key("k").set_url(f"{base}/face/verify")
+         .set(outputCol="v", errorCol="err"))
+    v.set_faceId1_col("a")
+    v.set_faceId2_col("b")
+    out = v.transform(ds2)
+    assert out["v"][0]["isIdentical"] is True
+    assert out["v"][1]["isIdentical"] is False
+
+
+def test_speech_to_text(base):
+    ds = Dataset({"audio": [b"RIFF" + b"\x00" * 100]})
+    t = (SpeechToText().set_subscription_key("k").set_url(f"{base}/speech")
+         .set(outputCol="stt", errorCol="err"))
+    t.set_audioData_col("audio")
+    t.set_language("en-US")
+    out = t.transform(ds)
+    assert "bytes heard" in out["stt"][0]["DisplayText"]
+
+
+def test_simple_detect_anomalies_groups(base):
+    ds = Dataset({
+        "grp": ["a"] * 4 + ["b"] * 3,
+        "timestamp": [f"2026-01-0{i+1}T00:00:00Z" for i in range(4)]
+        + [f"2026-02-0{i+1}T00:00:00Z" for i in range(3)],
+        "value": np.array([1.0, 2.0, 1.5, 500.0, 10.0, 11.0, 10.5]),
+    })
+    t = (SimpleDetectAnomalies().set_subscription_key("k")
+         .set_url(f"{base}/anomaly/entire")
+         .set(outputCol="anom", errorCol="err", groupbyCol="grp"))
+    t.set_granularity("daily")
+    out = t.transform(ds)
+    assert out["anom"][3]["isAnomaly"] is True        # 500 vs mean ~126
+    assert out["anom"][0]["isAnomaly"] is False
+    assert all(a["isAnomaly"] is False for a in out["anom"][4:])
+
+
+def test_bing_image_search_and_url_explode(base):
+    ds = Dataset({"query": ["cats", "dogs"]})
+    t = (BingImageSearch().set_subscription_key("k")
+         .set_url(f"{base}/bing/images").set(outputCol="res", errorCol="err"))
+    t.set_q_col("query")
+    out = t.transform(ds)
+    urls = BingImageSearch.get_urls(out, "res")
+    assert list(urls["imageUrl"]) == ["http://img/cats/1", "http://img/cats/2",
+                                      "http://img/dogs/1", "http://img/dogs/2"]
+
+
+def test_azure_search_writer(base):
+    w = AzureSearchWriter(f"{base}/search", "idx1", "key")
+    created = w.ensure_index([{"name": "id", "type": "Edm.String", "key": True}])
+    assert created is True
+    assert w.ensure_index([]) is False  # second call: already exists
+    n = w.write(Dataset({"id": ["1", "2", "3"], "score": np.arange(3.0)}))
+    assert n == 3
+    assert _Mock.uploaded[0]["@search.action"] == "upload"
+
+
+def test_cognitive_persistence_roundtrip(tmp_path, base):
+    t = (TextSentiment().set_subscription_key("k")
+         .set_url(f"{base}/text/sentiment").set(outputCol="s", errorCol="e"))
+    t.set_text_col("txt")
+    t.set_language("en")
+    save_stage(t, str(tmp_path / "s"))
+    t2 = load_stage(str(tmp_path / "s"))
+    out = t2.transform(Dataset({"txt": ["good stuff"]}))
+    assert out["s"][0]["documents"][0]["score"] == 0.9

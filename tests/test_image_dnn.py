@@ -1,0 +1,244 @@
+"""Image ops + DNN scoring path tests.
+
+Mirrors the reference's opencv/ImageTransformerSuite, image/UnrollImageSuite,
+cntk/CNTKModelSuite and ImageFeaturizerSuite scenarios on synthetic images.
+"""
+
+import io as _io
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.core.pipeline import load_stage, save_stage
+from mmlspark_tpu.image import (DecodeImage, ImageSetAugmenter,
+                                ImageTransformer, ResizeImageTransformer,
+                                UnrollImage, gaussian_kernel)
+from mmlspark_tpu.models.dnn import (CNNConfig, DNNModel, ImageFeaturizer,
+                                     ModelDownloader, apply_cnn, feature_dim,
+                                     init_cnn_params)
+
+
+def _img(h=32, w=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (h, w, 3)).astype(np.uint8)
+
+
+def _png_bytes(arr):
+    from PIL import Image
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# decode + transformer stages
+# ---------------------------------------------------------------------------
+
+
+def test_decode_image_roundtrip_and_bad_bytes():
+    a = _img()
+    ds = Dataset({"bytes": [_png_bytes(a), b"not an image"]})
+    out = DecodeImage().set(inputCol="bytes", outputCol="img").transform(ds)
+    np.testing.assert_array_equal(out["img"][0], a)
+    assert out["img"][1] is None
+
+
+def test_resize_crop_chain():
+    ds = Dataset({"img": [_img(40, 60), _img(100, 30, seed=1)]})
+    t = (ImageTransformer().set(inputCol="img", outputCol="out")
+         .resize(24, 24).center_crop(16, 16))
+    out = t.transform(ds)
+    assert isinstance(out["out"], np.ndarray)  # stacked: same size now
+    assert out["out"].shape == (2, 16, 16, 3)
+
+
+def test_grayscale_flip_threshold():
+    a = np.zeros((4, 6, 3), np.uint8)
+    a[:, :3] = 200  # left half bright
+    ds = Dataset({"img": [a]})
+    t = (ImageTransformer().set(inputCol="img", outputCol="out")
+         .color_format("gray").flip(1).threshold(100.0, max_val=1.0))
+    out = t.transform(ds)[ "out"]
+    assert out.shape == (1, 4, 6, 1)
+    # after horizontal flip the bright half is on the right
+    assert out[0, 0, 0, 0] == 0.0 and out[0, 0, 5, 0] == 1.0
+
+
+def test_gaussian_blur_preserves_mean():
+    img = _img(16, 16).astype(np.float32)
+    ds = Dataset({"img": [img]})
+    out = (ImageTransformer().set(inputCol="img", outputCol="out")
+           .gaussian_blur(5, 1.0).transform(ds))["out"][0]
+    assert out.shape == img.shape
+    assert abs(out.mean() - img.mean()) / img.mean() < 0.05
+    assert out.std() < img.std()  # smoothing reduces variance
+
+
+def test_gaussian_kernel_normalized():
+    k = gaussian_kernel(5, 1.0)
+    assert k.shape == (5,)
+    np.testing.assert_allclose(k.sum(), 1.0, rtol=1e-6)
+    assert k[2] == k.max()
+
+
+def test_batched_stacked_input():
+    batch = np.stack([_img(), _img(seed=1)]).astype(np.float32)
+    ds = Dataset({"img": batch})
+    out = (ImageTransformer().set(inputCol="img", outputCol="out")
+           .resize(8, 8).transform(ds))["out"]
+    assert out.shape == (2, 8, 8, 3)
+
+
+def test_resize_transformer_and_persistence(tmp_path):
+    t = ResizeImageTransformer().set(inputCol="img", outputCol="out",
+                                     height=10, width=12)
+    save_stage(t, str(tmp_path / "r"))
+    t2 = load_stage(str(tmp_path / "r"))
+    out = t2.transform(Dataset({"img": [_img()]}))["out"]
+    assert out.shape == (1, 10, 12, 3)
+
+
+def test_unroll_image_chw_order():
+    img = np.zeros((2, 3, 3), np.float32)
+    img[..., 0] = 1.0  # R plane all ones
+    out = (UnrollImage().set(inputCol="img", outputCol="u")
+           .transform(Dataset({"img": [img]})))["u"]
+    assert out.shape == (1, 18)
+    np.testing.assert_array_equal(out[0, :6], 1.0)   # CHW: R plane first
+    np.testing.assert_array_equal(out[0, 6:], 0.0)
+
+
+def test_image_set_augmenter():
+    ds = Dataset({"img": [_img()], "label": np.array([1])})
+    out = (ImageSetAugmenter().set(inputCol="img", outputCol="img",
+                                   flipLeftRight=True, flipUpDown=True)
+           .transform(ds))
+    assert len(out) == 3
+    np.testing.assert_array_equal(out["img"][1], out["img"][0][:, ::-1])
+    np.testing.assert_array_equal(out["img"][2], out["img"][0][::-1])
+    assert list(out["label"]) == [1, 1, 1]
+
+
+# ---------------------------------------------------------------------------
+# CNN + DNNModel + ImageFeaturizer
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_cnn():
+    import jax
+    cfg = CNNConfig(num_classes=5, stage_sizes=(1, 1), width=4,
+                    input_hw=(16, 16))
+    params = init_cnn_params(cfg, jax.random.PRNGKey(0))
+    apply_fn = lambda p, x, capture=(): apply_cnn(p, x, cfg, capture)  # noqa
+    return params, cfg, apply_fn
+
+
+def test_cnn_shapes_and_capture(tiny_cnn):
+    params, cfg, apply_fn = tiny_cnn
+    x = np.random.default_rng(0).normal(size=(2, 16, 16, 3)).astype(np.float32)
+    logits, acts = apply_fn(params, x, capture=["pool", "stage0_block0"])
+    assert logits.shape == (2, 5)
+    assert acts["pool"].shape == (2, feature_dim(cfg))
+    assert acts["stage0_block0"].ndim == 4
+
+
+def test_dnn_model_transform_batching(tiny_cnn):
+    params, cfg, apply_fn = tiny_cnn
+    model = (DNNModel(params, lambda p, x, capture=("logits",): apply_fn(p, x, capture))
+             .set(inputCol="x", outputCol="y", outputNode="logits",
+                  miniBatchSize=4))
+    # 10 rows with batch 4 exercises the padded tail batch
+    x = np.random.default_rng(1).normal(size=(10, 16, 16, 3)).astype(np.float32)
+    out = model.transform(Dataset({"x": x}))
+    assert out["y"].shape == (10, 5)
+    # values must match an unbatched reference run
+    ref, _ = apply_fn(params, x, ("logits",))
+    np.testing.assert_allclose(out["y"], np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_dnn_model_output_node_surgery(tiny_cnn):
+    params, cfg, apply_fn = tiny_cnn
+    model = DNNModel(params, apply_fn).set(inputCol="x", outputCol="f",
+                                           outputNode="pool", miniBatchSize=8)
+    x = np.random.default_rng(2).normal(size=(3, 16, 16, 3)).astype(np.float32)
+    out = model.transform(Dataset({"x": x}))
+    assert out["f"].shape == (3, feature_dim(cfg))
+    clone = model.cloned_with_shared_params()
+    assert clone.params is model.params
+    out2 = clone.transform(Dataset({"x": x}))
+    np.testing.assert_allclose(out["f"], out2["f"], rtol=1e-5)
+
+
+def test_image_featurizer_end_to_end(tiny_cnn):
+    params, cfg, apply_fn = tiny_cnn
+    dnn = DNNModel(params, apply_fn)
+    feat = (ImageFeaturizer(dnn, input_hw=(16, 16))
+            .set(inputCol="img", outputCol="features", cutOutputLayers=1))
+    ds = Dataset({"img": [_img(30, 40), _img(50, 20, seed=3)]})
+    out = feat.transform(ds)
+    assert out["features"].shape == (2, feature_dim(cfg))
+    assert np.isfinite(out["features"]).all()
+    # cutOutputLayers=0 -> logits
+    logits = (ImageFeaturizer(dnn, input_hw=(16, 16))
+              .set(inputCol="img", outputCol="l", cutOutputLayers=0)
+              .transform(ds))["l"]
+    assert logits.shape == (2, 5)
+
+
+def test_dnn_model_persistence(tmp_path, tiny_cnn):
+    params, cfg, apply_fn = tiny_cnn
+    spec = {"kind": "cnn",
+            "config": {"num_classes": cfg.num_classes,
+                       "stage_sizes": cfg.stage_sizes, "width": cfg.width,
+                       "input_hw": cfg.input_hw}}
+    model = (DNNModel(params, apply_spec=spec)
+             .set(inputCol="x", outputCol="y", outputNode="pool"))
+    x = np.random.default_rng(4).normal(size=(2, 16, 16, 3)).astype(np.float32)
+    before = model.transform(Dataset({"x": x}))["y"]
+    save_stage(model, str(tmp_path / "m"))
+    model2 = load_stage(str(tmp_path / "m"))
+    after = model2.transform(Dataset({"x": x}))["y"]
+    np.testing.assert_allclose(before, after, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# ModelDownloader
+# ---------------------------------------------------------------------------
+
+
+def test_model_downloader_builtin(tmp_path):
+    d = ModelDownloader(str(tmp_path / "repo"))
+    names = [s.name for s in d.remote_models()]
+    assert "ConvNetMNIST" in names
+    schema = d.download_model("ConvNetMNIST")
+    assert schema.sha256
+    assert "pool" in schema.layerNames
+    # second call is a cache hit (hash verified)
+    schema2 = d.download_model("ConvNetMNIST")
+    assert schema2.sha256 == schema.sha256
+    assert [s.name for s in d.local_models()] == ["ConvNetMNIST"]
+
+    params, cfg, apply_fn = d.load_model("ConvNetMNIST")
+    x = np.zeros((1, 28, 28, 3), np.float32)
+    logits, _ = apply_fn(params, x)
+    assert logits.shape == (1, 10)
+
+
+def test_model_downloader_file_uri_and_hash_check(tmp_path):
+    import hashlib
+    from mmlspark_tpu.models.dnn.downloader import ModelSchema
+
+    blob = b"fake model payload"
+    src = tmp_path / "m.pkl"
+    src.write_bytes(blob)
+    d = ModelDownloader(str(tmp_path / "repo"))
+    good = ModelSchema(name="ext", uri=f"file://{src}",
+                       sha256=hashlib.sha256(blob).hexdigest())
+    d.download_model(good)
+    assert (tmp_path / "repo" / "ext" / "model.pkl").read_bytes() == blob
+
+    bad = ModelSchema(name="ext2", uri=f"file://{src}", sha256="0" * 64)
+    with pytest.raises(IOError, match="hash mismatch"):
+        d.download_model(bad)
