@@ -124,7 +124,13 @@ class ServingServer:
             def log_message(self, *a):  # quiet
                 pass
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        class Server(ThreadingHTTPServer):
+            # Deep listen backlog: burst traffic must never see connection
+            # resets while handler threads are parked on in-flight replies.
+            request_queue_size = 128
+            daemon_threads = True
+
+        self._httpd = Server((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)
