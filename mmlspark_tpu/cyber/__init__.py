@@ -1,0 +1,21 @@
+"""CyberML: security-analytics estimators (access-anomaly detection).
+
+TPU-native re-design of the reference's pure-PySpark cyber package
+(reference: src/main/python/mmlspark/cyber/ — 1,962 LoC). The Spark ALS
+substrate is replaced with a jit-compiled JAX ALS (batched normal-equation
+solves on the MXU); the per-tenant dataframe joins become columnar numpy
+group-bys on the host.
+"""
+
+from .feature import (IdIndexer, IdIndexerModel, LinearScalarScaler,
+                      LinearScalarScalerModel, MultiIndexer, MultiIndexerModel,
+                      StandardScalarScaler, StandardScalarScalerModel)
+from .complement import ComplementAccessTransformer
+from .anomaly import AccessAnomaly, AccessAnomalyConfig, AccessAnomalyModel
+
+__all__ = [
+    "AccessAnomaly", "AccessAnomalyConfig", "AccessAnomalyModel",
+    "ComplementAccessTransformer", "IdIndexer", "IdIndexerModel",
+    "LinearScalarScaler", "LinearScalarScalerModel", "MultiIndexer",
+    "MultiIndexerModel", "StandardScalarScaler", "StandardScalarScalerModel",
+]

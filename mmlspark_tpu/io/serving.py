@@ -128,7 +128,6 @@ class ServingServer:
             # Deep listen backlog: burst traffic must never see connection
             # resets while handler threads are parked on in-flight replies.
             request_queue_size = 128
-            daemon_threads = True
 
         self._httpd = Server((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
@@ -338,12 +337,13 @@ class ServingBuilder:
         output col. The inner batch is padded to a power-of-two bucket (first
         row repeated) so a jitted model sees only log2(maxBatch) distinct
         shapes — no recompiles under varying load."""
-        max_batch = self._max_batch
 
         def fn(ds: Dataset) -> Dataset:
             values = list(ds["value"])
             n = len(values)
-            b = bucket_size(n, max(max_batch, n))
+            # Read the builder's batch size at call time, so `.batch()` later
+            # in the fluent chain still governs the bucketing.
+            b = bucket_size(n, max(self._max_batch, n))
             padded = values + [values[0]] * (b - n)
             out = model.transform(Dataset({input_col: padded}))
             replies = [make_reply(to_jsonable(v))

@@ -1,0 +1,170 @@
+"""CyberML tests: indexers, scalers, complement sampling, AccessAnomaly.
+
+Mirrors the reference's python cyber tests
+(src/test/python/mmlsparktest/cyber/): per-tenant isolation, index
+contiguity, score normalization (mean 0 / std 1 over training accesses),
+history zeroing, and cross-component +inf behavior.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.cyber import (AccessAnomaly, AccessAnomalyModel,
+                                ComplementAccessTransformer, IdIndexer,
+                                LinearScalarScaler, StandardScalarScaler)
+
+
+def _access_df(seed=0):
+    """Two tenants; within each, users 0-3 hit resources 0-3 (cluster A) and
+    users 4-7 hit resources 4-7 (cluster B)."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for tenant in ["t1", "t2"]:
+        for cluster in (0, 1):
+            for u in range(4):
+                for r in range(4):
+                    rows.append({
+                        "tenant": tenant,
+                        "user": f"u{cluster * 4 + u}",
+                        "res": f"r{cluster * 4 + r}",
+                        "likelihood": float(rng.integers(1, 20)),
+                    })
+    return Dataset.from_rows(rows)
+
+
+def test_id_indexer_global_and_reset():
+    ds = Dataset({"tenant": ["a", "a", "b", "b"],
+                  "user": ["x", "y", "x", "z"]})
+    model = IdIndexer("user", "tenant", "user_idx", False).fit(ds)
+    out = model.transform(ds)
+    idx = out.array("user_idx")
+    assert sorted(idx.tolist()) == [1, 2, 3, 4]  # globally contiguous from 1
+
+    model_r = IdIndexer("user", "tenant", "user_idx", True).fit(ds)
+    out_r = model_r.transform(ds)
+    by_tenant = {}
+    for t, i in zip(["a", "a", "b", "b"], out_r.array("user_idx").tolist()):
+        by_tenant.setdefault(t, []).append(i)
+    assert sorted(by_tenant["a"]) == [1, 2]      # resets per tenant
+    assert sorted(by_tenant["b"]) == [1, 2]
+
+    # unseen value -> 0
+    unseen = model.transform(Dataset({"tenant": ["a"], "user": ["nope"]}))
+    assert unseen.array("user_idx").tolist() == [0]
+
+    # undo_transform restores original names
+    undone = model.undo_transform(out)
+    assert list(undone["user"]) == ["x", "y", "x", "z"]
+
+
+def test_standard_scaler_per_tenant():
+    ds = Dataset({"tenant": ["a"] * 4 + ["b"] * 4,
+                  "v": np.asarray([1, 2, 3, 4, 100, 200, 300, 400.0])})
+    out = StandardScalarScaler("v", "tenant", "v_s").fit(ds).transform(ds)
+    v = out.array("v_s")
+    for sl in (slice(0, 4), slice(4, 8)):
+        assert abs(float(np.mean(v[sl]))) < 1e-9
+        assert abs(float(np.std(v[sl])) - 1.0) < 1e-9
+
+
+def test_linear_scaler_range():
+    ds = Dataset({"tenant": ["a"] * 3 + ["b"] * 2,
+                  "v": np.asarray([0.0, 5.0, 10.0, 7.0, 9.0])})
+    out = LinearScalarScaler("v", "tenant", "v_s", 5.0, 10.0).fit(ds).transform(ds)
+    v = out.array("v_s")
+    assert v[:3].min() == 5.0 and v[:3].max() == 10.0
+    assert v[3:].min() == 5.0 and v[3:].max() == 10.0
+
+
+def test_complement_access_disjoint():
+    ds = Dataset({"tenant": ["a"] * 6,
+                  "u": np.asarray([1, 1, 2, 2, 3, 3]),
+                  "r": np.asarray([1, 2, 1, 2, 1, 2])})
+    comp = ComplementAccessTransformer("tenant", ["u", "r"], 2).transform(ds)
+    observed = set(zip(ds.array("u").tolist(), ds.array("r").tolist()))
+    sampled = set(zip(comp.array("u").tolist(), comp.array("r").tolist()))
+    assert sampled.isdisjoint(observed)
+    assert all(1 <= u <= 3 and 1 <= r <= 2 for u, r in sampled)
+
+
+@pytest.mark.parametrize("implicit", [True, False])
+def test_access_anomaly_end_to_end(implicit, tmp_path):
+    ds = _access_df()
+    est = AccessAnomaly(maxIter=8, rankParam=4, applyImplicitCf=implicit,
+                        seed=1)
+    model = est.fit(ds)
+    scored = model.transform(ds)
+    s = scored.array("anomaly_score")
+    # training accesses are history -> exactly 0
+    assert np.all(s == 0.0)
+
+    # raw standardized scores: standardization is over the *enriched* train
+    # set (explicit mode adds complement negatives), so positive pairs sit at
+    # or below the overall mean — never above it.
+    model.preserve_history = False
+    raw = model.transform(ds).array("anomaly_score")
+    assert float(np.mean(raw)) < 0.25
+    assert 0.2 < float(np.std(raw)) < 2.0
+    model.preserve_history = True
+
+    # cross-cluster access (disconnected components) -> +inf
+    cross = model.transform(Dataset({
+        "tenant": ["t1"], "user": ["u0"], "res": ["r5"]}))
+    assert np.isposinf(cross.array("anomaly_score"))[0]
+
+    # unseen user -> NaN (cold start)
+    cold = model.transform(Dataset({
+        "tenant": ["t1"], "user": ["stranger"], "res": ["r0"]}))
+    assert np.isnan(cold.array("anomaly_score"))[0]
+
+    # persistence round-trip
+    path = str(tmp_path / f"aa_{implicit}")
+    model.save(path)
+    loaded = AccessAnomalyModel.load(path)
+    re_scored = loaded.transform(ds).array("anomaly_score")
+    np.testing.assert_allclose(re_scored, s)
+
+
+def test_access_anomaly_unseen_within_component_scores_high():
+    """A user accessing an in-component resource they never touched should
+    score higher than their usual accesses."""
+    ds = _access_df()
+    model = AccessAnomaly(maxIter=10, rankParam=4, seed=2).fit(ds)
+    model.preserve_history = False
+    # u0 regularly hits r0-r3; r4-r7 are another cluster (disconnected), so
+    # compare against a rarely-but-connected setup: drop one edge and refit.
+    rows = [r for r in ds.to_rows()
+            if not (r["tenant"] == "t1" and r["user"] == "u0" and r["res"] == "r3")]
+    # keep r3 connected via other users
+    ds2 = Dataset.from_rows(rows)
+    model2 = AccessAnomaly(maxIter=10, rankParam=4, seed=2).fit(ds2)
+    model2.preserve_history = False
+    seen = model2.transform(Dataset({
+        "tenant": ["t1"], "user": ["u0"], "res": ["r0"]}))
+    unseen = model2.transform(Dataset({
+        "tenant": ["t1"], "user": ["u0"], "res": ["r3"]}))
+    assert unseen.array("anomaly_score")[0] > seen.array("anomaly_score")[0]
+
+
+def test_access_anomaly_param_validation():
+    with pytest.raises(ValueError):
+        AccessAnomaly(applyImplicitCf=True, complementsetFactor=2).fit(
+            _access_df())
+    with pytest.raises(ValueError):
+        AccessAnomaly(applyImplicitCf=False, alphaParam=1.0).fit(_access_df())
+    with pytest.raises(ValueError):
+        AccessAnomaly(lowValue=0.5, highValue=10.0).fit(_access_df())
+    with pytest.raises(ValueError):
+        AccessAnomaly(applyImplicitCf=False, negScore=6.0,
+                      lowValue=5.0, highValue=10.0).fit(_access_df())
+
+
+def test_access_anomaly_neg_score_zero_still_trains():
+    """negScore=0 complement rows must still carry weight in the explicit
+    objective (observation mask, not value!=0)."""
+    model = AccessAnomaly(applyImplicitCf=False, negScore=0.0, maxIter=5,
+                          rankParam=4, seed=3).fit(_access_df())
+    model.preserve_history = False
+    raw = model.transform(_access_df()).array("anomaly_score")
+    assert np.all(np.isfinite(raw))
