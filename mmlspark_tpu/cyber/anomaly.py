@@ -24,7 +24,7 @@ components score +inf; pairs present in training history score 0.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Tuple
 
 import numpy as np
 
@@ -376,36 +376,26 @@ class AccessAnomaly(Estimator):
         alpha = self.get_or_default("alphaParam")
         alpha = AccessAnomalyConfig.default_alpha if alpha is None else alpha
 
-        # One joint ALS: global indices keep tenants disjoint, so a single
-        # factorization trains every tenant at once (reference default path).
-        # separateTenants resets index spaces, so factor per tenant instead.
-        if self.get_or_default("separateTenants"):
-            user_vecs: Dict[Tuple, np.ndarray] = {}
-            res_vecs: Dict[Tuple, np.ndarray] = {}
-            for t in sorted(set(tenants)):
-                mask = np.asarray([x == t for x in tenants])
-                ui, ri, rt = u_idx[mask], r_idx[mask], rating[mask]
-                x, y = als_fit(ui, ri, rt, int(ui.max()) + 1,
-                               int(ri.max()) + 1, rank,
-                               self.get_or_default("maxIter"),
-                               self.get_or_default("regParam"),
-                               implicit, alpha, seed)
-                for i in np.unique(ui):
-                    user_vecs[(t, int(i))] = x[i]
-                for i in np.unique(ri):
-                    res_vecs[(t, int(i))] = y[i]
-        else:
-            x, y = als_fit(u_idx, r_idx, rating, int(u_idx.max()) + 1,
-                           int(r_idx.max()) + 1, rank,
+        # Tenants share no observations, so the joint factorization is
+        # block-diagonal: solve one compact dense ALS per tenant (local
+        # reindex via np.unique) instead of densifying the full global
+        # (all-users x all-resources) matrix, which would be quadratic in
+        # tenant count with only the diagonal blocks ever nonzero.
+        user_vecs: Dict[Tuple, np.ndarray] = {}
+        res_vecs: Dict[Tuple, np.ndarray] = {}
+        tenants_arr = np.asarray(tenants)
+        for t in sorted(set(tenants)):
+            mask = tenants_arr == t
+            uu, ui = np.unique(u_idx[mask], return_inverse=True)
+            ru, ri = np.unique(r_idx[mask], return_inverse=True)
+            x, y = als_fit(ui, ri, rating[mask], len(uu), len(ru), rank,
                            self.get_or_default("maxIter"),
                            self.get_or_default("regParam"),
                            implicit, alpha, seed)
-            user_vecs = {}
-            res_vecs = {}
-            for t, i in sorted({(t, int(i)) for t, i in zip(tenants, u_idx)}):
-                user_vecs[(t, i)] = x[i]
-            for t, i in sorted({(t, int(i)) for t, i in zip(tenants, r_idx)}):
-                res_vecs[(t, i)] = y[i]
+            for local, g in enumerate(uu):
+                user_vecs[(t, int(g))] = x[local]
+            for local, g in enumerate(ru):
+                res_vecs[(t, int(g))] = y[local]
 
         # --- normalization: standardize dot products per tenant, folded into
         # two appended bias dims (reference: ModelNormalizeTransformer).

@@ -15,7 +15,7 @@ import numpy as np
 
 from ..core.dataset import Dataset
 from ..core.params import HasInputCol, HasOutputCol, Param
-from ..core.pipeline import Estimator, Model, Transformer
+from ..core.pipeline import Estimator, Model
 
 
 def _col_as_list(col) -> list:

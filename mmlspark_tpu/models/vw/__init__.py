@@ -1,0 +1,17 @@
+"""Vowpal-Wabbit-parity online learners (hashed linear SGD on XLA)."""
+
+from .api import (VowpalWabbitClassificationModel, VowpalWabbitClassifier,
+                  VowpalWabbitRegressionModel, VowpalWabbitRegressor)
+from .bandit import (ContextualBanditMetrics, VectorZipper,
+                     VowpalWabbitContextualBandit,
+                     VowpalWabbitContextualBanditModel,
+                     VowpalWabbitInteractions)
+from .featurizer import VowpalWabbitFeaturizer
+
+__all__ = [
+    "ContextualBanditMetrics", "VectorZipper", "VowpalWabbitClassifier",
+    "VowpalWabbitClassificationModel", "VowpalWabbitContextualBandit",
+    "VowpalWabbitContextualBanditModel", "VowpalWabbitFeaturizer",
+    "VowpalWabbitInteractions", "VowpalWabbitRegressionModel",
+    "VowpalWabbitRegressor",
+]

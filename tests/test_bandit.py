@@ -1,0 +1,146 @@
+"""Contextual bandit tests (reference:
+vw/VerifyVowpalWabbitContextualBandit.scala scenarios: 1-based action
+validation, probability outputs, IPS/SNIPS metrics, parallel multi-config
+fit; VectorZipper + Interactions behavior)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.models.vw import (ContextualBanditMetrics, VectorZipper,
+                                    VowpalWabbitContextualBandit,
+                                    VowpalWabbitContextualBanditModel,
+                                    VowpalWabbitInteractions)
+
+
+def _bandit_df(n=200, k=3, seed=0):
+    """Synthetic: action whose feature matches the context has cost 0,
+    others cost 1. Logged policy is uniform."""
+    rng = np.random.default_rng(seed)
+    ctx = rng.integers(0, k, size=n)
+    shared = np.eye(k, dtype=np.float32)[ctx]
+    actions_col = []
+    chosen = np.zeros(n, dtype=np.int64)
+    cost = np.zeros(n)
+    prob = np.full(n, 1.0 / k)
+    for i in range(n):
+        acts = [np.eye(k, dtype=np.float32)[a] for a in range(k)]
+        actions_col.append(acts)
+        a = rng.integers(0, k)
+        chosen[i] = a + 1                      # 1-based
+        cost[i] = 0.0 if a == ctx[i] else 1.0
+    return Dataset({"shared": shared, "features": actions_col,
+                    "chosenAction": chosen, "label": cost,
+                    "probability": prob})
+
+
+def test_bandit_learns_matching_policy():
+    ds = _bandit_df()
+    est = VowpalWabbitContextualBandit(labelCol="label", numPasses=4,
+                                       epsilon=0.1, learningRate=0.5)
+    model = est.fit(ds)
+    out = model.transform(ds)
+    probs = out["prediction"]
+    # the learned policy should put the big (1 - eps + eps/K) mass on the
+    # context-matching (cost 0) action for almost every row
+    ctx = np.argmax(np.asarray(ds["shared"]), axis=1)
+    hits = sum(int(np.argmax(p) == c) for p, c in zip(probs, ctx))
+    assert hits / len(probs) > 0.9
+    # probabilities form a distribution
+    for p in probs[:10]:
+        assert abs(sum(p) - 1.0) < 1e-5
+        assert min(p) > 0.0                    # epsilon floor
+
+
+def test_bandit_metrics_and_stats():
+    ds = _bandit_df()
+    model = VowpalWabbitContextualBandit(labelCol="label", numPasses=2).fit(ds)
+    stats = model.get_performance_statistics()
+    row = stats.row(0)
+    assert row["totalEvents"] == 2 * len(ds)   # per-pass accumulation
+    # costs are in [0, 1] so both counterfactual estimates must be too
+    assert 0.0 <= row["ipsEstimate"] <= 1.0
+    assert 0.0 <= row["snipsEstimate"] <= 1.0
+
+
+def test_bandit_zero_action_rejected():
+    ds = _bandit_df(n=10)
+    bad = ds.with_column("chosenAction",
+                         np.zeros(len(ds), dtype=np.int64))
+    with pytest.raises(ValueError, match="1-based"):
+        VowpalWabbitContextualBandit(labelCol="label").fit(bad)
+
+
+def test_bandit_ragged_actions_and_persistence(tmp_path):
+    """Rows may offer different action counts; padding must not leak."""
+    rows = []
+    rng = np.random.default_rng(1)
+    for i in range(40):
+        k = int(rng.integers(2, 5))
+        acts = [np.eye(4, dtype=np.float32)[a] for a in range(k)]
+        rows.append({"shared": np.ones(2, dtype=np.float32), "features": acts,
+                     "chosenAction": int(rng.integers(1, k + 1)),
+                     "label": float(rng.random()),
+                     "probability": 1.0 / k})
+    ds = Dataset.from_rows(rows)
+    ds = Dataset({"shared": np.stack([r["shared"] for r in rows]),
+                  "features": [r["features"] for r in rows],
+                  "chosenAction": np.asarray([r["chosenAction"] for r in rows]),
+                  "label": np.asarray([r["label"] for r in rows]),
+                  "probability": np.asarray([r["probability"] for r in rows])})
+    model = VowpalWabbitContextualBandit(labelCol="label").fit(ds)
+    out = model.transform(ds)
+    for p, r in zip(out["prediction"], rows):
+        assert len(p) == len(r["features"])    # per-row action count preserved
+        assert abs(sum(p) - 1.0) < 1e-5
+
+    path = str(tmp_path / "cb")
+    model.save(path)
+    loaded = VowpalWabbitContextualBanditModel.load(path)
+    out2 = loaded.transform(ds)
+    for p1, p2 in zip(out["prediction"], out2["prediction"]):
+        np.testing.assert_allclose(p1, p2)
+    assert loaded.metrics.total_events == model.metrics.total_events
+
+
+def test_bandit_parallel_multi_config_fit():
+    ds = _bandit_df(n=60)
+    est = VowpalWabbitContextualBandit(labelCol="label", parallelism=3)
+    models = est.fit_multiple(ds, [{"epsilon": 0.05}, {"epsilon": 0.2},
+                                   {"learningRate": 0.1}])
+    assert len(models) == 3
+    eps = [m.get_or_default("epsilon") for m in models]
+    assert eps[0] == 0.05 and eps[1] == 0.2
+
+
+def test_contextual_bandit_metrics_match_reference_semantics():
+    m = ContextualBanditMetrics()
+    m.add_example(0.5, 1.0, 0.25)
+    m.add_example(0.5, 0.0, 0.5)
+    m.add_example(0.5, 2.0, 0.0)               # eval prob 0: only total grows
+    assert m.total_events == 3
+    assert m.offline_policy_events == 2
+    assert m.get_ips_estimate() == pytest.approx((1.0 * 0.5) / 3)
+    assert m.get_snips_estimate() == pytest.approx(0.5 / 1.5)
+
+
+def test_vector_zipper():
+    ds = Dataset({"a": np.asarray([[1.0, 0.0], [0.0, 1.0]]),
+                  "b": np.asarray([[2.0, 2.0], [3.0, 3.0]])})
+    out = VectorZipper(inputCols=["a", "b"], outputCol="z").transform(ds)
+    z = out["z"]
+    assert len(z) == 2 and len(z[0]) == 2
+    np.testing.assert_allclose(z[0][1], [2.0, 2.0])
+
+
+def test_interactions_quadratic_count_and_values():
+    """|out nnz| = prod(|nnz per namespace|); values multiply
+    (reference: VowpalWabbitInteractions.scala numElems product)."""
+    ds = Dataset({"a": np.asarray([[1.0, 2.0, 0.0]]),
+                  "b": np.asarray([[3.0, 0.0, 4.0]])})
+    out = VowpalWabbitInteractions(inputCols=["a", "b"],
+                                   outputCol="q").transform(ds)
+    vals = out.array("q_values")[0]
+    nz = vals[vals != 0]
+    assert len(nz) == 4                        # 2 x 2 active features
+    assert sorted(nz.tolist()) == sorted([3.0, 4.0, 6.0, 8.0])
