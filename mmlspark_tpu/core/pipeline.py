@@ -171,9 +171,11 @@ class Lambda(Transformer):
 
 
 def _is_jsonable(v: Any) -> bool:
+    # JSON must round-trip *faithfully*: json.dumps silently stringifies
+    # non-str dict keys and turns tuples into lists, which corrupts params
+    # (e.g. a float->weight table); such values go to the pickle path instead.
     try:
-        json.dumps(v)
-        return True
+        return json.loads(json.dumps(v)) == v
     except (TypeError, ValueError):
         return False
 

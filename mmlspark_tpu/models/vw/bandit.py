@@ -298,11 +298,8 @@ class VowpalWabbitContextualBanditModel(Model, _ContextualBanditParams):
         if wq is not None:
             scores = scores + np.einsum("ns,sd,nkd->nk", shared,
                                         np.asarray(wq), actions)
-        k_valid = mask.sum(axis=1, keepdims=True)
-        masked = np.where(mask > 0, scores, np.inf)
-        best = np.argmin(masked, axis=1)
-        probs = (eps / k_valid) * mask
-        probs[np.arange(len(best)), best] += 1.0 - eps
+        # one policy definition shared with training (no train/serve drift)
+        probs = np.asarray(_epsilon_greedy(scores, mask, eps))
         out = [probs[i, mask[i] > 0].tolist() for i in range(len(probs))]
         return dataset.with_column(
             self.get_or_default("predictionCol") or "prediction", out)

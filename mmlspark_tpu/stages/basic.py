@@ -137,6 +137,7 @@ class ClassBalancer(Estimator, HasInputCol, HasOutputCol):
 
 class ClassBalancerModel(Model, HasInputCol, HasOutputCol):
     table = Param("table", "label -> weight", None, is_complex=True)
+    outputCol = Param("outputCol", "weight column", "weight", TypeConverters.to_string)
 
     def __init__(self, table: Optional[dict] = None, **kwargs):
         super().__init__(**kwargs)

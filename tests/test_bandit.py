@@ -82,7 +82,6 @@ def test_bandit_ragged_actions_and_persistence(tmp_path):
                      "chosenAction": int(rng.integers(1, k + 1)),
                      "label": float(rng.random()),
                      "probability": 1.0 / k})
-    ds = Dataset.from_rows(rows)
     ds = Dataset({"shared": np.stack([r["shared"] for r in rows]),
                   "features": [r["features"] for r in rows],
                   "chosenAction": np.asarray([r["chosenAction"] for r in rows]),
