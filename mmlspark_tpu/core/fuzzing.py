@@ -108,10 +108,13 @@ def _columns_equal(a, b) -> bool:
         xe = np.asarray(x) if isinstance(x, (np.ndarray, list)) else x
         ye = np.asarray(y) if isinstance(y, (np.ndarray, list)) else y
         if isinstance(xe, np.ndarray) and isinstance(ye, np.ndarray):
-            if xe.shape != ye.shape or (
-                np.issubdtype(xe.dtype, np.number)
-                and not np.allclose(xe, ye, rtol=1e-5, atol=1e-6,
-                                    equal_nan=True)):
+            if xe.shape != ye.shape:
+                return False
+            if np.issubdtype(xe.dtype, np.number):
+                if not np.allclose(xe, ye, rtol=1e-5, atol=1e-6,
+                                   equal_nan=True):
+                    return False
+            elif not np.array_equal(xe, ye):
                 return False
         elif x != y:
             return False

@@ -1,0 +1,200 @@
+"""Native host-runtime loader: compile-on-first-use C++ with ctypes bindings.
+
+TPU-native replacement for the reference's NativeLoader (reference:
+core/env/NativeLoader.java:28-140 — extract .so from jar resources, then
+``System.load``). Here the native source ships with the package; the loader
+compiles it once with the system toolchain into a content-addressed cache and
+binds the C ABI via ctypes. Everything has a pure-Python fallback, so the
+framework degrades gracefully on hosts without a compiler.
+
+API:
+- ``get_lib() -> ctypes.CDLL | None`` — the compiled library (cached), or
+  None when unavailable.
+- ``murmur3_batch(strings, seeds) -> np.uint32[n]`` — batch feature hashing.
+- ``bin_batch(X, upper_bounds) -> np.int32[n, F]`` — quantile-bin apply.
+- ``csv_read_floats(text, ncols) -> np.float32[rows, ncols]`` — data loader.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_SOURCE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native",
+    "mmlspark_native.cpp")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _cache_dir() -> str:
+    # Per-user, mode-0700 cache: a world-writable shared dir would let
+    # another local user pre-plant a .so that we'd load into this process.
+    d = os.environ.get("MMLSPARK_TPU_NATIVE_CACHE")
+    if not d:
+        uid = os.getuid() if hasattr(os, "getuid") else "u"
+        d = os.path.join(tempfile.gettempdir(), f"mmlspark_tpu_native_{uid}")
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    st = os.stat(d)
+    if hasattr(os, "getuid") and st.st_uid != os.getuid():
+        raise PermissionError(f"native cache dir {d} owned by uid {st.st_uid}")
+    return d
+
+
+def _compile() -> Optional[str]:
+    if not os.path.exists(_SOURCE):
+        return None
+    with open(_SOURCE, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_cache_dir(), f"mmlspark_native_{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    # unique temp name per process: concurrent cold-cache compiles must not
+    # race on one .tmp file (os.replace publishes atomically)
+    tmp_path = f"{so_path}.{os.getpid()}.tmp"
+    for cxx in (os.environ.get("CXX"), "g++", "c++", "clang++"):
+        if not cxx:
+            continue
+        cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", _SOURCE,
+               "-o", tmp_path]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp_path, so_path)
+            return so_path
+        except (OSError, subprocess.SubprocessError):
+            continue
+    return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the native library; None if unavailable."""
+    global _lib, _lib_tried
+    if _lib_tried:        # lock-free fast path for per-hash callers
+        return _lib
+    with _lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        if os.environ.get("MMLSPARK_TPU_DISABLE_NATIVE"):
+            return None
+        so = _compile()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        lib.mm_murmur3_32.restype = ctypes.c_uint32
+        lib.mm_murmur3_32.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                      ctypes.c_uint32]
+        lib.mm_murmur3_batch.restype = None
+        lib.mm_murmur3_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32)]
+        lib.mm_bin_batch.restype = None
+        lib.mm_bin_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.mm_csv_read_floats.restype = ctypes.c_int64
+        lib.mm_csv_read_floats.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# High-level wrappers (with pure-Python fallbacks)
+# ---------------------------------------------------------------------------
+
+
+def murmur3_batch(strings: Sequence[str],
+                  seeds: Sequence[int]) -> np.ndarray:
+    """Hash n utf-8 strings with per-string seeds -> uint32[n]."""
+    lib = get_lib()
+    if lib is None:
+        from ..ops.murmur import murmur3_32
+        return np.asarray([murmur3_32(s, int(seed)) for s, seed
+                           in zip(strings, seeds)], dtype=np.uint32)
+    encoded: List[bytes] = [s.encode("utf-8") for s in strings]
+    offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in encoded], out=offsets[1:])
+    buf = b"".join(encoded)
+    seeds_arr = np.asarray(seeds, dtype=np.uint32)
+    out = np.empty(len(encoded), dtype=np.uint32)
+    lib.mm_murmur3_batch(
+        buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        seeds_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        len(encoded), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+    return out
+
+
+def bin_batch(X: np.ndarray, upper_bounds: np.ndarray) -> np.ndarray:
+    """Apply per-feature quantile bins: [n, F] floats -> [n, F] int32 bins."""
+    X = np.ascontiguousarray(X, dtype=np.float32)
+    ub = np.ascontiguousarray(upper_bounds, dtype=np.float32)
+    n, F = X.shape
+    lib = get_lib()
+    if lib is None:
+        out = np.empty((n, F), dtype=np.int32)
+        for f in range(F):
+            out[:, f] = np.searchsorted(ub[f], X[:, f], side="left")
+        out[np.isnan(X)] = 0
+        return out
+    out = np.empty((n, F), dtype=np.int32)
+    lib.mm_bin_batch(
+        X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n, F,
+        ub.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), ub.shape[1],
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out
+
+
+def csv_read_floats(text: str, ncols: int,
+                    max_rows: Optional[int] = None) -> np.ndarray:
+    """Parse numeric CSV text -> float32[rows, ncols]; raises on ragged rows."""
+    data = text.encode("utf-8") if isinstance(text, str) else text
+    lib = get_lib()
+    if max_rows is None:
+        max_rows = data.count(b"\n") + 1
+    if lib is None:
+        def parse(p: str) -> float:
+            p = p.strip()
+            if not p:
+                return np.nan
+            try:
+                return float(p)
+            except ValueError:
+                return np.nan      # same as the native parser: bad field=NaN
+
+        rows = []
+        for line in data.decode("utf-8").splitlines():
+            if not line.strip():
+                continue
+            parts = line.split(",")
+            if len(parts) != ncols:
+                raise ValueError(f"expected {ncols} columns, got {len(parts)}")
+            rows.append([parse(p) for p in parts])
+            if len(rows) >= max_rows:
+                break
+        return np.asarray(rows, dtype=np.float32)
+    out = np.empty((max_rows, ncols), dtype=np.float32)
+    n = lib.mm_csv_read_floats(
+        data, len(data), ncols,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), max_rows)
+    if n < 0:
+        raise ValueError(f"CSV shape mismatch: expected {ncols} columns")
+    return out[:n]

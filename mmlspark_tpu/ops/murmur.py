@@ -20,14 +20,26 @@ _C2 = 0x1B873593
 _MASK = 0xFFFFFFFF
 
 
+def _native_lib():
+    """Lazy handle to the compiled host runtime (None if unavailable)."""
+    from ..native import get_lib
+    return get_lib()
+
+
 def _rotl32(x: int, r: int) -> int:
     return ((x << r) | (x >> (32 - r))) & _MASK
 
 
 def murmur3_32(data: Union[bytes, str], seed: int = 0) -> int:
-    """MurmurHash3_x86_32 of ``data`` with ``seed``; returns uint32."""
+    """MurmurHash3_x86_32 of ``data`` with ``seed``; returns uint32.
+
+    Uses the native C++ runtime when available (exact same algorithm — see
+    native/mmlspark_native.cpp mm_murmur3_32); pure-Python otherwise."""
     if isinstance(data, str):
         data = data.encode("utf-8")
+    lib = _native_lib()
+    if lib is not None:
+        return int(lib.mm_murmur3_32(data, len(data), seed & _MASK))
     n = len(data)
     h = seed & _MASK
     nblocks = n // 4

@@ -1,0 +1,174 @@
+// Native host runtime for mmlspark_tpu: the C++ side of the framework.
+//
+// The reference grafts native learners onto the JVM via JNI
+// (reference: core/env/NativeLoader.java:28-140 extracts lib_lightgbm.so etc.;
+// vw JNI class VowpalWabbitMurmur provides the hash that defines feature
+// identity; LGBM_DatasetCreateFromMat bins features natively). Here the
+// device-side math lives in XLA/Pallas; this library is the *host* runtime:
+// the data-plane hot loops that feed the device — batch feature hashing,
+// quantile-bin application, and float CSV ingestion — exposed C-ABI for
+// ctypes (no pybind11 dependency).
+//
+// Build: g++ -O3 -march=native -shared -fPIC mmlspark_native.cpp -o ...
+// (driven by mmlspark_tpu/native/__init__.py with a pure-Python fallback).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// MurmurHash3_x86_32 (Austin Appleby, public domain) — must match
+// mmlspark_tpu/ops/murmur.py bit-for-bit: hashing defines feature identity.
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85ebca6b;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35;
+  h ^= h >> 16;
+  return h;
+}
+
+uint32_t mm_murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
+  const int64_t nblocks = len / 4;
+  uint32_t h1 = seed;
+  const uint32_t c1 = 0xcc9e2d51;
+  const uint32_t c2 = 0x1b873593;
+
+  for (int64_t i = 0; i < nblocks; i++) {
+    uint32_t k1;
+    std::memcpy(&k1, data + 4 * i, 4);  // little-endian hosts only
+    k1 *= c1;
+    k1 = rotl32(k1, 15);
+    k1 *= c2;
+    h1 ^= k1;
+    h1 = rotl32(h1, 13);
+    h1 = h1 * 5 + 0xe6546b64;
+  }
+
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k1 = 0;
+  switch (len & 3) {
+    case 3: k1 ^= tail[2] << 16; [[fallthrough]];
+    case 2: k1 ^= tail[1] << 8;  [[fallthrough]];
+    case 1:
+      k1 ^= tail[0];
+      k1 *= c1;
+      k1 = rotl32(k1, 15);
+      k1 *= c2;
+      h1 ^= k1;
+  }
+
+  h1 ^= (uint32_t)len;
+  return fmix32(h1);
+}
+
+// Batch: n strings packed into one utf-8 buffer with offsets[n+1]; one seed
+// per string (the VW namespace hash). Out: n uint32 hashes.
+void mm_murmur3_batch(const uint8_t* buf, const int64_t* offsets,
+                      const uint32_t* seeds, int64_t n, uint32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = mm_murmur3_32(buf + offsets[i], offsets[i + 1] - offsets[i],
+                           seeds[i]);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Quantile-bin application (GBDT dataset construction). Matches
+// ops/binning.py: bin b iff upper[b-1] < v <= upper[b]; NaN -> bin 0;
+// searchsorted-left over per-feature upper bounds.
+// ---------------------------------------------------------------------------
+
+void mm_bin_batch(const float* X, int64_t n, int64_t F, const float* bounds,
+                  int64_t B1 /* = max_bin - 1 */, int32_t* out) {
+  for (int64_t r = 0; r < n; r++) {
+    const float* row = X + r * F;
+    int32_t* orow = out + r * F;
+    for (int64_t f = 0; f < F; f++) {
+      float v = row[f];
+      if (std::isnan(v)) {
+        orow[f] = 0;
+        continue;
+      }
+      const float* ub = bounds + f * B1;
+      // branch-light binary search: first index where ub[i] >= v
+      int64_t lo = 0, hi = B1;
+      while (lo < hi) {
+        int64_t mid = (lo + hi) >> 1;
+        if (ub[mid] < v) lo = mid + 1; else hi = mid;
+      }
+      orow[f] = (int32_t)lo;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Float CSV ingestion (data loader). Parses a comma/newline-delimited buffer
+// of numerics into a dense float32 matrix. Returns rows parsed, or -1 on a
+// column-count mismatch. Empty fields and "nan" parse to NaN.
+// ---------------------------------------------------------------------------
+
+static inline bool is_blank(const char* s, const char* e) {
+  for (; s < e; s++)
+    if (*s != ' ' && *s != '\t' && *s != '\r') return false;
+  return true;
+}
+
+int64_t mm_csv_read_floats(const char* buf, int64_t len, int64_t ncols,
+                           float* out, int64_t max_rows) {
+  // Line-by-line with bounded fields, matching the Python fallback exactly:
+  // blank lines are skipped; fields are trimmed; empty/unparseable -> NaN.
+  int64_t row = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+  char field[128];
+  while (p < end && row < max_rows) {
+    const char* eol = (const char*)memchr(p, '\n', end - p);
+    if (eol == nullptr) eol = end;
+    if (is_blank(p, eol)) {  // skip blank lines (python: `if not strip()`)
+      p = eol + 1;
+      continue;
+    }
+    int64_t col = 0;
+    const char* f = p;
+    while (true) {
+      const char* fe = (const char*)memchr(f, ',', eol - f);
+      const char* fend = fe ? fe : eol;
+      if (col >= ncols) return -1;
+      // trim surrounding whitespace/CR, parse within the bounded field
+      const char* a = f;
+      const char* b = fend;
+      while (a < b && (*a == ' ' || *a == '\t' || *a == '\r')) a++;
+      while (b > a && (*(b - 1) == ' ' || *(b - 1) == '\t' || *(b - 1) == '\r'))
+        b--;
+      if (a == b) {
+        out[row * ncols + col] = NAN;  // empty field
+      } else if (b - a >= (int64_t)sizeof(field)) {
+        return -1;  // absurdly long numeric field
+      } else {
+        std::memcpy(field, a, b - a);
+        field[b - a] = '\0';
+        char* parsed_end = nullptr;
+        float v = strtof(field, &parsed_end);
+        out[row * ncols + col] = (parsed_end == field + (b - a)) ? v : NAN;
+      }
+      col++;
+      if (!fe) break;
+      f = fe + 1;
+    }
+    if (col != ncols) return -1;
+    row++;
+    p = eol + 1;
+  }
+  return row;
+}
+
+}  // extern "C"
