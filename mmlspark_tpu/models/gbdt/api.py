@@ -12,6 +12,7 @@ but the mesh defines the actual topology.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import numpy as np
@@ -66,6 +67,15 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
                        "(reference: LightGBMBase.scala:28-50)", 0, TypeConverters.to_int)
     modelString = Param("modelString", "Warm-start model string", None,
                         TypeConverters.to_string)
+    checkpointDir = Param("checkpointDir",
+                          "Step-level checkpoint directory: training saves "
+                          "every checkpointInterval iterations and resumes "
+                          "from the newest checkpoint (preemption-safe; "
+                          "extends the reference's model-level warm start)",
+                          None, TypeConverters.to_string)
+    checkpointInterval = Param("checkpointInterval",
+                               "Iterations between checkpoints", 10,
+                               TypeConverters.to_int)
     verbosity = Param("verbosity", "Log verbosity", -1, TypeConverters.to_int)
     # cluster-compat params: topology comes from the device mesh on TPU
     parallelism = Param("parallelism", "data_parallel or voting_parallel "
@@ -140,6 +150,8 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
 
         num_batches = self.get_or_default("numBatches")
         common = dict(
+            checkpoint_dir=self.get_or_default("checkpointDir"),
+            checkpoint_period=self.get_or_default("checkpointInterval"),
             objective=objective, num_class=num_class,
             cfg=self._grow_config(),
             max_bin=self.get_or_default("maxBin"),
@@ -162,8 +174,14 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
             n = len(y)
             bounds = np.linspace(0, n, num_batches + 1).astype(int)
             booster = init_booster
+            base_ckpt = common.get("checkpoint_dir")
             for i in range(num_batches):
                 sl = slice(bounds[i], bounds[i + 1])
+                if base_ckpt:
+                    # one subdir per batch: batch i must never resume from
+                    # batch i-1's mid-train checkpoint
+                    common["checkpoint_dir"] = os.path.join(
+                        base_ckpt, f"batch_{i:04d}")
                 booster = train_booster(
                     X[sl], y[sl], None if w is None else w[sl],
                     num_iterations=num_iterations, valid_set=valid_set,

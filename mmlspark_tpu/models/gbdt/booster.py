@@ -289,6 +289,8 @@ def train_booster(
     boosting_type: str = "gbdt",
     top_rate: float = 0.2,
     other_rate: float = 0.1,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_period: int = 10,
 ) -> Booster:
     """Train a boosted ensemble, rows sharded over the mesh ``data`` axis.
 
@@ -298,6 +300,44 @@ def train_booster(
     ``iteration_callback`` is the delegate hook
     (reference: lightgbm/LightGBMDelegate.scala).
     """
+    # --- step-level checkpoint resume (SURVEY.md §5): the newest checkpoint
+    # becomes the warm-start booster and already-completed iterations are
+    # skipped; the caller's init_booster is subsumed (training that produced
+    # the checkpoint already started from it). Checkpoints carry a
+    # data+config fingerprint — a stale checkpoint from different data or
+    # hyperparameters is ignored, not silently resumed.
+    ckpt_mgr = None
+    ckpt_fingerprint = None
+    iterations_done = 0
+    user_init_booster = init_booster
+    resume_state: Optional[dict] = None
+    if checkpoint_dir is not None:
+        from ...utils.checkpoint import CheckpointManager, data_fingerprint
+        ckpt_mgr = CheckpointManager(checkpoint_dir)
+        cfg_norm = (cfg or GrowConfig())._replace(num_bins=max_bin)
+        ckpt_fingerprint = data_fingerprint(
+            np.asarray(X, np.float32), np.asarray(y, np.float32),
+            None if weight is None else np.asarray(weight, np.float32),
+            config=(objective, num_class, cfg_norm, max_bin, feature_fraction,
+                    bagging_fraction, bagging_freq, seed, boosting_type,
+                    top_rate, other_rate, sorted((objective_kwargs or
+                                                  {}).items())))
+        latest = ckpt_mgr.latest()
+        if latest is not None:
+            step, payload = latest
+            if payload.get("fingerprint") != ckpt_fingerprint:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "checkpoint in %s was written for different data/config; "
+                    "starting fresh", checkpoint_dir)
+            else:
+                init_booster = Booster.from_string(payload["model"])
+                iterations_done = payload["iteration"] + 1
+                resume_state = payload
+                if iterations_done >= num_iterations:
+                    # checkpoint already covers the request: truncate to it
+                    return _truncate_booster(init_booster, num_iterations)
+
     mesh = mesh or meshlib.get_default_mesh()
     cfg = cfg or GrowConfig()
     cfg = cfg._replace(num_bins=max_bin)
@@ -467,9 +507,28 @@ def train_booster(
     higher_is_better = metric_name in HIGHER_IS_BETTER
     best_metric = -np.inf if higher_is_better else np.inf
     best_iter, rounds_no_improve = -1, 0
+    if resume_state is not None:
+        # continue the early-stopping bookkeeping exactly where it stopped
+        best_metric = resume_state.get("best_metric", best_metric)
+        best_iter = resume_state.get("best_iter", best_iter)
+        rounds_no_improve = resume_state.get("rounds_no_improve", 0)
+        history = resume_state.get("history", history)
+
+    def _finalize(trees_list: List[Tree]) -> Booster:
+        stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *trees_list)
+        upper = binner.bin_upper_raw()  # [F, B]
+        thr_raw = upper[stacked.feat, np.minimum(stacked.thr_bin, max_bin - 1)]
+        thr_raw = np.where(stacked.is_leaf, np.float32(np.inf), thr_raw)
+        b = Booster(stacked, thr_raw.astype(np.float32), K, base,
+                    objective, depth_cap, binner.state(),
+                    best_iteration=best_iter, eval_history=history,
+                    objective_kwargs=objective_kwargs)
+        if init_booster is not None:
+            b = _merge_boosters(init_booster, b)
+        return b
 
     base_key = jax.random.PRNGKey(seed)
-    for it in range(num_iterations):
+    for it in range(iterations_done, num_iterations):
         key = jax.random.fold_in(base_key, it)
         # GOSS resamples every iteration; bagging reuses its subsample for
         # bagging_freq rounds (LightGBM semantics)
@@ -503,19 +562,23 @@ def train_booster(
         elif iteration_callback is not None:
             iteration_callback(it, {})
 
-    stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *all_trees)
-    n_trees = stacked.feat.shape[0]
-    upper = binner.bin_upper_raw()  # [F, B]
-    thr_raw = upper[stacked.feat, np.minimum(stacked.thr_bin, max_bin - 1)]
-    thr_raw = np.where(stacked.is_leaf, np.float32(np.inf), thr_raw)
+        if (ckpt_mgr is not None and checkpoint_period > 0
+                and (it + 1) % checkpoint_period == 0
+                and it + 1 < num_iterations):
+            ckpt_mgr.save(it, {"model": _finalize(all_trees).model_string(),
+                               "iteration": it,
+                               "fingerprint": ckpt_fingerprint,
+                               "best_metric": best_metric,
+                               "best_iter": best_iter,
+                               "rounds_no_improve": rounds_no_improve,
+                               "history": history})
 
-    booster = Booster(stacked, thr_raw.astype(np.float32), K, base,
-                      objective, depth_cap, binner.state(),
-                      best_iteration=best_iter, eval_history=history,
-                      objective_kwargs=objective_kwargs)
-    if init_booster is not None:
-        booster = _merge_boosters(init_booster, booster)
-    if early_stopping_rounds > 0 and best_iter >= 0 and init_booster is None:
+    booster = _finalize(all_trees)
+    # early-stop truncation applies to fresh runs and checkpoint resumes
+    # alike (the checkpoint's trees carry global iteration indices); only a
+    # user-supplied warm-start booster suppresses it, as before.
+    if (early_stopping_rounds > 0 and best_iter >= 0
+            and user_init_booster is None):
         booster = _truncate_booster(booster, best_iter + 1)
     return booster
 

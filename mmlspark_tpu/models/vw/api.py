@@ -48,6 +48,11 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
     passThroughArgs = Param("passThroughArgs", "VW-style argument string", "",
                             TypeConverters.to_string)
     initialModel = Param("initialModel", "Warm-start weights", None, is_complex=True)
+    checkpointDir = Param("checkpointDir",
+                          "Pass-level checkpoint directory: each finished "
+                          "pass saves full optimizer state and training "
+                          "resumes from the newest one (preemption-safe)",
+                          None, TypeConverters.to_string)
 
     def _parse_args(self) -> dict:
         """Map the supported subset of VW command-line args onto config."""
@@ -116,9 +121,17 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
         wcol = self.get_or_default("weightCol")
         sw = dataset.array(wcol, np.float32) if wcol else None
         init = self.get_or_default("initialModel")
+        ckpt_dir = self.get_or_default("checkpointDir")
         sw_time = StopWatch()
         with sw_time:
-            weights = train_sgd(idx, val, y, sw, cfg, initial_weights=init)
+            if ckpt_dir:
+                from .sgd import train_sgd_checkpointed
+                weights = train_sgd_checkpointed(idx, val, y, sw, cfg,
+                                                 ckpt_dir,
+                                                 initial_weights=init)
+            else:
+                weights = train_sgd(idx, val, y, sw, cfg,
+                                    initial_weights=init)
         stats = {
             "numExamples": len(y),
             "learnTimeNs": sw_time.elapsed_ns(),

@@ -42,6 +42,9 @@ class SGDConfig(NamedTuple):
     link: str = "identity"
 
 
+_SGD_FN_CACHE: dict = {}
+
+
 def _loss_grad(loss: str, pred, y, tau: float):
     """d(loss)/d(prediction). Labels: classifier y in {0,1}; regressor real."""
     if loss == "squared":
@@ -61,8 +64,15 @@ def _loss_grad(loss: str, pred, y, tau: float):
 def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
               sample_weight: Optional[np.ndarray], cfg: SGDConfig,
               mesh: Optional[Mesh] = None,
-              initial_weights: Optional[np.ndarray] = None) -> np.ndarray:
-    """Train a hashed linear model; returns the weight vector [2^num_bits]."""
+              initial_weights: Optional[np.ndarray] = None,
+              initial_state: Optional[tuple] = None,
+              return_state: bool = False):
+    """Train a hashed linear model; returns the weight vector [2^num_bits].
+
+    ``initial_state``/``return_state`` carry the full optimizer state
+    (weights, adagrad accumulators, step counter) across calls so pass-level
+    checkpoint/resume reproduces an uninterrupted run exactly
+    (see ``train_sgd_checkpointed``)."""
     mesh = mesh or meshlib.get_default_mesh()
     D = 1 << cfg.num_bits
     n = indices.shape[0]
@@ -91,7 +101,7 @@ def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
     lr = cfg.learning_rate
     eps = 1e-6
 
-    def local_train(idx, val, y, sw, w):
+    def local_train(idx, val, y, sw, w, g2_0, t_0):
         n_local = idx.shape[0]
         nb = n_local // bs
         idx_b = idx.reshape(nb, bs, nnz)
@@ -127,18 +137,94 @@ def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
             g2 = lax.pmean(g2, "data")
             return (w, g2, t), None
 
-        g2 = jnp.zeros_like(w)
-        t = jnp.float32(cfg.initial_t)
-        (w, g2, t), _ = lax.scan(one_pass, (w, g2, t), None, length=cfg.num_passes)
+        (w, g2, t), _ = lax.scan(one_pass, (w, g2_0, t_0), None,
+                                 length=cfg.num_passes)
+        w_out = w
         if cfg.l1 > 0:  # truncate-at-end approximation of lazy L1
-            w = jnp.sign(w) * jnp.maximum(jnp.abs(w) - cfg.l1, 0.0)
-        return w
+            w_out = jnp.sign(w) * jnp.maximum(jnp.abs(w) - cfg.l1, 0.0)
+        # raw (pre-L1) state continues across checkpointed calls
+        return w_out, w, g2, t
 
-    fn = jax.jit(jax.shard_map(
-        local_train, mesh=mesh,
-        in_specs=(P("data", None), P("data", None), P("data"), P("data"), P()),
-        out_specs=P(), check_vma=False))
-    return np.asarray(fn(idx_d, val_d, y_d, sw_d, jnp.asarray(w0)))
+    # compiled-step cache: pass-by-pass checkpointed training re-enters with
+    # identical (cfg, shapes, mesh) and must reuse one XLA executable rather
+    # than re-jitting a fresh closure every pass
+    cache_key = (cfg, nnz, D, tuple(mesh.axis_names),
+                 tuple(d.id for d in mesh.devices.flat))
+    fn = _SGD_FN_CACHE.get(cache_key)
+    if fn is None:
+        fn = jax.jit(jax.shard_map(
+            local_train, mesh=mesh,
+            in_specs=(P("data", None), P("data", None), P("data"), P("data"),
+                      P(), P(), P()),
+            out_specs=P(), check_vma=False))
+        _SGD_FN_CACHE[cache_key] = fn
+        while len(_SGD_FN_CACHE) > 32:
+            _SGD_FN_CACHE.pop(next(iter(_SGD_FN_CACHE)))
+    if initial_state is not None:
+        w_raw, g2_0, t_0 = initial_state
+        w0 = np.asarray(w_raw, np.float32)
+        g2_0 = jnp.asarray(g2_0)
+        t_0 = jnp.float32(t_0)
+    else:
+        g2_0 = jnp.zeros(D, jnp.float32)
+        t_0 = jnp.float32(cfg.initial_t)
+    w_out, w_raw, g2, t = fn(idx_d, val_d, y_d, sw_d, jnp.asarray(w0),
+                             g2_0, t_0)
+    if return_state:
+        return np.asarray(w_out), (np.asarray(w_raw), np.asarray(g2),
+                                   float(t))
+    return np.asarray(w_out)
+
+
+def train_sgd_checkpointed(indices: np.ndarray, values: np.ndarray,
+                           labels: np.ndarray,
+                           sample_weight: Optional[np.ndarray],
+                           cfg: SGDConfig, checkpoint_dir: str,
+                           mesh: Optional[Mesh] = None,
+                           initial_weights: Optional[np.ndarray] = None
+                           ) -> np.ndarray:
+    """Multi-pass SGD with pass-level checkpoint/resume (SURVEY.md §5).
+
+    Each pass runs as one device call whose full optimizer state (raw
+    weights, adagrad accumulators, step counter) is checkpointed; resuming
+    reproduces the uninterrupted run exactly. L1 truncation (a train-end
+    post-pass in VW) applies only on the final pass."""
+    from ...utils.checkpoint import CheckpointManager, data_fingerprint
+
+    mgr = CheckpointManager(checkpoint_dir)
+    fingerprint = data_fingerprint(
+        indices, values, labels,
+        None if sample_weight is None else np.asarray(sample_weight),
+        None if initial_weights is None else np.asarray(initial_weights),
+        config=cfg._replace(num_passes=0))    # pass count may legally change
+    latest = mgr.latest()
+    start_pass, state = 0, None
+    if latest is not None:
+        _, payload = latest
+        if payload.get("fingerprint") != fingerprint:
+            import logging
+            logging.getLogger(__name__).warning(
+                "checkpoint in %s was written for different data/config; "
+                "starting fresh", checkpoint_dir)
+        else:
+            start_pass = payload["pass"] + 1
+            state = payload["state"]
+            if start_pass >= cfg.num_passes:
+                raise ValueError(
+                    f"checkpoint in {checkpoint_dir} already covers "
+                    f"{start_pass} passes but only {cfg.num_passes} were "
+                    "requested; clear the directory or raise numPasses")
+    w = initial_weights
+    for p in range(start_pass, cfg.num_passes):
+        is_last = p == cfg.num_passes - 1
+        one = cfg._replace(num_passes=1, l1=cfg.l1 if is_last else 0.0)
+        w, state = train_sgd(indices, values, labels, sample_weight, one,
+                             mesh=mesh, initial_weights=w,
+                             initial_state=state, return_state=True)
+        if not is_last:
+            mgr.save(p, {"pass": p, "state": state,
+                         "fingerprint": fingerprint})
+    return w
 
 
 def predict_sgd(indices: np.ndarray, values: np.ndarray, weights: np.ndarray,

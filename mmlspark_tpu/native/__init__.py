@@ -82,35 +82,46 @@ def get_lib() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib_tried:
             return _lib
+        lib = _load()
+        if lib is not None:
+            _bind(lib)
+            _lib = lib
+        # published last: the lock-free fast path must never observe
+        # _lib_tried=True while the compile/bind is still in flight
         _lib_tried = True
-        if os.environ.get("MMLSPARK_TPU_DISABLE_NATIVE"):
-            return None
-        so = _compile()
-        if so is None:
-            return None
-        try:
-            lib = ctypes.CDLL(so)
-        except OSError:
-            return None
-        lib.mm_murmur3_32.restype = ctypes.c_uint32
-        lib.mm_murmur3_32.argtypes = [ctypes.c_char_p, ctypes.c_int64,
-                                      ctypes.c_uint32]
-        lib.mm_murmur3_batch.restype = None
-        lib.mm_murmur3_batch.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_uint32)]
-        lib.mm_bin_batch.restype = None
-        lib.mm_bin_batch.argtypes = [
-            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int32)]
-        lib.mm_csv_read_floats.restype = ctypes.c_int64
-        lib.mm_csv_read_floats.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
-        _lib = lib
         return _lib
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("MMLSPARK_TPU_DISABLE_NATIVE"):
+        return None
+    so = _compile()
+    if so is None:
+        return None
+    try:
+        return ctypes.CDLL(so)
+    except OSError:
+        return None
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.mm_murmur3_32.restype = ctypes.c_uint32
+    lib.mm_murmur3_32.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                  ctypes.c_uint32]
+    lib.mm_murmur3_batch.restype = None
+    lib.mm_murmur3_batch.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint32)]
+    lib.mm_bin_batch.restype = None
+    lib.mm_bin_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.mm_csv_read_floats.restype = ctypes.c_int64
+    lib.mm_csv_read_floats.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
 
 
 def native_available() -> bool:

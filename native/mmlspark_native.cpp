@@ -129,7 +129,6 @@ int64_t mm_csv_read_floats(const char* buf, int64_t len, int64_t ncols,
   int64_t row = 0;
   const char* p = buf;
   const char* end = buf + len;
-  char field[128];
   while (p < end && row < max_rows) {
     const char* eol = (const char*)memchr(p, '\n', end - p);
     if (eol == nullptr) eol = end;
@@ -151,14 +150,13 @@ int64_t mm_csv_read_floats(const char* buf, int64_t len, int64_t ncols,
         b--;
       if (a == b) {
         out[row * ncols + col] = NAN;  // empty field
-      } else if (b - a >= (int64_t)sizeof(field)) {
-        return -1;  // absurdly long numeric field
       } else {
-        std::memcpy(field, a, b - a);
-        field[b - a] = '\0';
+        // parse in place: strtof stops at the delimiter on its own (',' and
+        // '\n' are invalid float chars; the ctypes buffer is NUL-terminated
+        // at the very end), and a partial parse means a bad field -> NaN
         char* parsed_end = nullptr;
-        float v = strtof(field, &parsed_end);
-        out[row * ncols + col] = (parsed_end == field + (b - a)) ? v : NAN;
+        float v = strtof(a, &parsed_end);
+        out[row * ncols + col] = (parsed_end == b) ? v : NAN;
       }
       col++;
       if (!fe) break;

@@ -1,0 +1,137 @@
+"""Step-level checkpoint/resume tests (SURVEY.md §5: first-class on TPU).
+
+Covers the CheckpointManager primitives, GBDT mid-train resume (result must
+predict like an uninterrupted run), and exact-state SGD pass resume.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.utils.checkpoint import CheckpointManager
+
+
+def test_manager_roundtrip_prune_atomic(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    for step in [3, 7, 11, 15]:
+        mgr.save(step, {"w": np.arange(step)})
+    assert mgr.steps() == [11, 15]             # pruned to newest 2
+    step, payload = mgr.latest()
+    assert step == 15
+    np.testing.assert_array_equal(payload["w"], np.arange(15))
+    # stray tmp files are never listed
+    (tmp_path / "ck" / "ckpt_0000000001.pkl.123.tmp").write_bytes(b"junk")
+    assert mgr.steps() == [11, 15]
+
+
+def _gbdt_data(n=300, seed=5):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.float64)
+    return Dataset({"features": X, "label": y})
+
+
+def test_gbdt_checkpoint_resume(tmp_path):
+    from mmlspark_tpu.models.gbdt.api import LightGBMClassifier
+
+    ds = _gbdt_data()
+    ckpt = str(tmp_path / "gbdt")
+
+    # interrupted run: train 6 of 12 iterations (checkpoint every 3)
+    partial = LightGBMClassifier(numIterations=6, numLeaves=7, minDataInLeaf=5,
+                                 checkpointDir=ckpt, checkpointInterval=3)
+    partial.fit(ds)
+    mgr = CheckpointManager(ckpt)
+    assert mgr.steps(), "no checkpoint written during training"
+
+    # resumed run: same estimator config but full 12 iterations
+    resumed = LightGBMClassifier(numIterations=12, numLeaves=7,
+                                 minDataInLeaf=5, checkpointDir=ckpt,
+                                 checkpointInterval=3).fit(ds)
+    assert resumed.booster.num_iterations == 12
+
+    acc = (resumed.transform(ds).array("prediction")
+           == ds.array("label")).mean()
+    assert acc > 0.9
+
+    # a full-iterations checkpoint resumes to an immediate result
+    again = LightGBMClassifier(numIterations=12, numLeaves=7, minDataInLeaf=5,
+                               checkpointDir=ckpt,
+                               checkpointInterval=3).fit(ds)
+    assert again.booster.num_iterations == 12
+
+
+def test_gbdt_stale_checkpoint_ignored(tmp_path):
+    """A checkpoint written for different data must not be resumed: refit on
+    new data starts fresh (fingerprint guard)."""
+    from mmlspark_tpu.models.gbdt.api import LightGBMClassifier
+
+    ckpt = str(tmp_path / "gbdt")
+    ds1 = _gbdt_data(seed=5)
+    LightGBMClassifier(numIterations=6, numLeaves=7, minDataInLeaf=5,
+                       checkpointDir=ckpt, checkpointInterval=3).fit(ds1)
+    assert CheckpointManager(ckpt).steps()
+
+    ds2 = _gbdt_data(seed=99)                 # different data, same shapes
+    fresh = LightGBMClassifier(numIterations=6, numLeaves=7, minDataInLeaf=5,
+                               checkpointDir=ckpt,
+                               checkpointInterval=3).fit(ds2)
+    plain = LightGBMClassifier(numIterations=6, numLeaves=7,
+                               minDataInLeaf=5).fit(ds2)
+    np.testing.assert_allclose(fresh.transform(ds2).array("probability"),
+                               plain.transform(ds2).array("probability"),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_stale_pass_count_raises(tmp_path):
+    from mmlspark_tpu.models.vw.sgd import SGDConfig, train_sgd_checkpointed
+
+    rng = np.random.default_rng(3)
+    idx = rng.integers(0, 1 << 8, size=(32, 3)).astype(np.int32)
+    val = rng.normal(size=(32, 3)).astype(np.float32)
+    y = rng.normal(size=32).astype(np.float32)
+    ck = str(tmp_path / "sgd")
+    cfg = SGDConfig(num_bits=8, num_passes=4)
+    train_sgd_checkpointed(idx, val, y, None, cfg, ck)
+    with pytest.raises(ValueError, match="already covers"):
+        train_sgd_checkpointed(idx, val, y, None,
+                               cfg._replace(num_passes=2), ck)
+
+
+def test_sgd_checkpoint_exact_resume(tmp_path):
+    """Interrupted + resumed SGD must equal the uninterrupted run exactly
+    (full optimizer state is carried, not just weights)."""
+    from mmlspark_tpu.models.vw.sgd import (SGDConfig, train_sgd,
+                                            train_sgd_checkpointed)
+
+    rng = np.random.default_rng(0)
+    n, nnz = 64, 4
+    idx = rng.integers(0, 1 << 10, size=(n, nnz)).astype(np.int32)
+    val = rng.normal(size=(n, nnz)).astype(np.float32)
+    y = rng.normal(size=n).astype(np.float32)
+    cfg = SGDConfig(num_bits=10, num_passes=4, l1=1e-4)
+
+    expect = train_sgd(idx, val, y, None, cfg)
+
+    # run passes 0..1 "then crash": simulate by a 2-pass config sharing the dir
+    ck = str(tmp_path / "sgd")
+    train_sgd_checkpointed(idx, val, y, None, cfg._replace(num_passes=2), ck)
+    # resume to the full 4 passes
+    got = train_sgd_checkpointed(idx, val, y, None, cfg, ck)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-7)
+
+
+def test_vw_api_checkpoint_param(tmp_path):
+    from mmlspark_tpu.models.vw.api import VowpalWabbitRegressor
+    from mmlspark_tpu.models.vw.featurizer import VowpalWabbitFeaturizer
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(100, 4)).astype(np.float32)
+    y = X @ np.asarray([1.0, -2.0, 0.5, 0.0], np.float32)
+    ds = VowpalWabbitFeaturizer(inputCols=["x"], outputCol="features").transform(
+        Dataset({"x": [v for v in X], "label": y.astype(np.float64)}))
+    ck = str(tmp_path / "vw")
+    m1 = VowpalWabbitRegressor(numPasses=3, checkpointDir=ck).fit(ds)
+    assert CheckpointManager(ck).steps()       # pass checkpoints exist
+    m2 = VowpalWabbitRegressor(numPasses=3).fit(ds)
+    np.testing.assert_allclose(m1.weights, m2.weights, rtol=1e-5, atol=1e-7)

@@ -134,13 +134,15 @@ def test_csv_edge_cases_match_fallback(monkeypatch):
     """Leading blank lines, padded fields, bad fields: identical on both
     paths (behavior must not depend on toolchain availability)."""
     import mmlspark_tpu.native as nat
-    text = "\n1, 2 ,3\n\n4,abc,  \n7,8,9\n"
+    long_field = "1." + "0" * 200 + "5"     # >128 chars, still a valid float
+    text = f"\n1, 2 ,3\n\n4,abc,  \n7,8,{long_field}\n"
     native_out = csv_read_floats(text, 3)
     monkeypatch.setattr(nat, "_lib", None)
     monkeypatch.setattr(nat, "_lib_tried", True)
     py_out = csv_read_floats(text, 3)
     assert native_out.shape == py_out.shape == (3, 3)
     np.testing.assert_allclose(native_out[0], [1, 2, 3])
+    np.testing.assert_allclose(native_out[2], [7, 8, 1.0])
     assert np.isnan(native_out[1, 1]) and np.isnan(native_out[1, 2])
     np.testing.assert_array_equal(np.isnan(native_out), np.isnan(py_out))
     np.testing.assert_allclose(native_out[~np.isnan(native_out)],
