@@ -1,0 +1,13 @@
+"""CLI: ``python -m mmlspark_tpu.codegen [output_dir]`` — emit the generated
+``mmlspark`` compat namespace, API reference, and smoke tests (the build-time
+codegen step; reference: sbt packagePythonTask at build.sbt:204-247)."""
+
+import sys
+
+from . import generate_all
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else "python_api"
+    result = generate_all(out)
+    print(f"wrote {len(result['namespace_files'])} namespace modules, "
+          f"{result['docs']}, {result['tests']}")

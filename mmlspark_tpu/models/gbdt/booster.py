@@ -318,25 +318,26 @@ def train_booster(
         ckpt_fingerprint = data_fingerprint(
             np.asarray(X, np.float32), np.asarray(y, np.float32),
             None if weight is None else np.asarray(weight, np.float32),
+            # the warm-start model is part of run identity: resuming a
+            # checkpoint that subsumed a *different* init would be silent
             config=(objective, num_class, cfg_norm, max_bin, feature_fraction,
                     bagging_fraction, bagging_freq, seed, boosting_type,
-                    top_rate, other_rate, sorted((objective_kwargs or
-                                                  {}).items())))
-        latest = ckpt_mgr.latest()
+                    top_rate, other_rate,
+                    sorted((objective_kwargs or {}).items()),
+                    None if user_init_booster is None
+                    else user_init_booster.model_string()))
+        latest = ckpt_mgr.latest_matching(ckpt_fingerprint)
         if latest is not None:
             step, payload = latest
-            if payload.get("fingerprint") != ckpt_fingerprint:
-                import logging
-                logging.getLogger(__name__).warning(
-                    "checkpoint in %s was written for different data/config; "
-                    "starting fresh", checkpoint_dir)
-            else:
-                init_booster = Booster.from_string(payload["model"])
-                iterations_done = payload["iteration"] + 1
-                resume_state = payload
-                if iterations_done >= num_iterations:
-                    # checkpoint already covers the request: truncate to it
-                    return _truncate_booster(init_booster, num_iterations)
+            init_booster = Booster.from_string(payload["model"])
+            iterations_done = payload["iteration"] + 1
+            resume_state = payload
+            if iterations_done >= num_iterations:
+                # checkpoint already covers the request: truncate to the
+                # warm-start prefix plus the requested trained iterations
+                prior = payload.get("prior_iterations", 0)
+                return _truncate_booster(init_booster,
+                                         prior + num_iterations)
 
     mesh = mesh or meshlib.get_default_mesh()
     cfg = cfg or GrowConfig()
@@ -568,6 +569,9 @@ def train_booster(
             ckpt_mgr.save(it, {"model": _finalize(all_trees).model_string(),
                                "iteration": it,
                                "fingerprint": ckpt_fingerprint,
+                               "prior_iterations":
+                                   0 if user_init_booster is None
+                                   else user_init_booster.num_iterations,
                                "best_metric": best_metric,
                                "best_iter": best_iter,
                                "rounds_no_improve": rounds_no_improve,

@@ -42,7 +42,10 @@ class SGDConfig(NamedTuple):
     link: str = "identity"
 
 
-_SGD_FN_CACHE: dict = {}
+from collections import OrderedDict
+
+_SGD_FN_CACHE: "OrderedDict" = OrderedDict()  # LRU, same pattern as
+_SGD_FN_CACHE_MAX = 32                        # booster._STEP_CACHE
 
 
 def _loss_grad(loss: str, pred, y, tau: float):
@@ -61,28 +64,14 @@ def _loss_grad(loss: str, pred, y, tau: float):
     raise ValueError(f"unknown loss {loss!r}")
 
 
-def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
-              sample_weight: Optional[np.ndarray], cfg: SGDConfig,
-              mesh: Optional[Mesh] = None,
-              initial_weights: Optional[np.ndarray] = None,
-              initial_state: Optional[tuple] = None,
-              return_state: bool = False):
-    """Train a hashed linear model; returns the weight vector [2^num_bits].
-
-    ``initial_state``/``return_state`` carry the full optimizer state
-    (weights, adagrad accumulators, step counter) across calls so pass-level
-    checkpoint/resume reproduces an uninterrupted run exactly
-    (see ``train_sgd_checkpointed``)."""
-    mesh = mesh or meshlib.get_default_mesh()
-    D = 1 << cfg.num_bits
+def _prep_sgd_data(indices: np.ndarray, values: np.ndarray,
+                   labels: np.ndarray, sample_weight: Optional[np.ndarray],
+                   cfg: SGDConfig, mesh: Mesh) -> tuple:
+    """Pad + shard the dataset onto the mesh once; reused across passes by
+    the checkpointed trainer so resume doesn't redo full-data transfers."""
     n = indices.shape[0]
-    nnz = indices.shape[1]
-    w0 = (np.zeros(D, np.float32) if initial_weights is None
-          else np.asarray(initial_weights, np.float32))
-
     sw = np.ones(n, np.float32) if sample_weight is None else np.asarray(
         sample_weight, np.float32)
-
     nshards = meshlib.num_shards(mesh)
     bs = cfg.batch_size
     # pad rows so each shard has a whole number of batches
@@ -97,7 +86,34 @@ def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
     val_d, _ = meshlib.shard_rows(val_p, mesh)
     y_d, _ = meshlib.shard_rows(y_p, mesh)
     sw_d, _ = meshlib.shard_rows(sw_p, mesh)
+    return idx_d, val_d, y_d, sw_d
 
+
+def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
+              sample_weight: Optional[np.ndarray], cfg: SGDConfig,
+              mesh: Optional[Mesh] = None,
+              initial_weights: Optional[np.ndarray] = None,
+              initial_state: Optional[tuple] = None,
+              return_state: bool = False,
+              prepped: Optional[tuple] = None):
+    """Train a hashed linear model; returns the weight vector [2^num_bits].
+
+    ``initial_state``/``return_state`` carry the full optimizer state
+    (weights, adagrad accumulators, step counter) across calls so pass-level
+    checkpoint/resume reproduces an uninterrupted run exactly
+    (see ``train_sgd_checkpointed``). ``prepped`` (from ``_prep_sgd_data``)
+    skips the per-call pad/shard/transfer."""
+    mesh = mesh or meshlib.get_default_mesh()
+    D = 1 << cfg.num_bits
+    nnz = indices.shape[1]
+    w0 = (np.zeros(D, np.float32) if initial_weights is None
+          else np.asarray(initial_weights, np.float32))
+    if prepped is None:
+        prepped = _prep_sgd_data(indices, values, labels, sample_weight, cfg,
+                                 mesh)
+    idx_d, val_d, y_d, sw_d = prepped
+
+    bs = cfg.batch_size
     lr = cfg.learning_rate
     eps = 1e-6
 
@@ -158,8 +174,10 @@ def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
                       P(), P(), P()),
             out_specs=P(), check_vma=False))
         _SGD_FN_CACHE[cache_key] = fn
-        while len(_SGD_FN_CACHE) > 32:
-            _SGD_FN_CACHE.pop(next(iter(_SGD_FN_CACHE)))
+        while len(_SGD_FN_CACHE) > _SGD_FN_CACHE_MAX:
+            _SGD_FN_CACHE.popitem(last=False)
+    else:
+        _SGD_FN_CACHE.move_to_end(cache_key)
     if initial_state is not None:
         w_raw, g2_0, t_0 = initial_state
         w0 = np.asarray(w_raw, np.float32)
@@ -197,30 +215,32 @@ def train_sgd_checkpointed(indices: np.ndarray, values: np.ndarray,
         None if sample_weight is None else np.asarray(sample_weight),
         None if initial_weights is None else np.asarray(initial_weights),
         config=cfg._replace(num_passes=0))    # pass count may legally change
-    latest = mgr.latest()
+    latest = mgr.latest_matching(fingerprint)
     start_pass, state = 0, None
     if latest is not None:
         _, payload = latest
-        if payload.get("fingerprint") != fingerprint:
-            import logging
-            logging.getLogger(__name__).warning(
-                "checkpoint in %s was written for different data/config; "
-                "starting fresh", checkpoint_dir)
-        else:
-            start_pass = payload["pass"] + 1
-            state = payload["state"]
-            if start_pass >= cfg.num_passes:
-                raise ValueError(
-                    f"checkpoint in {checkpoint_dir} already covers "
-                    f"{start_pass} passes but only {cfg.num_passes} were "
-                    "requested; clear the directory or raise numPasses")
+        start_pass = payload["pass"] + 1
+        state = payload["state"]
+        if start_pass >= cfg.num_passes:
+            raise ValueError(
+                f"checkpoint in {checkpoint_dir} already covers "
+                f"{start_pass} passes but only {cfg.num_passes} were "
+                "requested; clear the directory or raise numPasses")
+    mesh = mesh or meshlib.get_default_mesh()
+    prepped = None
     w = initial_weights
     for p in range(start_pass, cfg.num_passes):
         is_last = p == cfg.num_passes - 1
         one = cfg._replace(num_passes=1, l1=cfg.l1 if is_last else 0.0)
+        if prepped is None:
+            # pad/shard/transfer once; identical for every pass (batch_size
+            # is the only prep-relevant cfg field and it doesn't vary)
+            prepped = _prep_sgd_data(indices, values, labels, sample_weight,
+                                     one, mesh)
         w, state = train_sgd(indices, values, labels, sample_weight, one,
                              mesh=mesh, initial_weights=w,
-                             initial_state=state, return_state=True)
+                             initial_state=state, return_state=True,
+                             prepped=prepped)
         if not is_last:
             mgr.save(p, {"pass": p, "state": state,
                          "fingerprint": fingerprint})

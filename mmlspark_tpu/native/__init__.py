@@ -82,13 +82,17 @@ def get_lib() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib_tried:
             return _lib
-        lib = _load()
-        if lib is not None:
-            _bind(lib)
-            _lib = lib
-        # published last: the lock-free fast path must never observe
-        # _lib_tried=True while the compile/bind is still in flight
-        _lib_tried = True
+        try:
+            lib = _load()
+            if lib is not None:
+                _bind(lib)
+                _lib = lib
+        finally:
+            # published last (the lock-free fast path must never observe
+            # _lib_tried=True mid-compile), but always published — a failed
+            # attempt latches to the Python fallback instead of re-running
+            # the compile on every call
+            _lib_tried = True
         return _lib
 
 

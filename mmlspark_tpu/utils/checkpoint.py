@@ -95,3 +95,27 @@ class CheckpointManager:
                 os.remove(self._path(step))
             except OSError:
                 pass
+
+    def latest_matching(self, fingerprint: str,
+                        purge_stale: bool = True
+                        ) -> Optional[Tuple[int, Dict[str, Any]]]:
+        """Newest checkpoint whose stored fingerprint matches.
+
+        Stale checkpoints (from a previous run with different data/config in
+        a reused directory) are removed when ``purge_stale`` — otherwise a
+        higher-numbered stale file would forever shadow the new run's valid
+        checkpoints in ``latest()`` and defeat resume."""
+        best = None
+        for step in self.steps():
+            try:
+                payload = self.load(step)
+            except Exception:
+                continue
+            if payload.get("fingerprint") == fingerprint:
+                best = (step, payload)
+            elif purge_stale:
+                try:
+                    os.remove(self._path(step))
+                except OSError:
+                    pass
+        return best
