@@ -77,6 +77,12 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
                                "Iterations between checkpoints", 10,
                                TypeConverters.to_int)
     verbosity = Param("verbosity", "Log verbosity", -1, TypeConverters.to_int)
+    growthPolicy = Param("growthPolicy",
+                         "leafwise (LightGBM-parity best-first, one histogram "
+                         "pass per split) or depthwise (TPU-throughput mode: "
+                         "one batched histogram pass per level, num_leaves "
+                         "budget enforced best-gain-first)", "leafwise",
+                         TypeConverters.to_string)
     # cluster-compat params: topology comes from the device mesh on TPU
     parallelism = Param("parallelism", "data_parallel or voting_parallel "
                         "(mesh collectives implement both)", "data_parallel",
@@ -118,6 +124,7 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
             min_gain_to_split=self.get_or_default("minGainToSplit"),
             voting=self.get_or_default("parallelism") == "voting_parallel",
             top_k=self.get_or_default("topK"),
+            growth_policy=self.get_or_default("growthPolicy"),
         )
 
     def _extract_arrays(self, dataset: Dataset):

@@ -20,12 +20,14 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...ops.binning import QuantileBinner
 from ...parallel import mesh as meshlib
-from .growth import (GrowConfig, Tree, grow_tree, predict_forest_raw,
+from .growth import (GrowConfig, Tree, grow_tree, grow_tree_depthwise,
+                     predict_forest_raw,
                      predict_tree_binned)
 from .objectives import (HIGHER_IS_BETTER, Objective, eval_metric,
                          get_objective)
@@ -451,9 +453,11 @@ def train_booster(
             u = jax.random.uniform(fkey, (F,))
             fmask = u < feature_fraction
             fmask = fmask.at[jnp.argmin(u)].set(True)  # guarantee >=1 feature
+        grow = (grow_tree_depthwise if cfg.growth_policy == "depthwise"
+                else grow_tree)
         for k in range(K):
-            tree, row_node = grow_tree(binned, grad[:, k], hess[:, k], row_mask,
-                                       fmask, cfg, axis_name="data")
+            tree, row_node = grow(binned, grad[:, k], hess[:, k], row_mask,
+                                  fmask, cfg, axis_name="data")
             scores = scores.at[:, k].add(tree.leaf_value[row_node])
             trees_out.append(tree)
         trees_stacked = jax.tree_util.tree_map(
@@ -490,7 +494,8 @@ def train_booster(
     # per call, so jit's identity-keyed cache would otherwise recompile
     cache_key = (cfg, K, objective, tuple(sorted(objective_kwargs.items())),
                  Xb_d.shape, None if not has_valid else Xvb_d.shape,
-                 use_bagging, bagging_fraction, feature_fraction, depth_cap,
+                 use_bagging, bagging_fraction, bagging_freq,
+                 feature_fraction, depth_cap,
                  use_goss, top_rate, other_rate, mesh)
     step = _STEP_CACHE.get(cache_key)
     if step is None:
@@ -515,18 +520,64 @@ def train_booster(
         rounds_no_improve = resume_state.get("rounds_no_improve", 0)
         history = resume_state.get("history", history)
 
+    # --- fused fast path: no validation loop, no delegate callbacks, no
+    # checkpointing, no resume -> run every iteration inside ONE compiled
+    # scan. One device dispatch instead of num_iterations round-trips, which
+    # dominates wall time on remote-attached TPUs.
+    fuse = (not has_valid and iteration_callback is None and ckpt_mgr is None
+            and iterations_done == 0)
+    if fuse:
+        fuse_key = (cache_key, num_iterations, seed, "fused")
+        multi = _STEP_CACHE.get(fuse_key)
+        if multi is None:
+            def multi_local(binned_l, yl, wl, vmask_l, scores_l):
+                base_key = jax.random.PRNGKey(seed)
+
+                def it_body(scores_c, it):
+                    key = jax.random.fold_in(base_key, it)
+                    if use_goss:
+                        bag_step = it
+                    elif use_bagging:
+                        bag_step = it // max(bagging_freq, 1)
+                    else:
+                        bag_step = 0
+                    bag_key = jax.random.fold_in(base_key, 1_000_003 + bag_step)
+                    d = jnp.zeros((), jnp.float32)
+                    scores_c, _, trees_stacked, _ = step_local(
+                        binned_l, yl, wl, vmask_l, scores_c, d, d, d, d,
+                        key, bag_key)
+                    return scores_c, trees_stacked
+
+                _, trees_seq = lax.scan(
+                    it_body, scores_l,
+                    jnp.arange(num_iterations, dtype=jnp.int32))
+                return trees_seq
+
+            multi = jax.jit(jax.shard_map(
+                multi_local, mesh=mesh,
+                in_specs=(row2_spec, row_spec, row_spec, row_spec, row2_spec),
+                out_specs=P(), check_vma=False))
+            _STEP_CACHE[fuse_key] = multi
+            while len(_STEP_CACHE) > _STEP_CACHE_MAX:
+                _STEP_CACHE.popitem(last=False)
+        else:
+            _STEP_CACHE.move_to_end(fuse_key)
+        trees_seq = jax.tree_util.tree_map(
+            np.asarray, multi(Xb_d, y_d, w_d, vmask_d, scores_d))
+        all_seq: List[Tree] = []
+        for it in range(num_iterations):
+            for k in range(K):
+                all_seq.append(jax.tree_util.tree_map(
+                    lambda a: a[it, k], trees_seq))
+        booster = _finalize_trees(all_seq, binner, max_bin, K, base, objective,
+                                  depth_cap, objective_kwargs, -1,
+                                  {metric_name: []}, init_booster)
+        return booster
+
     def _finalize(trees_list: List[Tree]) -> Booster:
-        stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *trees_list)
-        upper = binner.bin_upper_raw()  # [F, B]
-        thr_raw = upper[stacked.feat, np.minimum(stacked.thr_bin, max_bin - 1)]
-        thr_raw = np.where(stacked.is_leaf, np.float32(np.inf), thr_raw)
-        b = Booster(stacked, thr_raw.astype(np.float32), K, base,
-                    objective, depth_cap, binner.state(),
-                    best_iteration=best_iter, eval_history=history,
-                    objective_kwargs=objective_kwargs)
-        if init_booster is not None:
-            b = _merge_boosters(init_booster, b)
-        return b
+        return _finalize_trees(trees_list, binner, max_bin, K, base,
+                               objective, depth_cap, objective_kwargs,
+                               best_iter, history, init_booster)
 
     base_key = jax.random.PRNGKey(seed)
     for it in range(iterations_done, num_iterations):
@@ -585,6 +636,25 @@ def train_booster(
             and user_init_booster is None):
         booster = _truncate_booster(booster, best_iter + 1)
     return booster
+
+
+def _finalize_trees(trees_list: List[Tree], binner, max_bin: int, K: int,
+                    base, objective: str, depth_cap: int,
+                    objective_kwargs: Optional[dict], best_iter: int,
+                    history: Dict[str, List[float]],
+                    init_booster: Optional[Booster]) -> Booster:
+    """Stack grown trees into a Booster (raw thresholds from bin bounds)."""
+    stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *trees_list)
+    upper = binner.bin_upper_raw()  # [F, B]
+    thr_raw = upper[stacked.feat, np.minimum(stacked.thr_bin, max_bin - 1)]
+    thr_raw = np.where(stacked.is_leaf, np.float32(np.inf), thr_raw)
+    b = Booster(stacked, thr_raw.astype(np.float32), K, base,
+                objective, depth_cap, binner.state(),
+                best_iteration=best_iter, eval_history=history,
+                objective_kwargs=objective_kwargs)
+    if init_booster is not None:
+        b = _merge_boosters(init_booster, b)
+    return b
 
 
 def _truncate_booster(b: Booster, num_iterations: int) -> Booster:

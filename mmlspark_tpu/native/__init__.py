@@ -87,11 +87,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
             if lib is not None:
                 _bind(lib)
                 _lib = lib
+        except Exception:
+            # corrupt cached .so, missing symbols, etc.: latch to the
+            # Python fallback rather than crashing the first caller
+            _lib = None
         finally:
             # published last (the lock-free fast path must never observe
             # _lib_tried=True mid-compile), but always published — a failed
-            # attempt latches to the Python fallback instead of re-running
-            # the compile on every call
+            # attempt latches instead of re-running the compile per call
             _lib_tried = True
         return _lib
 

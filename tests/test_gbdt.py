@@ -391,3 +391,23 @@ class TestParallelModes:
                                    boostingType="goss").fit(_to_ds(Xtr, ytr))
         p = model.transform(_to_ds(Xte, yte))["probability"][:, 1]
         assert roc_auc_score(yte, p) > 0.95
+
+    def test_depthwise_growth_matches_quality(self):
+        """growthPolicy=depthwise (one batched histogram pass per level)
+        must match best-first quality; save/load keeps predicting."""
+        Xtr, Xte, ytr, yte = _binary_data()
+        model = LightGBMClassifier(numIterations=15,
+                                   growthPolicy="depthwise").fit(
+            _to_ds(Xtr, ytr))
+        p = model.transform(_to_ds(Xte, yte))["probability"][:, 1]
+        assert roc_auc_score(yte, p) > BASELINE_BINARY_AUC
+        # leaf budget respected (count only allocated node slots)
+        nodes = int(model.booster.trees.node_count[0])
+        assert model.booster.trees.is_leaf[0][:nodes].sum() <= 31
+
+    def test_depthwise_voting_rejected(self):
+        Xtr, _, ytr, _ = _binary_data()
+        with pytest.raises(NotImplementedError):
+            LightGBMClassifier(numIterations=2, growthPolicy="depthwise",
+                               parallelism="voting_parallel").fit(
+                _to_ds(Xtr, ytr))
