@@ -1,0 +1,45 @@
+"""Online text classification, VW-style: hashed features + SGD.
+
+The "Vowpal Wabbit - Overview" sample of the reference: murmur-hashed sparse
+featurization (feature identity matches VW's hashing) feeding an XLA-compiled
+online SGD with pass-end AllReduce averaging.
+"""
+
+import numpy as np
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.models.vw import (VowpalWabbitClassifier,
+                                    VowpalWabbitFeaturizer)
+
+POS = ["great", "excellent", "wonderful", "amazing", "superb"]
+NEG = ["terrible", "awful", "poor", "boring", "bad"]
+
+
+def main():
+    rng = np.random.default_rng(0)
+    texts, labels = [], []
+    for _ in range(1500):
+        y = int(rng.random() > 0.5)
+        pool = POS if y else NEG
+        words = rng.choice(pool, 3).tolist() + rng.choice(
+            ["movie", "film", "plot", "cast"], 2).tolist()
+        rng.shuffle(words)
+        texts.append(" ".join(words))
+        labels.append(float(y))
+    ds = Dataset({"text": texts, "label": np.asarray(labels)})
+
+    featurized = VowpalWabbitFeaturizer(
+        inputCols=["text"], stringSplitInputCols=["text"],
+        outputCol="features").transform(ds)
+    model = VowpalWabbitClassifier(numPasses=3).fit(featurized)
+
+    out = model.transform(featurized)
+    acc = float((out.array("prediction") == ds.array("label")).mean())
+    print("accuracy:", round(acc, 4))
+    print(model.get_performance_statistics().row(0))
+    assert acc > 0.95
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Transfer learning: pretrained-CNN featurization -> classic classifier.
+
+The "DeepLearning - Flower Image Classification" sample of the reference:
+ModelDownloader fetches a catalog CNN, ImageFeaturizer cuts its head and
+emits embeddings, and a GBDT trains on them (reference:
+image/ImageFeaturizer.scala:40-191 + downloader/ModelDownloader.scala).
+"""
+
+import tempfile
+
+import numpy as np
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.models.dnn.downloader import ModelDownloader
+from mmlspark_tpu.models.dnn.scoring import DNNModel, ImageFeaturizer
+from mmlspark_tpu.models.gbdt.api import LightGBMClassifier
+
+
+def main():
+    rng = np.random.default_rng(0)
+    # two synthetic "classes": bright-ish vs dark-ish images
+    imgs, labels = [], []
+    for _ in range(60):
+        y = int(rng.random() > 0.5)
+        base = 170 if y else 80
+        imgs.append(rng.normal(base, 30, (64, 64, 3)).clip(0, 255)
+                    .astype(np.uint8))
+        labels.append(float(y))
+    ds = Dataset({"img": imgs, "label": np.asarray(labels)})
+
+    with tempfile.TemporaryDirectory() as repo:
+        downloader = ModelDownloader(repo)
+        print("catalog:", [m.name for m in downloader.remote_models()])
+        schema = downloader.download_model("ResNet10Micro")
+        dnn = DNNModel.from_downloader(repo, schema.name)
+
+    featurizer = (ImageFeaturizer(dnn, input_hw=(64, 64))
+                  .set(inputCol="img", outputCol="features"))
+    feats = featurizer.transform(ds)
+    print("embedding dim:", np.asarray(feats["features"]).shape[1])
+
+    model = LightGBMClassifier(numIterations=20, numLeaves=7,
+                               minDataInLeaf=3).fit(feats)
+    acc = float((model.transform(feats).array("prediction")
+                 == ds.array("label")).mean())
+    print("train accuracy:", round(acc, 4))
+    assert acc > 0.9
+    return acc
+
+
+if __name__ == "__main__":
+    main()

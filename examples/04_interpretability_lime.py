@@ -1,0 +1,34 @@
+"""Model interpretability with LIME (tabular).
+
+The "Interpretability - Tabular SHAP/LIME" sample of the reference: perturb
+around each row, score the perturbations through the trained model in one
+batched device pass, fit a local lasso — the informative feature should
+dominate the explanation weights (reference: lime/LIME.scala:166-249).
+"""
+
+import numpy as np
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.explain.lime import TabularLIME
+from mmlspark_tpu.models.gbdt.api import LightGBMClassifier
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n = 600
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (X[:, 3] > 0).astype(np.float64)       # only feature 3 matters
+    ds = Dataset({"features": X, "label": y})
+
+    model = LightGBMClassifier(numIterations=20, numLeaves=7).fit(ds)
+    lime = TabularLIME(model=model, inputCol="features",
+                       outputCol="weights", nSamples=300).fit(ds)
+    out = lime.transform(Dataset({"features": X[:5]}))
+    W = np.abs(np.asarray(out["weights"]))
+    print("explanation weights (first row):", np.round(W[0], 4))
+    assert (W.argmax(axis=1) == 3).all()
+    return W
+
+
+if __name__ == "__main__":
+    main()

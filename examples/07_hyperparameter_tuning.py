@@ -1,0 +1,42 @@
+"""AutoML: random-search hyperparameter tuning with cross-validation.
+
+The "HyperParameterTuning - Fighting Breast Cancer" sample of the reference
+(automl/TuneHyperparameters.scala:37-235): define a space, sweep it with
+k-fold CV, keep the best model.
+"""
+
+import numpy as np
+
+from mmlspark_tpu.automl.core import (DiscreteHyperParam, HyperparamBuilder,
+                                      RandomSpace, RangeHyperParam,
+                                      TuneHyperparameters)
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.models.gbdt.api import LightGBMClassifier
+
+
+def main():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 6)).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 1] + 0.2 * rng.normal(size=400) > 0
+         ).astype(np.float64)
+    ds = Dataset({"features": X, "label": y})
+
+    space = (HyperparamBuilder()
+             .add_hyperparam("numLeaves", DiscreteHyperParam([7, 15, 31]))
+             .add_hyperparam("learningRate", RangeHyperParam(0.05, 0.3))
+             .add_hyperparam("numIterations", DiscreteHyperParam([10, 20]))
+             .build())
+    tuned = TuneHyperparameters(
+        models=[LightGBMClassifier(minDataInLeaf=3)],
+        evaluationMetric="accuracy", numFolds=3, numRuns=6,
+        paramSpace=RandomSpace(space, seed=1)).fit(ds)
+
+    print("best CV accuracy:", round(tuned.get_or_default("bestMetric"), 4))
+    acc = float((tuned.transform(ds).array("prediction") == y).mean())
+    print("refit train accuracy:", round(acc, 4))
+    assert acc > 0.9
+    return acc
+
+
+if __name__ == "__main__":
+    main()

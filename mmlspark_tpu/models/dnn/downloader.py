@@ -57,6 +57,12 @@ def retry_with_timeout(fn, retries: int = 3, backoff: float = 0.5):
 
 _BUILTIN = {
     # name -> (stage_sizes, width, num_classes, input_hw)
+    # full-width families (the featurizer catalog the reference fetches from
+    # its Azure repo — downloader/ModelDownloader.scala:37-276; weights here
+    # are deterministic random inits, pending a hosted weight repo)
+    "ResNet18": ((2, 2, 2, 2), 64, 1000, (224, 224)),
+    "ResNet34": ((3, 4, 6, 3), 64, 1000, (224, 224)),
+    # small variants for tests / CI
     "ResNet18Tiny": ((2, 2, 2, 2), 16, 1000, (224, 224)),
     "ResNet10Micro": ((1, 1, 1, 1), 8, 1000, (64, 64)),
     "ConvNetMNIST": ((1, 1), 8, 10, (28, 28)),

@@ -88,6 +88,23 @@ def test_api_docs_generated(generated, tmp_path):
     assert "## mmlspark.cyber" in text
 
 
+def test_r_wrappers_generated(generated):
+    out, result = generated
+    assert any(p.endswith("mmlspark_lightgbm.R") for p in result["r_files"])
+    core = next(p for p in result["r_files"]
+               if p.endswith("mmlspark_runtime.R"))
+    assert "mmlspark_initialize" in open(core).read()
+    lgbm = next(p for p in result["r_files"]
+                if p.endswith("mmlspark_lightgbm.R"))
+    text = open(lgbm).read()
+    assert "ml_light_g_b_m_classifier <- function(...)" in text \
+        or "ml_light_gbm_classifier" in text or "LightGBMClassifier" in text
+    # balanced braces (rough syntax sanity for every generated R file)
+    for p in result["r_files"]:
+        s = open(p).read()
+        assert s.count("{") == s.count("}"), p
+
+
 def test_generated_smoke_tests_pass(generated):
     out, result = generated
     env = dict(os.environ, PYTHONPATH=out + os.pathsep +

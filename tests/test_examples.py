@@ -1,0 +1,26 @@
+"""Every example script must run end-to-end (the reference's notebook-test
+leg: nbtest/NotebookTests.scala executes all sample notebooks)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+SCRIPTS = sorted(f for f in os.listdir(EXAMPLES_DIR) if f.endswith(".py"))
+
+
+@pytest.mark.parametrize("script", SCRIPTS)
+def test_example_runs(script):
+    path = os.path.join(EXAMPLES_DIR, script)
+    spec = importlib.util.spec_from_file_location(
+        f"example_{script[:-3]}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+        mod.main()
+    finally:
+        sys.modules.pop(spec.name, None)
