@@ -315,19 +315,26 @@ def train_booster(
     resume_state: Optional[dict] = None
     if checkpoint_dir is not None:
         from ...utils.checkpoint import CheckpointManager, data_fingerprint
-        ckpt_mgr = CheckpointManager(checkpoint_dir)
         cfg_norm = (cfg or GrowConfig())._replace(num_bins=max_bin)
         ckpt_fingerprint = data_fingerprint(
             np.asarray(X, np.float32), np.asarray(y, np.float32),
             None if weight is None else np.asarray(weight, np.float32),
             # the warm-start model is part of run identity: resuming a
-            # checkpoint that subsumed a *different* init would be silent
-            config=(objective, num_class, cfg_norm, max_bin, feature_fraction,
+            # checkpoint that subsumed a *different* init would be silent.
+            # Every param that shapes the trained model belongs here —
+            # bin_sample_count/boost_from_average change bin boundaries /
+            # the base score, so a changed value must invalidate resume.
+            config=(objective, num_class, cfg_norm, max_bin, bin_sample_count,
+                    boost_from_average, feature_fraction,
                     bagging_fraction, bagging_freq, seed, boosting_type,
                     top_rate, other_rate,
                     sorted((objective_kwargs or {}).items()),
                     None if user_init_booster is None
                     else user_init_booster.model_string()))
+        # namespaced by fingerprint: concurrent runs sharing checkpoint_dir
+        # (sweeps) never purge each other's files
+        ckpt_mgr = CheckpointManager(checkpoint_dir,
+                                     namespace=ckpt_fingerprint[:12])
         latest = ckpt_mgr.latest_matching(ckpt_fingerprint)
         if latest is not None:
             step, payload = latest

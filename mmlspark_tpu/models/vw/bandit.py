@@ -68,11 +68,21 @@ class ContextualBanditMetrics:
 
 
 def _stack_actions(col) -> Tuple[np.ndarray, np.ndarray]:
-    """Ragged per-row action lists -> ([n, K_max, d] padded, [n, K_max] mask)."""
+    """Ragged per-row action lists -> ([n, K_max, d] padded, [n, K_max] mask).
+
+    Rows with zero actions are legal at scoring time (mask all-zero, empty
+    probability list downstream); the action dimensionality comes from the
+    first non-empty row.
+    """
     n = len(col)
     ks = [len(row) for row in col]
     k_max = max(ks) if ks else 1
-    d = len(np.asarray(col[0][0]).ravel())
+    k_max = max(k_max, 1)
+    d = 1
+    for row in col:
+        if len(row):
+            d = len(np.asarray(row[0]).ravel())
+            break
     out = np.zeros((n, k_max, d), dtype=np.float32)
     mask = np.zeros((n, k_max), dtype=np.float32)
     for i, row in enumerate(col):
@@ -87,7 +97,9 @@ def _epsilon_greedy(scores, mask, epsilon):
     1 - eps + eps/K, the rest eps/K each (VW --cb_explore_adf epsilon)."""
     import jax.numpy as jnp
 
-    k_valid = jnp.sum(mask, axis=-1, keepdims=True)
+    # max(k_valid, 1): a zero-action row divides by 1 and, with an all-zero
+    # mask, still yields all-zero probabilities instead of NaN
+    k_valid = jnp.maximum(jnp.sum(mask, axis=-1, keepdims=True), 1.0)
     masked = jnp.where(mask > 0, scores, jnp.inf)
     best = jnp.argmin(masked, axis=-1)
     base = (epsilon / k_valid) * mask

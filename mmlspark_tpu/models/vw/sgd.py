@@ -209,12 +209,13 @@ def train_sgd_checkpointed(indices: np.ndarray, values: np.ndarray,
     post-pass in VW) applies only on the final pass."""
     from ...utils.checkpoint import CheckpointManager, data_fingerprint
 
-    mgr = CheckpointManager(checkpoint_dir)
     fingerprint = data_fingerprint(
         indices, values, labels,
         None if sample_weight is None else np.asarray(sample_weight),
         None if initial_weights is None else np.asarray(initial_weights),
         config=cfg._replace(num_passes=0))    # pass count may legally change
+    # namespaced by fingerprint: sweeps sharing one dir don't purge each other
+    mgr = CheckpointManager(checkpoint_dir, namespace=fingerprint[:12])
     latest = mgr.latest_matching(fingerprint)
     start_pass, state = 0, None
     if latest is not None:

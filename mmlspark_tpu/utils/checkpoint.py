@@ -19,6 +19,7 @@ import hashlib
 import os
 import pickle
 import re
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -32,9 +33,13 @@ def data_fingerprint(*arrays, config: Any = None) -> str:
     Stored inside every checkpoint and compared on resume: a checkpoint
     written for different data or different hyperparameters must NOT be
     silently resumed (a refit on new data would otherwise skip straight to
-    the old run's tail). Samples head/tail bytes so huge arrays stay cheap.
+    the old run's tail). Small arrays are hashed in full; large ones combine
+    strided 4 KiB pages (sha256) with a full-content crc32 — the crc streams
+    at C speed (~1 GB/s) and catches any changed byte anywhere in the
+    buffer, including mid-buffer edits the old head/tail sampling missed.
     """
     h = hashlib.sha256()
+    page, max_pages = 4096, 64
     for a in arrays:
         if a is None:
             h.update(b"<none>")
@@ -42,32 +47,56 @@ def data_fingerprint(*arrays, config: Any = None) -> str:
         a = np.ascontiguousarray(a)
         h.update(str(a.shape).encode())
         h.update(str(a.dtype).encode())
-        raw = a.ravel().view(np.uint8)
-        h.update(raw[:4096].tobytes())
-        h.update(raw[-4096:].tobytes())
+        raw = a.reshape(-1).view(np.uint8)
+        nbytes = raw.size
+        if nbytes <= page * max_pages:
+            h.update(raw.tobytes())
+        else:
+            starts = np.linspace(0, nbytes - page, max_pages).astype(np.int64)
+            for s in starts:
+                h.update(raw[s:s + page].tobytes())
+            h.update(zlib.crc32(raw).to_bytes(4, "little"))
     if config is not None:
         h.update(repr(config).encode())
     return h.hexdigest()[:32]
 
 
 class CheckpointManager:
-    """Atomic step-indexed checkpoints in a directory, newest-``keep`` kept."""
+    """Atomic step-indexed checkpoints in a directory, newest-``keep`` kept.
 
-    def __init__(self, directory: str, keep: int = 3):
+    ``namespace`` (typically the run's data/config fingerprint) isolates
+    concurrent or alternating runs sharing one directory — e.g. a
+    hyperparameter sweep pointing every trial at the same checkpointDir —
+    so one run's stale-purge never deletes another run's files.
+    """
+
+    def __init__(self, directory: str, keep: int = 3,
+                 namespace: Optional[str] = None):
         self.directory = directory
         self.keep = max(1, int(keep))
+        self.namespace = namespace
+        # namespaced: see (and prune) only this run's files. Un-namespaced:
+        # see every checkpoint file regardless of namespace — the inspection
+        # mode ("are there checkpoints here?", "show me the newest").
+        self._re = (re.compile(rf"^ckpt_{re.escape(namespace)}_(\d+)\.pkl$")
+                    if namespace else
+                    re.compile(r"^ckpt_(?:[0-9a-f]+_)?(\d+)\.pkl$"))
         os.makedirs(directory, exist_ok=True)
 
     def _path(self, step: int) -> str:
-        return os.path.join(self.directory, f"ckpt_{step:010d}.pkl")
+        ns = f"{self.namespace}_" if self.namespace else ""
+        return os.path.join(self.directory, f"ckpt_{ns}{step:010d}.pkl")
 
-    def steps(self) -> List[int]:
+    def _files(self) -> List[Tuple[int, str]]:
         out = []
         for name in os.listdir(self.directory):
-            m = _CKPT_RE.match(name)
+            m = self._re.match(name)
             if m:
-                out.append(int(m.group(1)))
+                out.append((int(m.group(1)), name))
         return sorted(out)
+
+    def steps(self) -> List[int]:
+        return sorted({s for s, _ in self._files()})
 
     def save(self, step: int, payload: Dict[str, Any]) -> str:
         path = self._path(step)
@@ -79,7 +108,14 @@ class CheckpointManager:
         return path
 
     def load(self, step: int) -> Dict[str, Any]:
-        with open(self._path(step), "rb") as f:
+        path = self._path(step)
+        if not os.path.exists(path) and self.namespace is None:
+            # inspection mode: fall back to a namespaced file with this step
+            for s, name in self._files():
+                if s == step:
+                    path = os.path.join(self.directory, name)
+                    break
+        with open(path, "rb") as f:
             return pickle.load(f)
 
     def latest(self) -> Optional[Tuple[int, Dict[str, Any]]]:
@@ -90,9 +126,10 @@ class CheckpointManager:
         return step, self.load(step)
 
     def _prune(self) -> None:
-        for step in self.steps()[:-self.keep]:
+        files = self._files()
+        for _, name in files[:-self.keep]:
             try:
-                os.remove(self._path(step))
+                os.remove(os.path.join(self.directory, name))
             except OSError:
                 pass
 
@@ -101,21 +138,25 @@ class CheckpointManager:
                         ) -> Optional[Tuple[int, Dict[str, Any]]]:
         """Newest checkpoint whose stored fingerprint matches.
 
-        Stale checkpoints (from a previous run with different data/config in
-        a reused directory) are removed when ``purge_stale`` — otherwise a
-        higher-numbered stale file would forever shadow the new run's valid
-        checkpoints in ``latest()`` and defeat resume."""
+        Stale checkpoints (an interrupted earlier run of the SAME namespace
+        whose payload predates a fingerprint-format change, or — for
+        un-namespaced managers — any mismatching file) are removed when
+        ``purge_stale`` so a higher-numbered stale file can't shadow the new
+        run's checkpoints. Namespaced managers only ever see (and purge)
+        their own files, so concurrent runs sharing a directory are safe."""
         best = None
-        for step in self.steps():
+        for step, name in self._files():
+            path = os.path.join(self.directory, name)
             try:
-                payload = self.load(step)
+                with open(path, "rb") as f:
+                    payload = pickle.load(f)
             except Exception:
                 continue
             if payload.get("fingerprint") == fingerprint:
                 best = (step, payload)
             elif purge_stale:
                 try:
-                    os.remove(self._path(step))
+                    os.remove(path)
                 except OSError:
                     pass
         return best

@@ -12,6 +12,7 @@
 // Build: g++ -O3 -march=native -shared -fPIC mmlspark_native.cpp -o ...
 // (driven by mmlspark_tpu/native/__init__.py with a pure-Python fallback).
 
+#include <charconv>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -151,12 +152,16 @@ int64_t mm_csv_read_floats(const char* buf, int64_t len, int64_t ncols,
       if (a == b) {
         out[row * ncols + col] = NAN;  // empty field
       } else {
-        // parse in place: strtof stops at the delimiter on its own (',' and
-        // '\n' are invalid float chars; the ctypes buffer is NUL-terminated
-        // at the very end), and a partial parse means a bad field -> NaN
-        char* parsed_end = nullptr;
-        float v = strtof(a, &parsed_end);
-        out[row * ncols + col] = (parsed_end == b) ? v : NAN;
+        // std::from_chars: locale-independent (strtof honors LC_NUMERIC, so
+        // a comma-decimal host locale would silently NaN every field while
+        // the Python fallback parsed fine); bounded by [a, b), and a partial
+        // parse means a bad field -> NaN. from_chars rejects a leading '+'
+        // (Python's float() accepts it) — skip one explicit plus sign.
+        if (*a == '+' && b - a > 1 && *(a + 1) != '-' && *(a + 1) != '+') a++;
+        float v;
+        auto res = std::from_chars(a, b, v);
+        out[row * ncols + col] =
+            (res.ec == std::errc() && res.ptr == b) ? v : NAN;
       }
       col++;
       if (!fe) break;

@@ -143,3 +143,36 @@ def test_interactions_quadratic_count_and_values():
     nz = vals[vals != 0]
     assert len(nz) == 4                        # 2 x 2 active features
     assert sorted(nz.tolist()) == sorted([3.0, 4.0, 6.0, 8.0])
+
+
+def test_bandit_transform_empty_action_row():
+    """ADVICE r1: scoring must tolerate rows with zero offered actions
+    (empty probability list, no NaNs) even though fit() rejects them."""
+    import numpy as np
+    from mmlspark_tpu.core.dataset import Dataset
+    from mmlspark_tpu.models.vw.bandit import VowpalWabbitContextualBandit
+
+    rng = np.random.default_rng(0)
+    n = 40
+    ds = Dataset({
+        "shared": [rng.normal(size=3).astype(np.float32) for _ in range(n)],
+        "features": [[rng.normal(size=2).astype(np.float32) for _ in range(3)]
+                     for _ in range(n)],
+        "chosenAction": np.full(n, 1, dtype=np.int64),
+        "probability": np.full(n, 0.5),
+        "label": rng.normal(size=n),
+    })
+    model = VowpalWabbitContextualBandit(numPasses=1).fit(ds)
+
+    score_ds = Dataset({
+        "shared": [rng.normal(size=3).astype(np.float32) for _ in range(3)],
+        "features": [
+            [rng.normal(size=2).astype(np.float32) for _ in range(2)],
+            [],                                    # zero actions
+            [rng.normal(size=2).astype(np.float32)],
+        ],
+    })
+    out = model.transform(score_ds)["prediction"]
+    assert len(out[0]) == 2 and len(out[1]) == 0 and len(out[2]) == 1
+    assert np.isfinite(out[0]).all() and np.isfinite(out[2]).all()
+    assert abs(sum(out[0]) - 1.0) < 1e-5

@@ -135,3 +135,55 @@ def test_vw_api_checkpoint_param(tmp_path):
     assert CheckpointManager(ck).steps()       # pass checkpoints exist
     m2 = VowpalWabbitRegressor(numPasses=3).fit(ds)
     np.testing.assert_allclose(m1.weights, m2.weights, rtol=1e-5, atol=1e-7)
+
+
+def test_fingerprint_detects_middle_change():
+    """ADVICE r1: arrays differing only in the middle must fingerprint
+    differently (head/tail-only sampling missed them)."""
+    from mmlspark_tpu.utils.checkpoint import data_fingerprint
+
+    a = np.zeros(2_000_000, dtype=np.float32)
+    b = a.copy()
+    b[1_000_000] = 1.0                         # differs only mid-buffer
+    assert data_fingerprint(a) != data_fingerprint(b)
+    assert data_fingerprint(a) == data_fingerprint(a.copy())
+
+
+def test_namespaced_managers_do_not_purge_each_other(tmp_path):
+    """ADVICE r1: two runs (different fingerprints) sharing one checkpoint
+    dir must not destroy each other's files on resume probes."""
+    d = str(tmp_path / "shared")
+    m1 = CheckpointManager(d, namespace="aaaa11112222")
+    m2 = CheckpointManager(d, namespace="bbbb33334444")
+    m1.save(5, {"fingerprint": "fp1", "w": 1})
+    m2.save(9, {"fingerprint": "fp2", "w": 2})
+
+    # each run's resume probe sees only its own files; nothing is purged
+    assert m1.latest_matching("fp1")[0] == 5
+    assert m2.latest_matching("fp2")[0] == 9
+    assert m1.steps() == [5] and m2.steps() == [9]
+
+    # inspection (no namespace) sees both
+    insp = CheckpointManager(d)
+    assert insp.steps() == [5, 9]
+    assert insp.latest()[1]["w"] == 2
+
+
+def test_bin_sample_count_invalidates_gbdt_checkpoint(tmp_path):
+    """ADVICE r1: changing binSampleCount re-bins the data, so an old
+    checkpoint must not resume."""
+    from mmlspark_tpu.models.gbdt.api import LightGBMClassifier
+
+    ckpt = str(tmp_path / "gbdt")
+    ds = _gbdt_data()
+    LightGBMClassifier(numIterations=6, numLeaves=7, minDataInLeaf=5,
+                       checkpointDir=ckpt, checkpointInterval=3,
+                       binSampleCount=200).fit(ds)
+    fresh = LightGBMClassifier(numIterations=6, numLeaves=7, minDataInLeaf=5,
+                               checkpointDir=ckpt, checkpointInterval=3,
+                               binSampleCount=150).fit(ds)
+    plain = LightGBMClassifier(numIterations=6, numLeaves=7, minDataInLeaf=5,
+                               binSampleCount=150).fit(ds)
+    np.testing.assert_allclose(fresh.transform(ds).array("probability"),
+                               plain.transform(ds).array("probability"),
+                               rtol=1e-5, atol=1e-6)
