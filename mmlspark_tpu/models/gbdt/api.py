@@ -94,6 +94,14 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
                     TypeConverters.to_float)
     otherRate = Param("otherRate", "GOSS: random retain fraction of the rest", 0.1,
                       TypeConverters.to_float)
+    dropRate = Param("dropRate", "DART: per-tree dropout probability", 0.1,
+                     TypeConverters.to_float)
+    maxDrop = Param("maxDrop", "DART: max trees dropped per iteration", 50,
+                    TypeConverters.to_int)
+    skipDrop = Param("skipDrop", "DART: probability of skipping dropout for "
+                     "an iteration", 0.5, TypeConverters.to_float)
+    dropSeed = Param("dropSeed", "DART: dropout random seed", 4,
+                     TypeConverters.to_int)
     defaultListenPort = Param("defaultListenPort", "Ignored on TPU (no socket ring)",
                               12400, TypeConverters.to_int)
     timeout = Param("timeout", "Ignored on TPU (no rendezvous)", 1200.0,
@@ -174,6 +182,10 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
             boosting_type=self.get_or_default("boostingType"),
             top_rate=self.get_or_default("topRate"),
             other_rate=self.get_or_default("otherRate"),
+            drop_rate=self.get_or_default("dropRate"),
+            max_drop=self.get_or_default("maxDrop"),
+            skip_drop=self.get_or_default("skipDrop"),
+            drop_seed=self.get_or_default("dropSeed"),
         )
         num_iterations = self.get_or_default("numIterations")
         if num_batches and num_batches > 1:

@@ -291,6 +291,10 @@ def train_booster(
     boosting_type: str = "gbdt",
     top_rate: float = 0.2,
     other_rate: float = 0.1,
+    drop_rate: float = 0.1,
+    max_drop: int = 50,
+    skip_drop: float = 0.5,
+    drop_seed: int = 4,
     checkpoint_dir: Optional[str] = None,
     checkpoint_period: int = 10,
 ) -> Booster:
@@ -308,6 +312,27 @@ def train_booster(
     # the checkpoint already started from it). Checkpoints carry a
     # data+config fingerprint — a stale checkpoint from different data or
     # hyperparameters is ignored, not silently resumed.
+    if boosting_type not in ("gbdt", "goss", "rf", "dart"):
+        raise ValueError(
+            f"boostingType {boosting_type!r} is not supported "
+            "(supported: gbdt, rf, dart, goss)")
+    if boosting_type in ("rf", "dart"):
+        if init_booster is not None:
+            raise ValueError(
+                f"warm start (modelString/numBatches) is not supported with "
+                f"boostingType={boosting_type!r}: its trees carry "
+                "normalization state that a warm-start prefix lacks")
+        if checkpoint_dir is not None:
+            raise ValueError(
+                f"checkpointDir is not supported with "
+                f"boostingType={boosting_type!r} (gbdt/goss only)")
+    if boosting_type == "rf" and not (bagging_fraction < 1.0
+                                      and bagging_freq > 0):
+        raise ValueError(
+            "boostingType='rf' requires bagging: set baggingFraction < 1.0 "
+            "and baggingFreq > 0 (LightGBM semantics — without bagging every "
+            "random-forest tree would be identical)")
+
     ckpt_mgr = None
     ckpt_fingerprint = None
     iterations_done = 0
@@ -351,6 +376,10 @@ def train_booster(
     mesh = mesh or meshlib.get_default_mesh()
     cfg = cfg or GrowConfig()
     cfg = cfg._replace(num_bins=max_bin)
+    if boosting_type == "rf":
+        # random forest: no shrinkage; the averaged ensemble is scaled at
+        # finalize time instead (LightGBM rf semantics)
+        cfg = cfg._replace(learning_rate=1.0)
     objective_kwargs = objective_kwargs or {}
     obj = get_objective(objective, num_class, **objective_kwargs)
     K = obj.num_scores
@@ -409,18 +438,35 @@ def train_booster(
     depth_cap = cfg.max_depth if cfg.max_depth > 0 else max(1, cfg.num_leaves - 1)
     depth_cap = min(depth_cap, 2 * cfg.num_leaves)
 
-    if boosting_type not in ("gbdt", "goss"):
-        raise ValueError(
-            f"boosting_type {boosting_type!r} is not supported yet "
-            "(supported: gbdt, goss)")
     use_goss = boosting_type == "goss"
+    is_rf = boosting_type == "rf"
     use_bagging = (not use_goss) and bagging_fraction < 1.0 and bagging_freq > 0
     metric_name = eval_metric(obj, jnp.zeros((1, K)) if K > 1 else jnp.zeros(1),
                               jnp.zeros(1), jnp.ones(1), **objective_kwargs)[0]
 
+    if boosting_type == "dart":
+        return _train_dart(
+            mesh=mesh, cfg=cfg, K=K, obj=obj,
+            objective=objective, objective_kwargs=objective_kwargs,
+            Xb_d=Xb_d, y_d=y_d, w_d=w_d, vmask_d=vmask_d, base=base,
+            has_valid=has_valid, Xvb_d=Xvb_d, yv_d=yv_d, wv_d=wv_d,
+            depth_cap=depth_cap, metric_name=metric_name,
+            num_iterations=num_iterations, seed=seed,
+            feature_fraction=feature_fraction, use_bagging=use_bagging,
+            bagging_fraction=bagging_fraction, bagging_freq=bagging_freq,
+            early_stopping_rounds=early_stopping_rounds,
+            iteration_callback=iteration_callback,
+            metric_eval_period=metric_eval_period,
+            drop_rate=drop_rate, max_drop=max_drop, skip_drop=skip_drop,
+            drop_seed=drop_seed, binner=binner, max_bin=max_bin)
+
     def step_local(binned, yl, wl, vmask, scores, vbinned, vy, vw, vscores,
-                   key, bag_key):
-        """One boosting iteration on local shard rows (inside shard_map)."""
+                   key, bag_key, it_f):
+        """One boosting iteration on local shard rows (inside shard_map).
+
+        ``it_f``: f32 iteration index — used only by rf, whose validation
+        metric evaluates the *average* of the trees grown so far.
+        """
         if K > 1:
             grad, hess = obj.grad_hess(scores, yl, wl)
         else:
@@ -465,7 +511,10 @@ def train_booster(
         for k in range(K):
             tree, row_node = grow(binned, grad[:, k], hess[:, k], row_mask,
                                   fmask, cfg, axis_name="data")
-            scores = scores.at[:, k].add(tree.leaf_value[row_node])
+            if not is_rf:
+                # rf: trees are independent (gradients stay at the base
+                # score); gbdt/goss: boost on the updated margin
+                scores = scores.at[:, k].add(tree.leaf_value[row_node])
             trees_out.append(tree)
         trees_stacked = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *trees_out)
@@ -476,7 +525,13 @@ def train_booster(
                 tr = jax.tree_util.tree_map(lambda a: a[k], trees_stacked)
                 vscores = vscores.at[:, k].add(
                     predict_tree_binned(tr, vbinned, depth_cap))
-            sc = vscores if K > 1 else vscores[:, 0]
+            if is_rf:
+                # ensemble-so-far = base + average of accumulated raw trees
+                vbase = jnp.asarray(base)[None, :]
+                veval = vbase + (vscores - vbase) / (it_f + 1.0)
+            else:
+                veval = vscores
+            sc = veval if K > 1 else veval[:, 0]
             _, num = eval_metric(obj, sc, vy, vw, **objective_kwargs)
             # metric is a weighted mean: combine across shards
             wsum = jax.lax.psum(jnp.sum(vw), "data")
@@ -493,7 +548,7 @@ def train_booster(
     in_specs = (row2_spec, row_spec, row_spec, row_spec, row2_spec,
                 row2_spec if has_valid else P(), row_spec if has_valid else P(),
                 row_spec if has_valid else P(), row2_spec if has_valid else P(),
-                P(), P())
+                P(), P(), P())
     out_specs = (row2_spec, row2_spec if has_valid else P(), P(), P())
 
     dummy = np.zeros((), np.float32)
@@ -503,7 +558,7 @@ def train_booster(
                  Xb_d.shape, None if not has_valid else Xvb_d.shape,
                  use_bagging, bagging_fraction, bagging_freq,
                  feature_fraction, depth_cap,
-                 use_goss, top_rate, other_rate, mesh)
+                 boosting_type, top_rate, other_rate, mesh)
     step = _STEP_CACHE.get(cache_key)
     if step is None:
         step = jax.jit(jax.shard_map(
@@ -542,7 +597,7 @@ def train_booster(
 
                 def it_body(scores_c, it):
                     key = jax.random.fold_in(base_key, it)
-                    if use_goss:
+                    if use_goss or is_rf:
                         bag_step = it
                     elif use_bagging:
                         bag_step = it // max(bagging_freq, 1)
@@ -552,7 +607,7 @@ def train_booster(
                     d = jnp.zeros((), jnp.float32)
                     scores_c, _, trees_stacked, _ = step_local(
                         binned_l, yl, wl, vmask_l, scores_c, d, d, d, d,
-                        key, bag_key)
+                        key, bag_key, it.astype(jnp.float32))
                     return scores_c, trees_stacked
 
                 _, trees_seq = lax.scan(
@@ -579,6 +634,10 @@ def train_booster(
         booster = _finalize_trees(all_seq, binner, max_bin, K, base, objective,
                                   depth_cap, objective_kwargs, -1,
                                   {metric_name: []}, init_booster)
+        if is_rf:
+            booster = _scale_booster_values(
+                booster, np.full(booster.num_trees,
+                                 1.0 / booster.num_iterations))
         return booster
 
     def _finalize(trees_list: List[Tree]) -> Booster:
@@ -589,16 +648,18 @@ def train_booster(
     base_key = jax.random.PRNGKey(seed)
     for it in range(iterations_done, num_iterations):
         key = jax.random.fold_in(base_key, it)
-        # GOSS resamples every iteration; bagging reuses its subsample for
-        # bagging_freq rounds (LightGBM semantics)
-        bag_step = (it if use_goss
+        # GOSS resamples every iteration; rf re-bags every iteration too (its
+        # gradients are constant, so a reused bag would duplicate trees);
+        # gbdt bagging reuses its subsample for bagging_freq rounds
+        # (LightGBM semantics)
+        bag_step = (it if use_goss or is_rf
                     else it // max(bagging_freq, 1) if use_bagging else 0)
         bag_key = jax.random.fold_in(base_key, 1_000_003 + bag_step)
         scores_d, vscores_d_new, trees_stacked, metrics = step(
             Xb_d, y_d, w_d, vmask_d, scores_d,
             Xvb_d if has_valid else dummy, yv_d if has_valid else dummy,
             wv_d if has_valid else dummy, vscores_d if has_valid else dummy,
-            key, bag_key)
+            key, bag_key, np.float32(it))
         if has_valid:
             vscores_d = vscores_d_new
         trees_host = jax.tree_util.tree_map(np.asarray, trees_stacked)
@@ -642,7 +703,204 @@ def train_booster(
     if (early_stopping_rounds > 0 and best_iter >= 0
             and user_init_booster is None):
         booster = _truncate_booster(booster, best_iter + 1)
+    if is_rf:
+        # forest prediction = base + average of (unshrunk) trees
+        booster = _scale_booster_values(
+            booster, np.full(booster.num_trees, 1.0 / booster.num_iterations))
     return booster
+
+
+def _scale_booster_values(b: Booster, per_tree_scale: np.ndarray) -> Booster:
+    """Scale each tree's output values (rf averaging / dart normalization)."""
+    s = np.asarray(per_tree_scale, np.float32)[:, None]
+    trees = b.trees._replace(
+        leaf_value=np.asarray(b.trees.leaf_value) * s,
+        node_value=np.asarray(b.trees.node_value) * s)
+    return Booster(trees, b.thr_raw, b.num_class, b.base_score, b.objective,
+                   b.depth_cap, b.binner_state, b.best_iteration,
+                   b.eval_history, b.objective_kwargs)
+
+
+def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
+                Xb_d, y_d, w_d, vmask_d, base, has_valid, Xvb_d, yv_d, wv_d,
+                depth_cap, metric_name, num_iterations, seed,
+                feature_fraction, use_bagging, bagging_fraction, bagging_freq,
+                early_stopping_rounds, iteration_callback, metric_eval_period,
+                drop_rate, max_drop, skip_drop, drop_seed,
+                binner, max_bin) -> Booster:
+    """DART boosting: Dropouts meet Multiple Additive Regression Trees.
+
+    Parity target: LightGBM's ``boosting=dart`` (reference exposes it via
+    TrainParams.scala:9-10). Per iteration, each existing tree is dropped
+    with probability ``drop_rate`` (skipped entirely with probability
+    ``skip_drop``, capped at ``max_drop``); the new tree fits gradients at
+    the ensemble *without* the dropped trees; then the new tree is scaled by
+    1/(k+1) and the dropped trees by k/(k+1) (DART-paper normalization, the
+    LightGBM default mode).
+
+    TPU design: per-tree training-row contributions are kept as one sharded
+    [T, n, K] device array so "the ensemble minus dropped trees" is a single
+    weighted reduction with a host-supplied per-tree scale vector — no
+    re-walking historical trees. Early stopping records best_iteration but
+    does not truncate (dropping later trees would denormalize earlier ones).
+    """
+    F = Xb_d.shape[1]
+    npad = Xb_d.shape[0]
+    T_max = num_iterations
+    grow = (grow_tree_depthwise if cfg.growth_policy == "depthwise"
+            else grow_tree)
+    base_j = jnp.asarray(base)
+
+    def dart_step_local(binned, yl, wl, vmask, contribs, eff_scales,
+                        vbinned, vcontribs, key, bag_key, it_i):
+        scores = base_j[None, :] + jnp.einsum("t,tnk->nk", eff_scales,
+                                              contribs)
+        if K > 1:
+            grad, hess = obj.grad_hess(scores, yl, wl)
+        else:
+            grad, hess = obj.grad_hess(scores[:, 0], yl, wl)
+            grad, hess = grad[:, None], hess[:, None]
+        if use_bagging:
+            k2 = jax.random.fold_in(bag_key, jax.lax.axis_index("data"))
+            bag = jax.random.uniform(k2, vmask.shape) < bagging_fraction
+            row_mask = vmask * bag.astype(jnp.float32)
+        else:
+            row_mask = vmask
+        fmask = jnp.ones(F, dtype=bool)
+        if feature_fraction < 1.0:
+            fkey = jax.random.fold_in(key, 7)
+            u = jax.random.uniform(fkey, (F,))
+            fmask = (u < feature_fraction).at[jnp.argmin(u)].set(True)
+        trees_out, new_contrib = [], []
+        for k in range(K):
+            tree, row_node = grow(binned, grad[:, k], hess[:, k], row_mask,
+                                  fmask, cfg, axis_name="data")
+            new_contrib.append(tree.leaf_value[row_node])
+            trees_out.append(tree)
+        nc = jnp.stack(new_contrib, axis=1)                # [n_local, K]
+        contribs = lax.dynamic_update_slice(contribs, nc[None], (it_i, 0, 0))
+        trees_stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *trees_out)
+        if has_valid:
+            vc = jnp.stack(
+                [predict_tree_binned(
+                    jax.tree_util.tree_map(lambda a: a[k], trees_stacked),
+                    vbinned, depth_cap) for k in range(K)], axis=1)
+            vcontribs = lax.dynamic_update_slice(
+                vcontribs, vc[None], (it_i, 0, 0))
+        return contribs, vcontribs, trees_stacked
+
+    def dart_eval_local(vcontribs, scales, vy, vw):
+        sc2 = base_j[None, :] + jnp.einsum("t,tnk->nk", scales, vcontribs)
+        sc = sc2 if K > 1 else sc2[:, 0]
+        _, num = eval_metric(obj, sc, vy, vw, **objective_kwargs)
+        wsum = jax.lax.psum(jnp.sum(vw), "data")
+        local_wsum = jnp.sum(vw)
+        if metric_name == "rmse":
+            return jnp.sqrt(jax.lax.psum(num * num * local_wsum, "data")
+                            / wsum)
+        return jax.lax.psum(num * local_wsum, "data") / wsum
+
+    row_spec, row2_spec = P("data"), P("data", None)
+    c_spec = P(None, "data", None)
+    # compiled-step cache, same rationale as the gbdt path: the closures are
+    # fresh per fit() call, so jit's identity-keyed cache would recompile on
+    # every trial of a sweep
+    cache_key = ("dart", cfg, K, objective,
+                 tuple(sorted(objective_kwargs.items())), Xb_d.shape,
+                 None if not has_valid else Xvb_d.shape, T_max,
+                 use_bagging, bagging_fraction, bagging_freq,
+                 feature_fraction, depth_cap, metric_name,
+                 tuple(np.asarray(base).tolist()), mesh)
+    cached = _STEP_CACHE.get(cache_key)
+    if cached is None:
+        dstep = jax.jit(jax.shard_map(
+            dart_step_local, mesh=mesh,
+            in_specs=(row2_spec, row_spec, row_spec, row_spec, c_spec, P(),
+                      row2_spec if has_valid else P(),
+                      c_spec if has_valid else P(), P(), P(), P()),
+            out_specs=(c_spec, c_spec if has_valid else P(), P()),
+            check_vma=False))
+        deval = (jax.jit(jax.shard_map(
+            dart_eval_local, mesh=mesh,
+            in_specs=(c_spec, P(), row_spec, row_spec), out_specs=P(),
+            check_vma=False)) if has_valid else None)
+        _STEP_CACHE[cache_key] = (dstep, deval)
+        while len(_STEP_CACHE) > _STEP_CACHE_MAX:
+            _STEP_CACHE.popitem(last=False)
+    else:
+        dstep, deval = cached
+        _STEP_CACHE.move_to_end(cache_key)
+
+    sh = lambda spec: NamedSharding(mesh, spec)
+    contribs_d = jax.device_put(
+        np.zeros((T_max, npad, K), np.float32), sh(c_spec))
+    vcontribs_d = (jax.device_put(
+        np.zeros((T_max, Xvb_d.shape[0], K), np.float32), sh(c_spec))
+        if has_valid else np.zeros((), np.float32))
+    dummy = np.zeros((), np.float32)
+
+    scales = np.zeros(T_max, np.float32)
+    rng_drop = np.random.default_rng(drop_seed)
+    all_trees: List[Tree] = []
+    history: Dict[str, List[float]] = {metric_name: []}
+    higher_is_better = metric_name in HIGHER_IS_BETTER
+    best_metric = -np.inf if higher_is_better else np.inf
+    best_iter, rounds_no_improve = -1, 0
+    base_key = jax.random.PRNGKey(seed)
+
+    for it in range(num_iterations):
+        if it == 0 or rng_drop.uniform() < skip_drop:
+            dropped = np.empty(0, np.int64)
+        else:
+            dropped = np.nonzero(rng_drop.uniform(size=it) < drop_rate)[0]
+            if max_drop > 0 and len(dropped) > max_drop:
+                dropped = rng_drop.choice(dropped, size=max_drop,
+                                          replace=False)
+        eff = scales.copy()
+        eff[dropped] = 0.0
+        key = jax.random.fold_in(base_key, it)
+        bag_step = it // max(bagging_freq, 1) if use_bagging else 0
+        bag_key = jax.random.fold_in(base_key, 1_000_003 + bag_step)
+        contribs_d, vcontribs_new, trees_stacked = dstep(
+            Xb_d, y_d, w_d, vmask_d, contribs_d, jnp.asarray(eff),
+            Xvb_d if has_valid else dummy,
+            vcontribs_d if has_valid else dummy,
+            key, bag_key, np.int32(it))
+        if has_valid:
+            vcontribs_d = vcontribs_new
+        trees_host = jax.tree_util.tree_map(np.asarray, trees_stacked)
+        for k in range(K):
+            all_trees.append(jax.tree_util.tree_map(lambda a: a[k],
+                                                    trees_host))
+        kdrop = len(dropped)
+        scales[dropped] *= kdrop / (kdrop + 1.0)
+        scales[it] = 1.0 / (kdrop + 1.0)
+
+        if has_valid and (it % metric_eval_period == 0
+                          or it == num_iterations - 1):
+            m = float(deval(vcontribs_d, jnp.asarray(scales), yv_d, wv_d))
+            history[metric_name].append(m)
+            improved = (m > best_metric + 1e-12 if higher_is_better
+                        else m < best_metric - 1e-12)
+            if improved:
+                best_metric, best_iter, rounds_no_improve = m, it, 0
+            else:
+                rounds_no_improve += 1
+            if iteration_callback is not None:
+                iteration_callback(it, {metric_name: m})
+            if (early_stopping_rounds > 0
+                    and rounds_no_improve >= early_stopping_rounds):
+                break
+        elif iteration_callback is not None:
+            iteration_callback(it, {})
+
+    booster = _finalize_trees(all_trees, binner, max_bin, K, base, objective,
+                              depth_cap, objective_kwargs, best_iter, history,
+                              None)
+    n_done = len(all_trees) // K
+    per_tree = np.repeat(scales[:n_done], K)
+    return _scale_booster_values(booster, per_tree)
 
 
 def _finalize_trees(trees_list: List[Tree], binner, max_bin: int, K: int,

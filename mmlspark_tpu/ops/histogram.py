@@ -129,28 +129,43 @@ def _hist_row_blocks(binned, stats, B, rows_per_block):
 # accumulation pattern). Measured ~1.5 ms for the same shape — ~35x.
 # ---------------------------------------------------------------------------
 
-_HIST_ROW_BLOCK = 8192
-_PALLAS_VMEM_BUDGET = 10 * 1024 * 1024   # leave headroom under ~16 MB VMEM
+_PALLAS_VMEM_BUDGET = 10 * 1024 * 1024   # headroom under the 16 MB scoped
+# vmem limit: the compiler's accounting adds dot outputs, copies and padding
+# beyond the blocks modeled below (a 12 MB budget was observed to produce a
+# 16.15 MB scoped allocation at S=96)
+
+
+def _pick_row_block(n: int, F: int, S: int, B: int) -> int:
+    """Largest row-block size whose resident VMEM fits the budget.
+
+    VMEM model (matches ``_make_hist_kernel``): input blocks are
+    double-buffered across grid steps (binned [F, RB] int32 and stats
+    [Sp, RB] bf16); the [F, Sp, BP] f32 accumulator stays resident; the
+    per-feature one-hot [RB, BP] bf16 is kernel scratch (single copy).
+    """
+    BP = -(-B // 128) * 128
+    Sp = -(-max(S, 1) // 16) * 16
+    for RB in (8192, 4096, 2048, 1024, 512):
+        if RB > max(512, n):
+            continue  # don't pad a small input up to a huge block
+        binned_block = F * RB * 4
+        stats_block = Sp * RB * 2
+        out_block = F * Sp * BP * 4
+        onehot = RB * BP * 2
+        if 2 * (binned_block + stats_block) + out_block + onehot \
+                <= _PALLAS_VMEM_BUDGET:
+            return RB
+    return 0
 
 
 def _pallas_fits(n: int, F: int, S: int, B: int) -> bool:
-    """VMEM estimate for the kernel's resident blocks; wide feature counts or
-    stat axes fall back to the chunked XLA formulation instead of OOMing."""
-    BP = -(-B // 128) * 128
-    RB = min(_HIST_ROW_BLOCK, max(512, n))
-    binned_block = F * RB * 4
-    out_block = F * S * BP * 4
-    onehot = RB * BP * 2
-    stats_block = RB * max(S, 8) * 2
-    # x2: Pallas double-buffers input blocks across grid steps
-    return 2 * (binned_block + stats_block) + out_block + 2 * onehot \
-        <= _PALLAS_VMEM_BUDGET
+    return _pick_row_block(n, F, S, B) > 0
 
 
 def _make_hist_kernel(F: int, BP: int):
     def kernel(b_ref, s_ref, o_ref):
         j = pl.program_id(0)
-        sb = s_ref[:, :]                            # [RB, S] bf16
+        sb = s_ref[:, :]                            # [Sp, RB] bf16
 
         @pl.when(j == 0)
         def _():
@@ -161,8 +176,8 @@ def _make_hist_kernel(F: int, BP: int):
             row = b_ref[0, f, :]                    # [RB] int32
             bins = lax.broadcasted_iota(jnp.int32, (row.shape[0], BP), 1)
             oh = (row[:, None] == bins).astype(sb.dtype)  # VMEM-only
-            h = lax.dot_general(sb, oh, (((0,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [S, BP]
+            h = lax.dot_general(sb, oh, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [Sp, BP]
             o_ref[f] += h
             return 0
 
@@ -177,25 +192,31 @@ def _hist_pallas(binned: jnp.ndarray, stats: jnp.ndarray,
     S = stats.shape[1]
     B = int(num_bins)
     BP = -(-B // 128) * 128                        # pad bins to lane multiple
-    RB = min(_HIST_ROW_BLOCK, max(512, n))
-    n_pad = -(-n // RB) * RB
+    Sp = -(-S // 16) * 16                          # pad stats to sublane tile
+    RB = _pick_row_block(n, F, S, B)
+    n_pad = -(-max(n, RB) // RB) * RB
     if n_pad != n:
         # zero stats on padding rows: they contribute nothing to any bin
         binned = jnp.pad(binned, ((0, n_pad - n), (0, 0)), constant_values=0)
         stats = jnp.pad(stats, ((0, n_pad - n), (0, 0)))
+    if Sp != S:
+        stats = jnp.pad(stats, ((0, 0), (0, Sp - S)))
     nb = n_pad // RB
-    # [nb, F, RB]: each grid step sees one row block of every feature
+    # [nb, F, RB]: each grid step sees one row block of every feature.
+    # stats transposed to [Sp, n]: rows ride the 128-lane axis, so a small
+    # stat count doesn't waste lanes (and the dot contracts the lane axis).
     binned_b = jnp.transpose(binned.reshape(nb, RB, F), (0, 2, 1))
+    stats_t = jnp.transpose(stats)
 
     out = pl.pallas_call(
         _make_hist_kernel(F, BP),
         grid=(nb,),
         in_specs=[
             pl.BlockSpec((1, F, RB), lambda j: (j, 0, 0)),
-            pl.BlockSpec((RB, S), lambda j: (j, 0)),
+            pl.BlockSpec((Sp, RB), lambda j: (0, j)),
         ],
-        out_specs=pl.BlockSpec((F, S, BP), lambda j: (0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((F, S, BP), jnp.float32),
-    )(binned_b, stats)
-    return out[:, :, :B]
+        out_specs=pl.BlockSpec((F, Sp, BP), lambda j: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, Sp, BP), jnp.float32),
+    )(binned_b, stats_t)
+    return out[:, :S, :B]
 

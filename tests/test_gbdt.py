@@ -411,3 +411,80 @@ class TestParallelModes:
             LightGBMClassifier(numIterations=2, growthPolicy="depthwise",
                                parallelism="voting_parallel").fit(
                 _to_ds(Xtr, ytr))
+
+
+class TestBoostingTypes:
+    """rf + dart boosting (reference: lightgbm/TrainParams.scala:9-10)."""
+
+    def test_rf(self):
+        Xtr, Xte, ytr, yte = _binary_data()
+        model = LightGBMClassifier(numIterations=25, boostingType="rf",
+                                   baggingFraction=0.632, baggingFreq=1,
+                                   featureFraction=0.8).fit(_to_ds(Xtr, ytr))
+        p = model.transform(_to_ds(Xte, yte))["probability"][:, 1]
+        assert roc_auc_score(yte, p) > 0.93
+        # forest probabilities are calibrated-ish around the averaged margin,
+        # not saturated like a boosted margin
+        assert np.isfinite(p).all()
+
+    def test_rf_requires_bagging(self):
+        Xtr, _, ytr, _ = _binary_data()
+        with pytest.raises(ValueError, match="requires bagging"):
+            LightGBMClassifier(numIterations=2, boostingType="rf").fit(
+                _to_ds(Xtr, ytr))
+
+    def test_rf_regressor(self):
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(400, 6)).astype(np.float32)
+        y = (X[:, 0] * 2 - X[:, 1] + 0.1 * rng.normal(size=400)).astype(
+            np.float64)
+        from mmlspark_tpu.models.gbdt.api import LightGBMRegressor
+        ds = Dataset({"features": X, "label": y})
+        model = LightGBMRegressor(numIterations=30, boostingType="rf",
+                                  baggingFraction=0.7, baggingFreq=1,
+                                  minDataInLeaf=5).fit(ds)
+        pred = model.transform(ds)["prediction"]
+        resid = np.asarray(pred) - y
+        # averaged forest must track the signal (weaker than boosting but real)
+        assert np.corrcoef(pred, y)[0, 1] > 0.9
+        assert np.abs(resid).mean() < np.abs(y - y.mean()).mean()
+
+    def test_dart(self):
+        Xtr, Xte, ytr, yte = _binary_data()
+        model = LightGBMClassifier(numIterations=25, boostingType="dart",
+                                   dropRate=0.2, skipDrop=0.3).fit(
+            _to_ds(Xtr, ytr))
+        p = model.transform(_to_ds(Xte, yte))["probability"][:, 1]
+        assert roc_auc_score(yte, p) > BASELINE_BINARY_AUC
+
+    def test_dart_early_stopping_history(self):
+        Xtr, Xte, ytr, yte = _binary_data()
+        n = len(ytr) + len(yte)
+        X = np.concatenate([Xtr, Xte])
+        y = np.concatenate([ytr, yte])
+        vmask = np.zeros(n); vmask[len(ytr):] = 1
+        ds = Dataset({"features": X.astype(np.float32),
+                      "label": y.astype(np.float64), "isVal": vmask})
+        model = LightGBMClassifier(numIterations=20, boostingType="dart",
+                                   validationIndicatorCol="isVal",
+                                   earlyStoppingRound=5).fit(ds)
+        hist = model.booster.eval_history
+        assert len(next(iter(hist.values()))) > 0
+
+    def test_dart_rejects_warm_start_and_checkpoint(self, tmp_path):
+        Xtr, _, ytr, _ = _binary_data()
+        base = LightGBMClassifier(numIterations=2).fit(_to_ds(Xtr, ytr))
+        with pytest.raises(ValueError, match="warm start"):
+            LightGBMClassifier(numIterations=2, boostingType="dart",
+                               modelString=base.get_native_model()).fit(
+                _to_ds(Xtr, ytr))
+        with pytest.raises(ValueError, match="checkpointDir"):
+            LightGBMClassifier(numIterations=2, boostingType="dart",
+                               checkpointDir=str(tmp_path / "ck")).fit(
+                _to_ds(Xtr, ytr))
+
+    def test_unknown_boosting_type_rejected(self):
+        Xtr, _, ytr, _ = _binary_data()
+        with pytest.raises(ValueError, match="not supported"):
+            LightGBMClassifier(numIterations=2, boostingType="plain").fit(
+                _to_ds(Xtr, ytr))
