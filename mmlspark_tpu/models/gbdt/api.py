@@ -188,6 +188,13 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
             drop_seed=self.get_or_default("dropSeed"),
         )
         num_iterations = self.get_or_default("numIterations")
+        if (num_batches and num_batches > 1
+                and common["boosting_type"] in ("rf", "dart")):
+            # fail before batch 0 trains: batch 1 would reject the warm start
+            raise ValueError(
+                f"numBatches > 1 is not supported with boostingType="
+                f"{common['boosting_type']!r} (its trees carry normalization "
+                "state that a warm-start prefix lacks)")
         if num_batches and num_batches > 1:
             # sequential warm-started batches (reference: LightGBMBase.scala:28-50)
             n = len(y)
@@ -233,12 +240,15 @@ class _LightGBMModelBase(Model, _LightGBMParams):
         return self.booster.feature_importances(importance_type).tolist()
 
     def get_native_model(self) -> str:
-        return self.booster.model_string()
+        """The model as a stock-LightGBM text string (loads in any LightGBM
+        tooling; reference: LightGBMModelMethods getNativeModel)."""
+        return self.booster.to_lightgbm_string()
 
     def save_native_model(self, path: str) -> None:
-        """reference: LightGBMClassifier.scala:172-194 saveNativeModel"""
+        """reference: LightGBMClassifier.scala:172-194 saveNativeModel —
+        writes the LightGBM ``tree`` v3 text format for tool interop."""
         with open(path, "w") as f:
-            f.write(self.booster.model_string())
+            f.write(self.booster.to_lightgbm_string())
 
     def _save_extra(self, path: str) -> None:
         import os

@@ -226,10 +226,33 @@ class Booster:
             meta["best_iteration"], meta["eval_history"],
             meta.get("objective_kwargs") or {})
 
+    def to_lightgbm_string(self) -> str:
+        """Stock-LightGBM ``tree`` v3 text model string — loads in any
+        LightGBM tooling (saveNativeModel parity, reference:
+        LightGBMClassifier.scala:172-194, LightGBMBooster.scala:289)."""
+        from .lgbm_format import to_lightgbm_string
+        return to_lightgbm_string(self)
+
+    @staticmethod
+    def from_lightgbm_string(s: str) -> "Booster":
+        """Load a LightGBM text model (produced by stock LightGBM or by
+        ``to_lightgbm_string``). base_score is 0: LightGBM folds any init
+        score into the first iteration's leaves."""
+        from .lgbm_format import parse_lightgbm_string
+        trees, thr_raw, K, objective, kwargs, F = parse_lightgbm_string(s)
+        M = trees.feat.shape[1]
+        depth_cap = max(1, (M + 1) // 2 - 1)
+        binner_state = dict(upper_bounds=np.zeros((F, 1), np.float32),
+                            max_bin=0, sample_count=0, seed=0,
+                            num_features=F)
+        return Booster(trees, thr_raw, K, np.zeros(K, np.float32), objective,
+                       depth_cap, binner_state, objective_kwargs=kwargs)
+
     def model_string(self) -> str:
-        """Portable JSON model string (saveNativeModel/getNativeModel parity,
-        reference: LightGBMClassifier.scala:172-194). Not the LightGBM text
-        format — a stable self-describing format for this framework."""
+        """Portable JSON model string (the framework's internal format:
+        keeps binner state, base score and history exactly — used by
+        checkpoints and pipeline persistence). For LightGBM-tool interop
+        use ``to_lightgbm_string``; ``from_string`` auto-detects both."""
         d = {
             "version": 1,
             "num_class": self.num_class,
@@ -247,6 +270,8 @@ class Booster:
 
     @staticmethod
     def from_string(s: str) -> "Booster":
+        if s.lstrip().startswith("tree"):
+            return Booster.from_lightgbm_string(s)
         d = json.loads(s)
         trees = Tree(**_with_tree_defaults(
             {k: np.asarray(v) for k, v in d["trees"].items()}))
@@ -558,7 +583,11 @@ def train_booster(
                  Xb_d.shape, None if not has_valid else Xvb_d.shape,
                  use_bagging, bagging_fraction, bagging_freq,
                  feature_fraction, depth_cap,
-                 boosting_type, top_rate, other_rate, mesh)
+                 boosting_type, top_rate, other_rate, mesh,
+                 # rf's validation eval closes over the data-dependent base
+                 # score; it must key the cache or a sweep over same-shape
+                 # datasets would reuse the wrong base
+                 tuple(np.asarray(base).tolist()) if is_rf else None)
     step = _STEP_CACHE.get(cache_key)
     if step is None:
         step = jax.jit(jax.shard_map(
@@ -930,14 +959,40 @@ def _truncate_booster(b: Booster, num_iterations: int) -> Booster:
                    b.eval_history, b.objective_kwargs)
 
 
+def _pad_tree_slots(trees: Tree, thr: np.ndarray, M: int):
+    """Widen fixed-shape tree arrays to M node slots (inert leaf padding)."""
+    cur = trees.feat.shape[1]
+    if cur == M:
+        return trees, thr
+    pad = M - cur
+
+    def pad_field(name, a):
+        a = np.asarray(a)
+        if a.ndim == 1:          # per-tree scalars (node_count)
+            return a
+        fill = {"is_leaf": True}.get(name, 0)
+        return np.pad(a, ((0, 0), (0, pad)), constant_values=fill)
+
+    trees = Tree(**{k: pad_field(k, v)
+                    for k, v in trees._asdict().items()})
+    thr = np.pad(thr, ((0, 0), (0, pad)), constant_values=np.float32(np.inf))
+    return trees, thr
+
+
 def _merge_boosters(first: Booster, second: Booster) -> Booster:
     """Concatenate tree sequences (BoosterMerge parity,
-    reference: TrainUtils.scala:165-168 warm-start via LGBM_BoosterMerge)."""
+    reference: TrainUtils.scala:165-168 warm-start via LGBM_BoosterMerge).
+
+    Slot widths may differ (e.g. a warm start loaded from a LightGBM text
+    model vs freshly grown trees): both sides are padded to the wider M."""
     assert first.num_class == second.num_class
+    M = max(first.trees.feat.shape[1], second.trees.feat.shape[1])
+    t1, thr1 = _pad_tree_slots(first.trees, first.thr_raw, M)
+    t2, thr2 = _pad_tree_slots(second.trees, second.thr_raw, M)
     trees = jax.tree_util.tree_map(
         lambda a, c: np.concatenate([np.asarray(a), np.asarray(c)], axis=0),
-        first.trees, second.trees)
-    thr = np.concatenate([first.thr_raw, second.thr_raw], axis=0)
+        t1, t2)
+    thr = np.concatenate([thr1, thr2], axis=0)
     return Booster(trees, thr, first.num_class, first.base_score, second.objective,
                    max(first.depth_cap, second.depth_cap), second.binner_state,
                    second.best_iteration, second.eval_history, second.objective_kwargs)

@@ -1,0 +1,239 @@
+"""LightGBM text model format interop (reference:
+LightGBMClassifier.scala:172-194 saveNativeModel / getNativeModel round-trips
+real LightGBM model strings; TrainUtils.scala:176-180).
+
+The lightgbm pip package is not in this image, so stock-LightGBM interop is
+pinned two ways: (a) emit -> parse round-trips must preserve predictions
+exactly, and (b) a checked-in golden model string in the exact shape stock
+LightGBM writes (v3 header, negative leaf refs, decision_type=2) must load
+and reproduce hand-computed predictions.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.models.gbdt.api import (LightGBMClassifier,
+                                          LightGBMRegressor)
+from mmlspark_tpu.models.gbdt.booster import Booster
+
+
+def _ds(seed=0, n=300):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] + 0.2 * rng.normal(size=n) > 0).astype(np.float64)
+    return Dataset({"features": X, "label": y}), X
+
+
+# A golden model string in stock LightGBM's v3 output shape: two trees,
+# 3 + 2 leaves, negative child refs for leaves, decision_type=2
+# (numerical, default-left). Tree structure:
+#   Tree 0: root split f1 <= 0.5 -> [leaf0 | split f0 <= -1.0 -> [leaf1|leaf2]]
+#   Tree 1: root split f0 <= 1.25 -> [leaf0 | leaf1]
+GOLDEN = """tree
+version=v3
+num_class=1
+num_tree_per_iteration=1
+label_index=0
+max_feature_idx=1
+objective=binary sigmoid:1
+feature_names=Column_0 Column_1
+feature_infos=[-3:3] [-3:3]
+
+Tree=0
+num_leaves=3
+num_cat=0
+split_feature=1 0
+split_gain=10.5 4.25
+threshold=0.5 -1.0
+decision_type=2 2
+left_child=-1 -2
+right_child=1 -3
+leaf_value=0.25 -0.125 0.0625
+leaf_weight=12 7 9
+leaf_count=12 7 9
+internal_value=0.05 -0.01
+internal_weight=28 16
+internal_count=28 16
+shrinkage=0.1
+
+
+Tree=1
+num_leaves=2
+num_cat=0
+split_feature=0
+split_gain=3.5
+threshold=1.25
+decision_type=2
+left_child=-1
+right_child=-2
+leaf_value=-0.0625 0.1875
+leaf_weight=20 8
+leaf_count=20 8
+internal_value=0.0
+internal_weight=28
+internal_count=28
+shrinkage=0.1
+
+
+end of trees
+
+feature_importances:
+Column_0=2
+Column_1=1
+
+parameters:
+[objective: binary]
+end of parameters
+
+pandas_categorical:null
+"""
+
+
+class TestGoldenStockModel:
+    def test_predictions_match_hand_computed(self):
+        b = Booster.from_string(GOLDEN)
+        assert b.num_class == 1 and b.objective == "binary"
+        X = np.array([
+            [0.0, 0.0],    # T0: f1=0<=0.5 -> leaf0 0.25;   T1: f0<=1.25 -> -0.0625
+            [0.0, 1.0],    # T0: f1>0.5, f0<=-1? no -> leaf2 0.0625; T1 -> -0.0625
+            [-2.0, 2.0],   # T0: f1>0.5, f0<=-1 -> leaf1 -0.125;     T1 -> -0.0625
+            [2.0, 1.0],    # T0: leaf2 0.0625;               T1: f0>1.25 -> 0.1875
+        ], dtype=np.float32)
+        raw = b.predict_raw(X)[:, 0]
+        expect = np.array([0.25 - 0.0625, 0.0625 - 0.0625,
+                           -0.125 - 0.0625, 0.0625 + 0.1875])
+        np.testing.assert_allclose(raw, expect, rtol=1e-6)
+        prob = b.predict(X)
+        np.testing.assert_allclose(prob, 1 / (1 + np.exp(-expect)), rtol=1e-6)
+
+    def test_nan_goes_left(self):
+        b = Booster.from_string(GOLDEN)
+        X = np.array([[np.nan, np.nan]], dtype=np.float32)
+        # default-left everywhere: T0 leaf0 (0.25), T1 leaf0 (-0.0625)
+        np.testing.assert_allclose(b.predict_raw(X)[0, 0], 0.25 - 0.0625,
+                                   rtol=1e-6)
+
+    def test_categorical_rejected_for_now(self):
+        s = GOLDEN.replace("decision_type=2 2", "decision_type=3 2")
+        with pytest.raises(NotImplementedError, match="categorical"):
+            Booster.from_string(s)
+
+
+class TestEmitParseRoundTrip:
+    def test_binary_round_trip(self):
+        ds, X = _ds()
+        model = LightGBMClassifier(numIterations=10, numLeaves=15).fit(ds)
+        s = model.get_native_model()
+        assert s.startswith("tree\nversion=v3")
+        b2 = Booster.from_string(s)
+        np.testing.assert_allclose(
+            b2.predict_raw(X)[:, 0],
+            model.booster.predict_raw(X)[:, 0], rtol=1e-6, atol=1e-7)
+        # probabilities too (objective survives)
+        np.testing.assert_allclose(b2.predict(X),
+                                   model.booster.predict(X),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_multiclass_round_trip(self):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(400, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)
+        ds = Dataset({"features": X, "label": y.astype(np.float64)})
+        model = LightGBMClassifier(numIterations=6, numLeaves=7).fit(ds)
+        s = model.get_native_model()
+        assert "num_class=3" in s and "num_tree_per_iteration=3" in s
+        b2 = Booster.from_string(s)
+        np.testing.assert_allclose(b2.predict_raw(X),
+                                   model.booster.predict_raw(X),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_regression_round_trip_and_single_leaf_trees(self):
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(200, 3)).astype(np.float32)
+        y = (2.0 * X[:, 0]).astype(np.float64)
+        ds = Dataset({"features": X, "label": y})
+        # minDataInLeaf so high some trees stay a single leaf
+        model = LightGBMRegressor(numIterations=5, minDataInLeaf=150).fit(ds)
+        b2 = Booster.from_string(model.get_native_model())
+        np.testing.assert_allclose(b2.predict_raw(X)[:, 0],
+                                   model.booster.predict_raw(X)[:, 0],
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_save_load_native_model_file(self, tmp_path):
+        ds, X = _ds()
+        model = LightGBMClassifier(numIterations=5).fit(ds)
+        from mmlspark_tpu.models.gbdt.api import LightGBMClassificationModel
+        p = str(tmp_path / "model.txt")
+        model.save_native_model(p)
+        loaded = LightGBMClassificationModel.load_native_model(p)
+        np.testing.assert_allclose(
+            np.asarray(loaded.transform(ds)["probability"]),
+            np.asarray(model.transform(ds)["probability"]),
+            rtol=1e-6, atol=1e-7)
+
+    def test_warm_start_from_lightgbm_string(self):
+        """modelString accepts the LightGBM text format (reference:
+        LightGBMParams modelString warm start)."""
+        ds, X = _ds()
+        first = LightGBMClassifier(numIterations=5).fit(ds)
+        cont = LightGBMClassifier(
+            numIterations=5, modelString=first.get_native_model()).fit(ds)
+        assert cont.booster.num_iterations == 10
+        p = np.asarray(cont.transform(ds)["probability"])[:, 1]
+        assert np.isfinite(p).all()
+
+    def test_feature_importances_survive(self):
+        ds, X = _ds()
+        model = LightGBMClassifier(numIterations=8).fit(ds)
+        s = model.get_native_model()
+        assert "feature_importances:" in s
+        b2 = Booster.from_string(s)
+        imp = b2.feature_importances("split")
+        np.testing.assert_allclose(
+            imp, model.booster.feature_importances("split"))
+
+
+class TestUnsupportedStockVariants:
+    """Unsupported stock-LightGBM variants fail loudly, never mispredict."""
+
+    def test_multiclassova_rejected(self):
+        s = GOLDEN.replace("objective=binary sigmoid:1",
+                           "objective=multiclassova num_class:3 sigmoid:1")
+        with pytest.raises(NotImplementedError, match="one-vs-all"):
+            Booster.from_string(s)
+
+    def test_nonunit_sigmoid_rejected(self):
+        s = GOLDEN.replace("objective=binary sigmoid:1",
+                           "objective=binary sigmoid:2")
+        with pytest.raises(NotImplementedError, match="sigmoid"):
+            Booster.from_string(s)
+
+    def test_zero_as_missing_rejected(self):
+        # decision_type 6 = numerical, default-left, missing=zero
+        s = GOLDEN.replace("decision_type=2 2", "decision_type=6 2")
+        with pytest.raises(NotImplementedError, match="zero_as_missing"):
+            Booster.from_string(s)
+
+    def test_default_right_nan_rejected(self):
+        # decision_type 8 = numerical, default-right, missing=NaN
+        s = GOLDEN.replace("decision_type=2 2", "decision_type=8 2")
+        with pytest.raises(NotImplementedError, match="default-right"):
+            Booster.from_string(s)
+
+    def test_default_right_missing_none_accepted(self):
+        # decision_type 0 = numerical, default-right, missing=none: NaN never
+        # occurs in such models, so NaN-left prediction is equivalent
+        s = GOLDEN.replace("decision_type=2 2", "decision_type=0 0")
+        b = Booster.from_string(s)
+        X = np.array([[0.0, 0.0]], dtype=np.float32)
+        np.testing.assert_allclose(b.predict_raw(X)[0, 0], 0.25 - 0.0625,
+                                   rtol=1e-6)
+
+    def test_rf_dart_num_batches_rejected_upfront(self):
+        ds, _ = _ds()
+        for bt in ("rf", "dart"):
+            with pytest.raises(ValueError, match="numBatches"):
+                LightGBMClassifier(numIterations=2, boostingType=bt,
+                                   baggingFraction=0.6, baggingFreq=1,
+                                   numBatches=2).fit(ds)
