@@ -27,9 +27,18 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-_SOURCE = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "native",
-    "mmlspark_native.cpp")
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+# repo layout keeps the C++ at <root>/native/; installed wheels ship a copy
+# as package data next to this file (setup.py build_py_with_native)
+_SOURCE_CANDIDATES = (
+    os.path.join(os.path.dirname(os.path.dirname(_PKG_DIR)), "native",
+                 "mmlspark_native.cpp"),
+    os.path.join(_PKG_DIR, "mmlspark_native.cpp"),
+)
+_SOURCE = next((p for p in _SOURCE_CANDIDATES if os.path.exists(p)),
+               _SOURCE_CANDIDATES[0])
+# wheels built on a host with a toolchain ship the compiled library too
+_PREBUILT = os.path.join(_PKG_DIR, "mmlspark_native_prebuilt.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False
@@ -102,6 +111,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
 def _load() -> Optional[ctypes.CDLL]:
     if os.environ.get("MMLSPARK_TPU_DISABLE_NATIVE"):
         return None
+    if os.path.exists(_PREBUILT):
+        try:
+            return ctypes.CDLL(_PREBUILT)
+        except OSError:
+            pass  # wrong arch/ABI for this host: recompile from source
     so = _compile()
     if so is None:
         return None
