@@ -15,6 +15,11 @@ sets per leaf, the TPU formulation keeps everything static-shape:
     record the split — all data-dependent choices via argmax + where, never
     Python control flow.
 
+Layout: the binned matrix rides **column-major** (``binned_t``: [F, n]) for
+the whole training run — histogram row blocks and per-feature column reads
+are then contiguous device slices, with no per-level transposes or per-row
+feature gathers (both measured dominators of the row-major formulation).
+
 Run inside ``shard_map`` with rows sharded over the ``data`` axis, the single
 ``psum`` on histograms reproduces the reference's per-iteration histogram
 all-reduce over its TCP ring (TrainUtils.scala:496-512), but on ICI.
@@ -28,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ...ops.histogram import histogram
+from ...ops.histogram import node_histogram
 
 NEG_INF = jnp.float32(-jnp.inf)
 
@@ -107,20 +112,24 @@ class Tree(NamedTuple):
     node_value: jnp.ndarray  # [M] f32 expected value at every node (SHAP path)
 
 
-def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               valid: jnp.ndarray, feat_mask: jnp.ndarray, cfg: GrowConfig,
               axis_name: Optional[str] = None):
     """Grow one tree on (possibly sharded) rows.
 
-    binned: [n, F] int32; grad/hess: [n] f32; valid: [n] f32 row mask (0 for
-    padding / bagged-out rows); feat_mask: [F] bool (feature_fraction).
-    With ``axis_name`` set (inside shard_map), histograms are psum'd so every
-    shard takes identical split decisions — data_parallel GBDT semantics.
+    binned_t: [F, n] int32 (column-major); grad/hess: [n] f32; valid: [n] f32
+    row mask (0 for padding / bagged-out rows); feat_mask: [F] bool
+    (feature_fraction). With ``axis_name`` set (inside shard_map), histograms
+    are psum'd so every shard takes identical split decisions —
+    data_parallel GBDT semantics.
     """
-    n, F = binned.shape
+    F, n = binned_t.shape
     L = int(cfg.num_leaves)
     M = 2 * L - 1
     B = int(cfg.num_bins)
+
+    vm = valid.astype(jnp.float32)
+    base_t = jnp.stack([grad * vm, hess * vm, vm], axis=0)   # [3, n]
 
     def _feature_best_gains(hist, fm):
         """[F] best local split gain per feature (for the voting step)."""
@@ -137,20 +146,20 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         ok = ok.at[:, B - 1].set(False)
         return jnp.max(jnp.where(ok, gain, NEG_INF), axis=-1)
 
-    def all_hist(stats):
-        """Global histogram + selected-feature mask.
+    def all_hist(row_pos, W):
+        """Global per-node histogram [F, W*3, B] + selected-feature mask.
 
-        data_parallel: one full [F, C, B] psum. voting_parallel: vote top_k
+        data_parallel: one full [F, W*3, B] psum. voting_parallel: vote top_k
         locally, psum the votes, psum only the global top-2k features'
         histograms (scattered back into a zeroed full array so downstream
         split search keeps static shapes; unselected features are masked)."""
-        h = histogram(binned, stats, B)
+        h = node_histogram(binned_t, row_pos, base_t, W, B)
         if axis_name is None:
             return h, jnp.ones(F, dtype=bool)
         if not cfg.voting:
             return lax.psum(h, axis_name), jnp.ones(F, dtype=bool)
         gains = _feature_best_gains(h[:, 0:3], feat_mask)
-        if h.shape[1] == 6:
+        if W == 2:
             gains = jnp.maximum(gains, _feature_best_gains(h[:, 3:6], feat_mask))
         k = min(int(cfg.top_k), F)
         _, local_top = lax.top_k(gains, k)
@@ -162,11 +171,10 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         hfull = jnp.zeros_like(h).at[sel].set(hsel)
         return hfull, jnp.zeros(F, dtype=bool).at[sel].set(True)
 
-    vm = valid.astype(jnp.float32)
-    root_hist, sel0 = all_hist(jnp.stack([grad * vm, hess * vm, vm], axis=1))
+    root_hist, sel0 = all_hist(jnp.zeros(n, dtype=jnp.int32), 1)
     # totals from the raw stats (not the histogram: under voting_parallel an
     # unselected feature's rows are zeroed there)
-    tot = jnp.stack([jnp.sum(grad * vm), jnp.sum(hess * vm), jnp.sum(vm)])
+    tot = jnp.sum(base_t, axis=1)
     if axis_name is not None:
         tot = lax.psum(tot, axis_name)
     tot_g, tot_h, tot_c = tot[0], tot[1], tot[2]
@@ -199,14 +207,12 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         lid = st["num_nodes"]
         rid = lid + 1
 
-        col = jnp.take(binned, bf, axis=1)
+        col = lax.dynamic_index_in_dim(binned_t, bf, axis=0, keepdims=False)
         in_node = st["row_node"] == node
         go_left = col <= bb
-        ml = (in_node & go_left).astype(jnp.float32) * vm
-        mr = (in_node & ~go_left).astype(jnp.float32) * vm
-        stats6 = jnp.stack(
-            [grad * ml, hess * ml, ml, grad * mr, hess * mr, mr], axis=1)
-        h2, sel = all_hist(stats6)
+        # side: 0 = left child, 1 = right child, -1 = not in the split node
+        side = jnp.where(in_node, jnp.where(go_left, 0, 1), -1).astype(jnp.int32)
+        h2, sel = all_hist(side, 2)
         hist_l, hist_r = h2[:, 0:3, :], h2[:, 3:6, :]
 
         lg, lh, lc = st["clg"][node], st["clh"][node], st["clc"][node]
@@ -261,7 +267,7 @@ def grow_tree(binned: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     return tree, state["row_node"]
 
 
-def grow_tree_depthwise(binned: jnp.ndarray, grad: jnp.ndarray,
+def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
                         hess: jnp.ndarray, valid: jnp.ndarray,
                         feat_mask: jnp.ndarray, cfg: GrowConfig,
                         axis_name: Optional[str] = None):
@@ -278,7 +284,7 @@ def grow_tree_depthwise(binned: jnp.ndarray, grad: jnp.ndarray,
     if cfg.voting:
         raise NotImplementedError(
             "voting_parallel requires leafwise growth (growthPolicy)")
-    n, F = binned.shape
+    F, n = binned_t.shape
     L = int(cfg.num_leaves)
     M = 2 * L - 1
     B = int(cfg.num_bins)
@@ -290,6 +296,7 @@ def grow_tree_depthwise(binned: jnp.ndarray, grad: jnp.ndarray,
                  else min(L - 1, (L - 1).bit_length() + 2))
 
     vm = valid.astype(jnp.float32)
+    base_t = jnp.stack([grad * vm, hess * vm, vm], axis=0)   # [3, n]
     zi = jnp.zeros(M, dtype=jnp.int32)
     zf = jnp.zeros(M, dtype=jnp.float32)
     tree_arrays = dict(
@@ -302,7 +309,7 @@ def grow_tree_depthwise(binned: jnp.ndarray, grad: jnp.ndarray,
     leaves = jnp.int32(1)
 
     # root totals
-    tot0 = jnp.stack([jnp.sum(grad * vm), jnp.sum(hess * vm), jnp.sum(vm)])
+    tot0 = jnp.sum(base_t, axis=1)
     if axis_name is not None:
         tot0 = lax.psum(tot0, axis_name)
     tree_arrays["ng"] = tree_arrays["ng"].at[0].set(tot0[0])
@@ -327,16 +334,12 @@ def grow_tree_depthwise(binned: jnp.ndarray, grad: jnp.ndarray,
                 jnp.arange(W, dtype=jnp.int32), mode="drop")
             row_pos = slot_to_pos[row_node]      # [n] in [-1, W)
 
-            # batched stats: [n, W*3] — grad/hess/count scattered to the
-            # row's frontier position; the level rides one histogram pass
-            pos_oh = (row_pos[:, None] ==
-                      jnp.arange(W, dtype=jnp.int32)).astype(jnp.float32)
-            base = jnp.stack([grad * vm, hess * vm, vm], axis=1)       # [n, 3]
-            sg = (pos_oh[:, :, None] * base[:, None, :]).reshape(n, W * 3)
-            h = histogram(binned, sg, B)                               # [F, W*3, B]
+            # one fused histogram pass covers the whole level: the
+            # row->position one-hot and masked stats are built in VMEM
+            h = node_histogram(binned_t, row_pos, base_t, W, B)  # [F, W*3, B]
             if axis_name is not None:
                 h = lax.psum(h, axis_name)
-            h = h.reshape(F, W, 3, B).transpose(1, 0, 2, 3)            # [W, F, 3, B]
+            h = h.reshape(F, W, 3, B).transpose(1, 0, 2, 3)      # [W, F, 3, B]
 
             tot = jnp.stack([tree_arrays["ng"][jnp.maximum(fr, 0)],
                              tree_arrays["nh"][jnp.maximum(fr, 0)],
@@ -362,14 +365,16 @@ def grow_tree_depthwise(binned: jnp.ndarray, grad: jnp.ndarray,
             rid = lid + 1
             n_split = jnp.sum(do.astype(jnp.int32))
 
-            # update rows: rows in split nodes move to their child slot
-            f_row = feats[jnp.maximum(row_pos, 0)]
-            t_row = bins_[jnp.maximum(row_pos, 0)]
-            col = jnp.take_along_axis(binned, f_row[:, None], axis=1)[:, 0]
-            go_left = col <= t_row
-            do_row = jnp.where(row_pos >= 0, do[jnp.maximum(row_pos, 0)],
-                               False)
-            lid_row = lid[jnp.maximum(row_pos, 0)]
+            # update rows: rows in split nodes move to their child slot.
+            # All routing is [W, n] elementwise + reduce (XLA fuses into one
+            # pass) — no per-row feature gathers.
+            pos_oh = row_pos[None, :] == jnp.arange(W, dtype=jnp.int32)[:, None]
+            move = pos_oh & do[:, None]                          # [W, n]
+            rows = binned_t[feats]                               # [W, n]
+            goleft_w = rows <= bins_[:, None]
+            do_row = jnp.any(move, axis=0)
+            go_left = jnp.any(move & goleft_w, axis=0)
+            lid_row = jnp.sum(jnp.where(move, lid[:, None], 0), axis=0)
             row_node = jnp.where(do_row,
                                  jnp.where(go_left, lid_row, lid_row + 1),
                                  row_node)

@@ -16,7 +16,29 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax.numpy as jnp
 import numpy as np
+from jax import lax
+
+
+def bin_cols_device(X: "jnp.ndarray", upper_bounds: "jnp.ndarray"):
+    """Device-side bin apply: floats [n, F] -> column-major bins [F, n].
+
+    Exact parity with the host path (searchsorted side='left' == the count of
+    strictly-smaller bounds; NaN compares false everywhere -> bin 0, matching
+    native bin_batch's NaN->0). The compare-sum runs as fused VPU work — at
+    1M x 28 x 255 it replaces a ~1.6 s single-core host pass — and emits the
+    [F, n] layout tree growth consumes, so no separate device transpose.
+    """
+    xt = jnp.transpose(X.astype(jnp.float32))          # [F, n]
+
+    def one(_, xu):
+        xf, uf = xu                                    # [n], [B-1]
+        b = jnp.sum(uf[:, None] < xf[None, :], axis=0).astype(jnp.int32)
+        return _, b
+
+    _, bt = lax.scan(one, None, (xt, upper_bounds))
+    return bt
 
 
 class QuantileBinner:

@@ -9,9 +9,18 @@ bin-scatter into dense one-hot contractions that run on the systolic array:
 
 i.e. per feature a ``[S, n] @ [n, B]`` matmul with the one-hot bin matrix.
 Stats ride in bf16 (one-hot products are exact; values round at 2^-8 relative)
-and accumulate in f32 on the MXU. Rows and features are chunked so the
-transient one-hot stays within a fixed element budget, keeping HBM pressure
-flat regardless of dataset size.
+and accumulate in f32 on the MXU.
+
+Layout: everything here is **column-major** — ``binned_t`` is ``[F, n]`` and
+stats are ``[S, n]`` — so the Pallas grid slices the row axis (the 128-lane
+axis) directly with no per-call transposes. Training materializes ``binned_t``
+once; the per-level inputs are then tiny ([n] node positions + [3, n] stats).
+
+``node_histogram`` is the fused training entry point: tree growth needs
+``hist[f, w, s, b]`` for every frontier node ``w``; instead of materializing
+the ``[3W, n]`` masked-stats matrix in HBM, the kernel rebuilds it per row
+block in VMEM from the row->frontier-position vector and the shared
+(grad, hess, count) stats.
 
 Under ``shard_map`` with rows sharded over the ``data`` mesh axis, callers
 ``psum`` the result — that single collective replaces the reference's entire
@@ -34,54 +43,111 @@ def _use_pallas() -> bool:
     if os.environ.get("MMLSPARK_TPU_DISABLE_PALLAS_HIST"):
         return False
     try:
-        return jax.default_backend() == "tpu"
+        # device_kind, not just jax.default_backend(): TPU PJRT plugins may
+        # register under a different platform name (e.g. a tunneled plugin)
+        # while still lowering Pallas TPU kernels. default_backend() then
+        # reports the plugin name and a name check would silently fall back
+        # to the ~10x slower XLA one-hot path.
+        if jax.default_backend() == "tpu":
+            return True
+        dev = jax.devices()[0]
+        kind = f"{getattr(dev, 'device_kind', '')} {dev.platform}"
+        return "tpu" in kind.lower()
     except Exception:
         return False
 
 
+# ---------------------------------------------------------------------------
+# Public entry points
+# ---------------------------------------------------------------------------
+
+
 def histogram(binned: jnp.ndarray, stats: jnp.ndarray, num_bins: int,
               stats_dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Row-major convenience wrapper: ``[n, F]`` bins + ``[n, S]`` stats.
+
+    Transposes and delegates to :func:`histogram_cols`. Training code should
+    use the column-major entry points directly and hoist the ``binned``
+    transpose out of the per-level loop.
+    """
+    return histogram_cols(jnp.transpose(binned), jnp.transpose(stats),
+                          num_bins, stats_dtype)
+
+
+def histogram_cols(binned_t: jnp.ndarray, stats_t: jnp.ndarray, num_bins: int,
+                   stats_dtype=jnp.bfloat16) -> jnp.ndarray:
     """Compute ``[F, S, B]`` histogram of per-row stats over feature bins.
 
-    binned: [n, F] int32 bin indices in [0, num_bins)
-    stats:  [n, S] float stats (e.g. grad, hess, count-mask, possibly per-child)
+    binned_t: [F, n] int32 bin indices in [0, num_bins)
+    stats_t:  [S, n] float stats (e.g. grad, hess, count-mask)
     Returns [F, S, B] float32.
-
-    On TPU this runs the fused Pallas kernel (one-hot never touches HBM);
-    elsewhere the XLA one-hot-matmul formulation below.
     """
-    n, F = binned.shape
-    S = stats.shape[1]
+    F, n = binned_t.shape
+    S = stats_t.shape[0]
     B = int(num_bins)
-    if _use_pallas() and _pallas_fits(n, F, S, B):
-        return _hist_pallas(binned, stats.astype(stats_dtype), B)
-    stats = stats.astype(stats_dtype)
+    stats_t = stats_t.astype(stats_dtype)
+    if _use_pallas() and _pick_row_block(n, F, S, B) > 0:
+        return _hist_pallas(binned_t, stats_t, B)
+    return _hist_xla(binned_t, stats_t, B)
 
+
+def node_histogram(binned_t: jnp.ndarray, row_pos: jnp.ndarray,
+                   base_t: jnp.ndarray, num_nodes: int,
+                   num_bins: int) -> jnp.ndarray:
+    """Per-frontier-node histograms in one fused pass: ``[F, W*3, B]``.
+
+    binned_t: [F, n] int32; row_pos: [n] int32 in [-1, W) — each row's
+    position in the frontier (-1: row is at a finished leaf, contributes
+    nothing); base_t: [3, n] f32 (grad*mask, hess*mask, mask).
+
+    Channel layout matches ``stack([g*m_w, h*m_w, m_w for w])``:
+    ``out[f, w*3 + s, b]`` is stat ``s`` of frontier node ``w``.
+
+    On TPU the row->node one-hot and the masked stats never touch HBM: the
+    Pallas kernel rebuilds them per row block in VMEM (the HBM inputs per
+    level are just binned_t + [n] positions + [3, n] stats, vs the
+    [3W, n] materialization the XLA fallback does).
+    """
+    F, n = binned_t.shape
+    W = int(num_nodes)
+    B = int(num_bins)
+    if _use_pallas() and _pick_row_block(n, F, 3 * W, B, fused_w=W) > 0:
+        return _node_hist_pallas(binned_t, row_pos, base_t, W, B)
+    woh = row_pos[None, :] == jnp.arange(W, dtype=row_pos.dtype)[:, None]
+    sb = jnp.where(woh[:, None, :], base_t[None, :, :], 0.0)
+    return _hist_xla(binned_t, sb.reshape(3 * W, n).astype(jnp.bfloat16), B)
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback formulations (CPU tests / shapes the kernel can't tile)
+# ---------------------------------------------------------------------------
+
+
+def _hist_xla(binned_t, stats_t, B):
+    F, n = binned_t.shape
     # feature chunk size bounded by the one-hot budget for a full row pass
     fc = max(1, min(F, _ONEHOT_BUDGET // max(n * B, 1)))
-    if fc >= 1 and n * B <= _ONEHOT_BUDGET:
-        return _hist_feature_scan(binned, stats, B, fc)
+    if n * B <= _ONEHOT_BUDGET:
+        return _hist_feature_scan(binned_t, stats_t, B, fc)
     # rows too large for even one feature at a time: block rows too
     rows_per_block = max(1, _ONEHOT_BUDGET // B)
-    # round to an MXU-friendly multiple
     rows_per_block = max(8, (rows_per_block // 1024) * 1024 or rows_per_block)
-    return _hist_row_blocks(binned, stats, B, rows_per_block)
+    return _hist_row_blocks(binned_t, stats_t, B, rows_per_block)
 
 
-def _hist_feature_scan(binned, stats, B, fc):
-    n, F = binned.shape
-    S = stats.shape[1]
+def _hist_feature_scan(binned_t, stats_t, B, fc):
+    F, n = binned_t.shape
+    S = stats_t.shape[0]
     n_chunks = -(-F // fc)
     Fp = n_chunks * fc
-    binned_t = jnp.transpose(binned)  # [F, n]
     if Fp != F:
         binned_t = jnp.pad(binned_t, ((0, Fp - F), (0, 0)), constant_values=0)
     chunks = binned_t.reshape(n_chunks, fc, n)
-    bins = jnp.arange(B, dtype=binned.dtype)
+    bins = jnp.arange(B, dtype=binned_t.dtype)
 
     def body(_, chunk):  # chunk [fc, n]
-        oh = (chunk[:, :, None] == bins).astype(stats.dtype)  # [fc, n, B]
-        h = jnp.einsum("ns,fnb->fsb", stats, oh,
+        oh = (chunk[:, :, None] == bins).astype(stats_t.dtype)  # [fc, n, B]
+        h = jnp.einsum("sn,fnb->fsb", stats_t, oh,
                        preferred_element_type=jnp.float32)
         return _, h
 
@@ -89,44 +155,47 @@ def _hist_feature_scan(binned, stats, B, fc):
     return hists.reshape(Fp, S, B)[:F].astype(jnp.float32)
 
 
-def _hist_row_blocks(binned, stats, B, rows_per_block):
-    n, F = binned.shape
-    S = stats.shape[1]
+def _hist_row_blocks(binned_t, stats_t, B, rows_per_block):
+    F, n = binned_t.shape
+    S = stats_t.shape[0]
     nb = -(-n // rows_per_block)
     n_pad = nb * rows_per_block
     if n_pad != n:
-        binned = jnp.pad(binned, ((0, n_pad - n), (0, 0)), constant_values=0)
-        stats = jnp.pad(stats, ((0, n_pad - n), (0, 0)))  # zero stats: no effect
-    binned_b = binned.reshape(nb, rows_per_block, F)
-    stats_b = stats.reshape(nb, rows_per_block, S)
-    bins = jnp.arange(B, dtype=binned.dtype)
+        binned_t = jnp.pad(binned_t, ((0, 0), (0, n_pad - n)),
+                           constant_values=0)
+        stats_t = jnp.pad(stats_t, ((0, 0), (0, n_pad - n)))  # zero: no effect
+    binned_b = binned_t.reshape(F, nb, rows_per_block)
+    stats_b = stats_t.reshape(S, nb, rows_per_block)
+    bins = jnp.arange(B, dtype=binned_t.dtype)
 
     def body(acc, xs):
-        bb, sb = xs  # [R, F], [R, S]
+        bb, sb = xs  # [F, R], [S, R]
 
         def feat_body(_, fchunk):  # fchunk [1, R]
             oh = (fchunk[:, :, None] == bins).astype(sb.dtype)  # [1, R, B]
-            return _, jnp.einsum("ns,fnb->fsb", sb, oh,
+            return _, jnp.einsum("sn,fnb->fsb", sb, oh,
                                  preferred_element_type=jnp.float32)
 
-        _, h = lax.scan(feat_body, None, jnp.transpose(bb)[:, None, :])
+        _, h = lax.scan(feat_body, None, bb[:, None, :])
         return acc + h.reshape(F, S, B), None
 
     acc0 = jnp.zeros((F, S, B), dtype=jnp.float32)
-    acc, _ = lax.scan(body, acc0, (binned_b, stats_b))
+    acc, _ = lax.scan(body, acc0,
+                      (jnp.transpose(binned_b, (1, 0, 2)),
+                       jnp.transpose(stats_b, (1, 0, 2))))
     return acc
 
 
 # ---------------------------------------------------------------------------
-# Pallas TPU kernel: the hot op of GBDT training.
+# Pallas TPU kernels: the hot op of GBDT training.
 #
 # The XLA formulations above materialize the [n, B] one-hot (and the masked
 # stats) in HBM, so at 1M rows x 255 bins they run bandwidth-bound at ~55 ms.
-# The kernel below keeps the one-hot entirely in VMEM: grid (F, n/RB), each
-# step builds a [RB, B] one-hot in registers/VMEM, feeds the MXU with a
-# [S, RB] x [RB, B] contraction, and accumulates the [S, B] block in the
-# output block that stays resident across the row-block axis (classic matmul
-# accumulation pattern). Measured ~1.5 ms for the same shape — ~35x.
+# The kernels below keep the one-hot entirely in VMEM: grid (n/RB,), each
+# step builds a [RB, B] one-hot in registers/VMEM per feature, feeds the MXU
+# with a [S, RB] x [RB, B] contraction, and accumulates the [S, B] block in
+# the output block that stays resident across the row-block axis (classic
+# matmul accumulation pattern). Measured ~1.5 ms for the same shape — ~35x.
 # ---------------------------------------------------------------------------
 
 _PALLAS_VMEM_BUDGET = 10 * 1024 * 1024   # headroom under the 16 MB scoped
@@ -135,13 +204,14 @@ _PALLAS_VMEM_BUDGET = 10 * 1024 * 1024   # headroom under the 16 MB scoped
 # 16.15 MB scoped allocation at S=96)
 
 
-def _pick_row_block(n: int, F: int, S: int, B: int) -> int:
+def _pick_row_block(n: int, F: int, S: int, B: int, fused_w: int = 0) -> int:
     """Largest row-block size whose resident VMEM fits the budget.
 
-    VMEM model (matches ``_make_hist_kernel``): input blocks are
-    double-buffered across grid steps (binned [F, RB] int32 and stats
-    [Sp, RB] bf16); the [F, Sp, BP] f32 accumulator stays resident; the
-    per-feature one-hot [RB, BP] bf16 is kernel scratch (single copy).
+    VMEM model (matches the kernels): input blocks are double-buffered across
+    grid steps (binned [F, RB] int32 and stats [Sp, RB] bf16 — or, fused,
+    [8, RB] f32 base + [1, RB] i32 positions); the [F, Sp, BP] f32 accumulator
+    stays resident; kernel scratch is the per-feature one-hot [RB, BP] bf16
+    plus, fused, the rebuilt [W, 3, RB] + [Sp, RB] masked stats.
     """
     BP = -(-B // 128) * 128
     Sp = -(-max(S, 1) // 16) * 16
@@ -149,17 +219,16 @@ def _pick_row_block(n: int, F: int, S: int, B: int) -> int:
         if RB > max(512, n):
             continue  # don't pad a small input up to a huge block
         binned_block = F * RB * 4
-        stats_block = Sp * RB * 2
+        if fused_w:
+            in_blocks = binned_block + RB * 4 + 8 * RB * 4
+            scratch = RB * BP * 2 + 2 * (fused_w * 3 * RB * 2) + Sp * RB * 2
+        else:
+            in_blocks = binned_block + Sp * RB * 2
+            scratch = RB * BP * 2
         out_block = F * Sp * BP * 4
-        onehot = RB * BP * 2
-        if 2 * (binned_block + stats_block) + out_block + onehot \
-                <= _PALLAS_VMEM_BUDGET:
+        if 2 * in_blocks + out_block + scratch <= _PALLAS_VMEM_BUDGET:
             return RB
     return 0
-
-
-def _pallas_fits(n: int, F: int, S: int, B: int) -> bool:
-    return _pick_row_block(n, F, S, B) > 0
 
 
 def _make_hist_kernel(F: int, BP: int):
@@ -173,7 +242,7 @@ def _make_hist_kernel(F: int, BP: int):
 
         def body(f, _):
             # sequential features: exactly one [RB, BP] one-hot live in VMEM
-            row = b_ref[0, f, :]                    # [RB] int32
+            row = b_ref[f, :]                       # [RB] int32
             bins = lax.broadcasted_iota(jnp.int32, (row.shape[0], BP), 1)
             oh = (row[:, None] == bins).astype(sb.dtype)  # VMEM-only
             h = lax.dot_general(sb, oh, (((1,), (0,)), ((), ())),
@@ -186,37 +255,98 @@ def _make_hist_kernel(F: int, BP: int):
     return kernel
 
 
-def _hist_pallas(binned: jnp.ndarray, stats: jnp.ndarray,
+def _make_node_hist_kernel(F: int, W: int, Sp: int, BP: int):
+    def kernel(b_ref, p_ref, base_ref, o_ref):
+        j = pl.program_id(0)
+        pos = p_ref[0, :]                           # [RB] int32
+        base = base_ref[0:3, :].astype(jnp.bfloat16)  # [3, RB]
+        woh = (lax.broadcasted_iota(jnp.int32, (W, pos.shape[0]), 0)
+               == pos[None, :])                     # [W, RB] bool
+        sb = jnp.where(woh[:, None, :], base[None, :, :],
+                       jnp.bfloat16(0.0)).reshape(3 * W, pos.shape[0])
+        if Sp != 3 * W:
+            sb = jnp.pad(sb, ((0, Sp - 3 * W), (0, 0)))
+
+        @pl.when(j == 0)
+        def _():
+            o_ref[...] = jnp.zeros_like(o_ref)
+
+        def body(f, _):
+            row = b_ref[f, :]                       # [RB] int32
+            bins = lax.broadcasted_iota(jnp.int32, (row.shape[0], BP), 1)
+            oh = (row[:, None] == bins).astype(sb.dtype)  # VMEM-only
+            h = lax.dot_general(sb, oh, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [Sp, BP]
+            o_ref[f] += h
+            return 0
+
+        lax.fori_loop(0, F, body, 0)
+
+    return kernel
+
+
+def _pad_rows_to(x, n_pad, fill=0):
+    n = x.shape[-1]
+    if n_pad == n:
+        return x
+    width = [(0, 0)] * (x.ndim - 1) + [(0, n_pad - n)]
+    return jnp.pad(x, width, constant_values=fill)
+
+
+def _hist_pallas(binned_t: jnp.ndarray, stats_t: jnp.ndarray,
                  num_bins: int) -> jnp.ndarray:
-    n, F = binned.shape
-    S = stats.shape[1]
+    F, n = binned_t.shape
+    S = stats_t.shape[0]
     B = int(num_bins)
     BP = -(-B // 128) * 128                        # pad bins to lane multiple
     Sp = -(-S // 16) * 16                          # pad stats to sublane tile
     RB = _pick_row_block(n, F, S, B)
     n_pad = -(-max(n, RB) // RB) * RB
-    if n_pad != n:
-        # zero stats on padding rows: they contribute nothing to any bin
-        binned = jnp.pad(binned, ((0, n_pad - n), (0, 0)), constant_values=0)
-        stats = jnp.pad(stats, ((0, n_pad - n), (0, 0)))
+    # zero stats on padding rows: they contribute nothing to any bin
+    binned_t = _pad_rows_to(binned_t, n_pad)
+    stats_t = _pad_rows_to(stats_t, n_pad)
     if Sp != S:
-        stats = jnp.pad(stats, ((0, 0), (0, Sp - S)))
+        stats_t = jnp.pad(stats_t, ((0, Sp - S), (0, 0)))
     nb = n_pad // RB
-    # [nb, F, RB]: each grid step sees one row block of every feature.
-    # stats transposed to [Sp, n]: rows ride the 128-lane axis, so a small
-    # stat count doesn't waste lanes (and the dot contracts the lane axis).
-    binned_b = jnp.transpose(binned.reshape(nb, RB, F), (0, 2, 1))
-    stats_t = jnp.transpose(stats)
 
     out = pl.pallas_call(
         _make_hist_kernel(F, BP),
         grid=(nb,),
         in_specs=[
-            pl.BlockSpec((1, F, RB), lambda j: (j, 0, 0)),
+            pl.BlockSpec((F, RB), lambda j: (0, j)),
             pl.BlockSpec((Sp, RB), lambda j: (0, j)),
         ],
         out_specs=pl.BlockSpec((F, Sp, BP), lambda j: (0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((F, Sp, BP), jnp.float32),
-    )(binned_b, stats_t)
+    )(binned_t, stats_t)
     return out[:, :S, :B]
 
+
+def _node_hist_pallas(binned_t: jnp.ndarray, row_pos: jnp.ndarray,
+                      base_t: jnp.ndarray, W: int, B: int) -> jnp.ndarray:
+    F, n = binned_t.shape
+    S = 3 * W
+    BP = -(-B // 128) * 128
+    Sp = -(-S // 16) * 16
+    RB = _pick_row_block(n, F, S, B, fused_w=W)
+    n_pad = -(-max(n, RB) // RB) * RB
+    binned_t = _pad_rows_to(binned_t, n_pad)
+    # padding rows: position -1 matches no frontier node -> contribute nothing
+    row_pos = _pad_rows_to(row_pos, n_pad, fill=-1)[None, :]
+    # base rides f32 [8, n] (sublane-aligned); rows 3..7 are dead padding
+    base8 = jnp.pad(base_t, ((0, 5), (0, 0)))
+    base8 = _pad_rows_to(base8, n_pad)
+    nb = n_pad // RB
+
+    out = pl.pallas_call(
+        _make_node_hist_kernel(F, W, Sp, BP),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((F, RB), lambda j: (0, j)),
+            pl.BlockSpec((1, RB), lambda j: (0, j)),
+            pl.BlockSpec((8, RB), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((F, Sp, BP), lambda j: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, Sp, BP), jnp.float32),
+    )(binned_t, row_pos, base8)
+    return out[:, :S, :B]
