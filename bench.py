@@ -98,7 +98,63 @@ def main() -> None:
         "bench_iterations": bench_iters,
         "growth_policy": "depthwise",
         "platform": "tpu" if on_tpu else "cpu-fallback",
+        # secondary headline (BASELINE.json config 3): ResNet-50 featurizer
+        # throughput; no absolute reference anchor is published, so the raw
+        # number is reported without a vs_ ratio
+        "resnet50_imgs_per_sec_chip": _resnet50_imgs_per_sec(on_tpu),
+        # serving latency vs the reference's ~1 ms continuous-mode claim
+        # (docs/mmlspark-serving.md:10-11)
+        **_serving_latency(),
     }))
+
+
+def _serving_latency() -> dict:
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.test_serving_latency import serving_latency_stats
+    s = serving_latency_stats(n_seq=200, n_conc=8, conc_each=50)
+    return {"serving_p50_ms": round(s["p50_ms"], 3),
+            "serving_p99_ms": round(s["p99_ms"], 3),
+            "serving_concurrent_rps": round(s["concurrent_rps"], 1),
+            "serving_vs_1ms_claim": round(1.0 / max(s["p50_ms"], 1e-9), 2)}
+
+
+def _resnet50_imgs_per_sec(on_tpu: bool) -> float:
+    """ImageFeaturizer throughput on ResNet-50 (bottleneck, bf16 activations),
+    224x224 inputs, pool-layer capture — the transfer-learning workload of
+    the reference's notebook example 9 (CNTKModel ResNet-50 featurizer)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mmlspark_tpu.models.dnn.cnn import (CNNConfig, apply_cnn,
+                                             init_cnn_params)
+
+    if on_tpu:
+        cfg = CNNConfig(num_classes=1000, stage_sizes=(3, 4, 6, 3), width=64,
+                        block="bottleneck", input_hw=(224, 224),
+                        dtype=jnp.bfloat16)
+        batch, reps = 128, 8
+    else:
+        cfg = CNNConfig(num_classes=10, stage_sizes=(1, 1, 1, 1), width=8,
+                        block="bottleneck", input_hw=(64, 64))
+        batch, reps = 8, 2
+    params = init_cnn_params(cfg, jax.random.PRNGKey(0))
+
+    @jax.jit
+    def featurize(p, x):
+        _, acts = apply_cnn(p, x, cfg, capture=["pool"])
+        return acts["pool"]
+
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(batch, *cfg.input_hw, 3)).astype(np.float32))
+    featurize(params, x).block_until_ready()       # compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = featurize(params, x)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    return round(batch * reps / dt, 1)
 
 
 if __name__ == "__main__":

@@ -153,13 +153,31 @@ class ServingServer:
         return f"http://{self.host}:{self.port}/{self.api_name}"
 
     # -- source side -------------------------------------------------------
-    def get_batch(self, max_batch: int, max_latency: float) -> List[ServedRequest]:
-        """Up to ``max_batch`` requests, waiting at most ``max_latency`` after
-        the first arrival (deadline-driven dynamic batching)."""
+    def get_batch(self, max_batch: int, max_latency: float,
+                  eager: bool = True) -> List[ServedRequest]:
+        """Up to ``max_batch`` requests.
+
+        ``eager`` (default): after the first arrival, greedily drain whatever
+        is already queued and reply immediately — a lone request never pays
+        the batching deadline, so idle-load p50 is the transform time, while
+        concurrent load still forms full batches from the backlog (the
+        ~1 ms-latency regime of the reference's continuous serving,
+        docs/mmlspark-serving.md:10-11). ``eager=False`` restores
+        deadline-driven accumulation: wait up to ``max_latency`` after the
+        first arrival to fill the batch (maximum MXU occupancy under
+        staggered arrivals, at the cost of the deadline on p50).
+        """
         out: List[ServedRequest] = []
         try:
             out.append(self._queue.get(timeout=max_latency))
         except queue.Empty:
+            return out
+        if eager:
+            while len(out) < max_batch:
+                try:
+                    out.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
             return out
         deadline = time.monotonic() + max_latency
         while len(out) < max_batch:
@@ -252,12 +270,13 @@ class ServingQuery:
     def __init__(self, server: ServingServer,
                  transform: Callable[[Dataset], Dataset],
                  reply_col: str = "reply", max_batch: int = 32,
-                 max_latency: float = 0.005):
+                 max_latency: float = 0.005, eager: bool = True):
         self.server = server
         self.transform = transform
         self.reply_col = reply_col
         self.max_batch = max_batch
         self.max_latency = max_latency
+        self.eager = eager
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self.batches_served = 0
@@ -280,7 +299,8 @@ class ServingQuery:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            batch = self.server.get_batch(self.max_batch, self.max_latency)
+            batch = self.server.get_batch(self.max_batch, self.max_latency,
+                                          self.eager)
             if not batch:
                 continue
             ds = requests_to_dataset(batch)
@@ -309,6 +329,7 @@ class ServingBuilder:
     def __init__(self):
         self._host, self._port, self._name = "localhost", 0, "serving"
         self._max_batch, self._max_latency = 32, 0.005
+        self._eager = True
         self._transform: Optional[Callable[[Dataset], Dataset]] = None
         self._reply_col = "reply"
         self._timeout = 30.0
@@ -318,9 +339,13 @@ class ServingBuilder:
         self._host, self._port, self._name = host, port, api_name
         return self
 
-    def batch(self, max_batch: int = 32, max_latency_ms: float = 5.0
-              ) -> "ServingBuilder":
+    def batch(self, max_batch: int = 32, max_latency_ms: float = 5.0,
+              eager: bool = True) -> "ServingBuilder":
+        """``eager=False`` opts into deadline accumulation (wait up to
+        ``max_latency_ms`` to fill a batch); default replies as soon as the
+        queued backlog is drained."""
         self._max_batch, self._max_latency = max_batch, max_latency_ms / 1000.0
+        self._eager = eager
         return self
 
     def request_timeout(self, seconds: float) -> "ServingBuilder":
@@ -362,7 +387,8 @@ class ServingBuilder:
             raise ValueError("no transform set; call .transform(fn) or .pipeline(model)")
         server = ServingServer(self._host, self._port, self._name, self._timeout)
         return ServingQuery(server, self._transform, self._reply_col,
-                            self._max_batch, self._max_latency).start()
+                            self._max_batch, self._max_latency,
+                            self._eager).start()
 
 
 def serve() -> ServingBuilder:

@@ -6,7 +6,10 @@ DNNModel/ImageFeaturizer pipeline stages (CNTKModel parity); ``downloader``:
 pretrained-model repository.
 """
 
-from .cnn import CNNConfig, apply_cnn, feature_dim, init_cnn_params
+from .cnn import (AlexNetConfig, CNNConfig, alexnet_feature_dim,
+                  apply_alexnet, apply_cnn, feature_dim, fold_bn,
+                  from_torch_resnet_state_dict, init_alexnet_params,
+                  init_cnn_params)
 from .downloader import ModelDownloader, ModelSchema, retry_with_timeout
 from .scoring import DNNModel, ImageFeaturizer
 

@@ -8,15 +8,18 @@ output node, ImageFeaturizer's layer cutting, image/ImageFeaturizer.scala:
 96-141) is a ``capture`` argument instead of graph editing: apply returns
 (logits, {node_name: activation}).
 
-Convs are NHWC bfloat16-friendly and lower straight onto the MXU; batch-norm
-is folded into inference scale/shift (no training here — this is the scoring
-path, like CNTK eval).
+Two block styles cover the reference's featurizer catalog: ``basic``
+(ResNet-18/34) and ``bottleneck`` (ResNet-50/101/152: 1x1 -> 3x3 -> 1x1 with
+4x channel expansion), plus a classic AlexNet tower. Convs are NHWC
+bfloat16-friendly and lower straight onto the MXU; batch-norm is folded into
+inference scale/shift (no training here — this is the scoring path, like
+CNTK eval).
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Any, Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,13 +29,22 @@ from jax import lax
 
 @dataclass(frozen=True)
 class CNNConfig:
-    """ResNet-v1-style config. stage_sizes=[2,2,2,2] ~ ResNet-18 shape."""
+    """ResNet-v1-style config.
+
+    block="basic": two 3x3 convs per block (stage_sizes=[2,2,2,2] ~ ResNet-18,
+    [3,4,6,3] ~ ResNet-34). block="bottleneck": 1x1/3x3/1x1 with expansion 4
+    ([3,4,6,3] ~ ResNet-50, [3,4,23,3] ~ ResNet-101, [3,8,36,3] ~ ResNet-152).
+    """
 
     num_classes: int = 1000
     stage_sizes: Tuple[int, ...] = (2, 2, 2, 2)
     width: int = 64
     input_hw: Tuple[int, int] = (224, 224)
     dtype: Any = jnp.float32
+    block: str = "basic"
+
+
+_EXPANSION = {"basic": 1, "bottleneck": 4}
 
 
 def _conv_init(key, kh, kw, cin, cout):
@@ -41,22 +53,39 @@ def _conv_init(key, kh, kw, cin, cout):
     return w.astype(jnp.float32)
 
 
+def _bn_unit(cout):
+    return {"scale": jnp.ones((cout,)), "bias": jnp.zeros((cout,))}
+
+
 def init_cnn_params(cfg: CNNConfig, key) -> Dict[str, Any]:
-    keys = iter(jax.random.split(key, 4 + 2 * sum(cfg.stage_sizes) * 2 + 2))
+    expansion = _EXPANSION[cfg.block]
+    n_convs = {"basic": 2, "bottleneck": 3}[cfg.block]
+    keys = iter(jax.random.split(
+        key, 4 + (n_convs + 1) * sum(cfg.stage_sizes) + 2))
     params: Dict[str, Any] = {
         "stem": {"w": _conv_init(next(keys), 7, 7, 3, cfg.width),
-                 "scale": jnp.ones((cfg.width,)),
-                 "bias": jnp.zeros((cfg.width,))}}
+                 **_bn_unit(cfg.width)}}
     cin = cfg.width
     for s, n_blocks in enumerate(cfg.stage_sizes):
-        cout = cfg.width * (2 ** s)
+        mid = cfg.width * (2 ** s)
+        cout = mid * expansion
         for b in range(n_blocks):
-            blk = {
-                "conv1": {"w": _conv_init(next(keys), 3, 3, cin, cout),
-                          "scale": jnp.ones((cout,)), "bias": jnp.zeros((cout,))},
-                "conv2": {"w": _conv_init(next(keys), 3, 3, cout, cout),
-                          "scale": jnp.ones((cout,)), "bias": jnp.zeros((cout,))},
-            }
+            if cfg.block == "basic":
+                blk = {
+                    "conv1": {"w": _conv_init(next(keys), 3, 3, cin, mid),
+                              **_bn_unit(mid)},
+                    "conv2": {"w": _conv_init(next(keys), 3, 3, mid, cout),
+                              **_bn_unit(cout)},
+                }
+            else:
+                blk = {
+                    "conv1": {"w": _conv_init(next(keys), 1, 1, cin, mid),
+                              **_bn_unit(mid)},
+                    "conv2": {"w": _conv_init(next(keys), 3, 3, mid, mid),
+                              **_bn_unit(mid)},
+                    "conv3": {"w": _conv_init(next(keys), 1, 1, mid, cout),
+                              **_bn_unit(cout)},
+                }
             if cin != cout:
                 blk["proj"] = {"w": _conv_init(next(keys), 1, 1, cin, cout)}
             params[f"stage{s}_block{b}"] = blk
@@ -69,8 +98,13 @@ def init_cnn_params(cfg: CNNConfig, key) -> Dict[str, Any]:
 
 
 def _conv(x, w, stride=1):
+    # explicit symmetric (k-1)//2 padding, not "SAME": under stride 2 SAME
+    # pads asymmetrically, which would silently de-align genuinely pretrained
+    # weights imported via from_torch_resnet_state_dict (torch pads
+    # symmetrically)
+    ph, pw = (w.shape[0] - 1) // 2, (w.shape[1] - 1) // 2
     return lax.conv_general_dilated(
-        x, w, (stride, stride), "SAME",
+        x, w, (stride, stride), ((ph, ph), (pw, pw)),
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
@@ -89,7 +123,7 @@ def apply_cnn(params: Dict[str, Any], x: jnp.ndarray, cfg: CNNConfig,
     stem = params["stem"]
     x = _bn_relu(_conv(x, stem["w"], stride=2), stem)
     x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
-                          "SAME")
+                          ((0, 0), (1, 1), (1, 1), (0, 0)))
     if "stem" in capture:
         acts["stem"] = x
     for s, n_blocks in enumerate(cfg.stage_sizes):
@@ -97,8 +131,15 @@ def apply_cnn(params: Dict[str, Any], x: jnp.ndarray, cfg: CNNConfig,
             name = f"stage{s}_block{b}"
             blk = params[name]
             stride = 2 if (b == 0 and s > 0) else 1
-            h = _bn_relu(_conv(x, blk["conv1"]["w"], stride), blk["conv1"])
-            h = _conv(h, blk["conv2"]["w"]) * blk["conv2"]["scale"] + blk["conv2"]["bias"]
+            if cfg.block == "basic":
+                h = _bn_relu(_conv(x, blk["conv1"]["w"], stride), blk["conv1"])
+                h = (_conv(h, blk["conv2"]["w"]) * blk["conv2"]["scale"]
+                     + blk["conv2"]["bias"])
+            else:
+                h = _bn_relu(_conv(x, blk["conv1"]["w"]), blk["conv1"])
+                h = _bn_relu(_conv(h, blk["conv2"]["w"], stride), blk["conv2"])
+                h = (_conv(h, blk["conv3"]["w"]) * blk["conv3"]["scale"]
+                     + blk["conv3"]["bias"])
             shortcut = x
             if "proj" in blk:
                 shortcut = _conv(x, blk["proj"]["w"], stride)
@@ -117,4 +158,162 @@ def apply_cnn(params: Dict[str, Any], x: jnp.ndarray, cfg: CNNConfig,
 
 
 def feature_dim(cfg: CNNConfig) -> int:
-    return cfg.width * (2 ** (len(cfg.stage_sizes) - 1))
+    return (cfg.width * (2 ** (len(cfg.stage_sizes) - 1))
+            * _EXPANSION[cfg.block])
+
+
+# ---------------------------------------------------------------------------
+# AlexNet (the reference catalog's other featurizer family —
+# downloader/ModelDownloader.scala:37-276 fetches CNTK AlexNet; featurization
+# cuts at fc7, image/ImageFeaturizer.scala:96-141)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AlexNetConfig:
+    num_classes: int = 1000
+    input_hw: Tuple[int, int] = (224, 224)
+    width_mult: float = 1.0           # shrink for tests
+    dtype: Any = jnp.float32
+
+
+def _alex_dims(cfg: AlexNetConfig):
+    m = cfg.width_mult
+    chans = [int(c * m) or 1 for c in (64, 192, 384, 256, 256)]
+    fc = int(4096 * m) or 1
+    return chans, fc
+
+
+def _alex_spatial(cfg: AlexNetConfig) -> Tuple[int, int]:
+    """Spatial dims entering fc6: stride-4 stem then three stride-2 SAME
+    pools, each with ceil semantics — exact for any (even non-square,
+    non-multiple-of-32) input size."""
+    def axis(d):
+        d = -(-d // 4)            # stem conv, stride 4, symmetric padding
+        for _ in range(3):        # pools after conv1, conv2, conv5
+            d = -(-d // 2)
+        return d
+    return axis(cfg.input_hw[0]), axis(cfg.input_hw[1])
+
+
+def init_alexnet_params(cfg: AlexNetConfig, key) -> Dict[str, Any]:
+    chans, fc = _alex_dims(cfg)
+    keys = iter(jax.random.split(key, 16))
+    specs = [(11, 3, chans[0]), (5, chans[0], chans[1]),
+             (3, chans[1], chans[2]), (3, chans[2], chans[3]),
+             (3, chans[3], chans[4])]
+    params: Dict[str, Any] = {}
+    for i, (k, cin, cout) in enumerate(specs):
+        params[f"conv{i + 1}"] = {
+            "w": _conv_init(next(keys), k, k, cin, cout),
+            "b": jnp.zeros((cout,))}
+    h, w = _alex_spatial(cfg)
+    flat = chans[4] * h * w
+    for i, (din, dout) in enumerate([(flat, fc), (fc, fc),
+                                     (fc, cfg.num_classes)]):
+        params[f"fc{i + 6}"] = {
+            "w": jax.random.normal(next(keys), (din, dout))
+            * np.sqrt(2.0 / din),
+            "b": jnp.zeros((dout,))}
+    return params
+
+
+def apply_alexnet(params: Dict[str, Any], x: jnp.ndarray, cfg: AlexNetConfig,
+                  capture: Sequence[str] = ()):
+    """AlexNet forward; capture nodes: conv1..conv5, fc6, fc7 (the
+    featurization layer), logits."""
+    acts: Dict[str, jnp.ndarray] = {}
+    x = x.astype(cfg.dtype)
+
+    def pool(v):
+        return lax.reduce_window(v, -jnp.inf, lax.max, (1, 3, 3, 1),
+                                 (1, 2, 2, 1), "SAME")
+
+    strides = [4, 1, 1, 1, 1]
+    pools = [True, True, False, False, True]
+    for i in range(5):
+        p = params[f"conv{i + 1}"]
+        x = jax.nn.relu(_conv(x, p["w"], strides[i]) + p["b"])
+        if pools[i]:
+            x = pool(x)
+        if f"conv{i + 1}" in capture:
+            acts[f"conv{i + 1}"] = x
+    x = x.reshape(x.shape[0], -1)
+    for name in ("fc6", "fc7"):
+        p = params[name]
+        x = jax.nn.relu(x @ p["w"] + p["b"])
+        if name in capture:
+            acts[name] = x
+    p = params["fc8"]
+    logits = x @ p["w"] + p["b"]
+    if "logits" in capture:
+        acts["logits"] = logits
+    return logits, acts
+
+
+def alexnet_feature_dim(cfg: AlexNetConfig) -> int:
+    return _alex_dims(cfg)[1]
+
+
+# ---------------------------------------------------------------------------
+# Real-weight import: torchvision ResNet state_dicts -> our pytree.
+# ---------------------------------------------------------------------------
+
+
+def fold_bn(gamma, beta, mean, var, eps: float = 1e-5):
+    """Inference-fold batch-norm into (scale, bias): y = x*scale + bias."""
+    scale = gamma / np.sqrt(var + eps)
+    return scale.astype(np.float32), (beta - mean * scale).astype(np.float32)
+
+
+def from_torch_resnet_state_dict(sd: Dict[str, np.ndarray],
+                                 cfg: CNNConfig) -> Dict[str, Any]:
+    """Convert a torchvision ``resnet*`` state_dict (tensors as numpy arrays,
+    OIHW conv weights) into the apply_cnn param pytree, folding batch-norm
+    running stats into inference scale/bias.
+
+    Enables loading genuinely pretrained ResNet-50 weights from a local
+    ``file://`` checkpoint (the reference downloads trained CNTK models the
+    same way — downloader/ModelDownloader.scala:37-276). This converter plus
+    ``ModelDownloader.save_model`` produces a repo payload from any
+    torchvision-format checkpoint without needing torch at load time.
+    """
+    def conv(prefix):
+        return np.ascontiguousarray(
+            np.transpose(np.asarray(sd[prefix + ".weight"]), (2, 3, 1, 0))
+        ).astype(np.float32)  # OIHW -> HWIO
+
+    def bn(prefix):
+        s, b = fold_bn(np.asarray(sd[prefix + ".weight"]),
+                       np.asarray(sd[prefix + ".bias"]),
+                       np.asarray(sd[prefix + ".running_mean"]),
+                       np.asarray(sd[prefix + ".running_var"]))
+        return {"scale": s, "bias": b}
+
+    params: Dict[str, Any] = {
+        "stem": {"w": conv("conv1"), **bn("bn1")}}
+    n_convs = {"basic": 2, "bottleneck": 3}[cfg.block]
+    for s, n_blocks in enumerate(cfg.stage_sizes):
+        for b in range(n_blocks):
+            t = f"layer{s + 1}.{b}"
+            blk: Dict[str, Any] = {}
+            for c in range(1, n_convs + 1):
+                blk[f"conv{c}"] = {"w": conv(f"{t}.conv{c}"),
+                                   **bn(f"{t}.bn{c}")}
+            if f"{t}.downsample.0.weight" in sd:
+                # torchvision's downsample = conv + bn; fold the bn into the
+                # projection by scaling its output channels
+                w = conv(f"{t}.downsample.0")
+                dbn = bn(f"{t}.downsample.1")
+                blk["proj"] = {"w": w * dbn["scale"]}
+                # bn bias on the shortcut shifts the sum pre-relu; carry it
+                # into the main-path bias of the last conv block
+                last = f"conv{n_convs}"
+                blk[last] = dict(blk[last])
+                blk[last]["bias"] = blk[last]["bias"] + dbn["bias"]
+            params[f"stage{s}_block{b}"] = blk
+    params["head"] = {
+        "w": np.ascontiguousarray(
+            np.transpose(np.asarray(sd["fc.weight"]))).astype(np.float32),
+        "b": np.asarray(sd["fc.bias"]).astype(np.float32)}
+    return params

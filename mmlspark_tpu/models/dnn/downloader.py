@@ -3,23 +3,30 @@
 Parity: downloader/ModelDownloader.scala:37-276 (fetch CNTK models from the
 Azure blob repo with sha-hash verification and FaultToleranceUtils
 retry-with-timeout, downloader/Schema.scala:30 ``ModelSchema`` with
-layerNames). The TPU model format is a pickled JAX param pytree + CNNConfig;
+layerNames). The TPU model format is a param pytree + an architecture config;
 sources are ``file://`` paths or HTTP URLs (fetched through the io.http retry
 client), plus a *builtin* registry of deterministically-initialised
 architectures so the framework is usable with zero egress — materialising a
 builtin is the "download" and lands in the same local repository with the
 same hash bookkeeping.
+
+Payloads: the native format is ``.npz`` (flattened pytree, loads with
+``allow_pickle=False`` — safe for payloads fetched over HTTP); legacy pickle
+payloads from older repos still load. Genuinely pretrained weights enter via
+``import_torch_resnet`` (torchvision-format state_dict -> folded-BN pytree ->
+repo payload) or ``save_model`` from any user-built pytree.
 """
 
 from __future__ import annotations
 
 import hashlib
+import io
 import json
 import os
 import pickle
 import time
 from dataclasses import asdict, dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import numpy as np
 
@@ -55,18 +62,110 @@ def retry_with_timeout(fn, retries: int = 3, backoff: float = 0.5):
     raise last
 
 
-_BUILTIN = {
-    # name -> (stage_sizes, width, num_classes, input_hw)
-    # full-width families (the featurizer catalog the reference fetches from
-    # its Azure repo — downloader/ModelDownloader.scala:37-276; weights here
-    # are deterministic random inits, pending a hosted weight repo)
-    "ResNet18": ((2, 2, 2, 2), 64, 1000, (224, 224)),
-    "ResNet34": ((3, 4, 6, 3), 64, 1000, (224, 224)),
+# the featurizer catalog the reference fetches from its Azure repo
+# (downloader/ModelDownloader.scala:37-276: AlexNet + the ResNet family);
+# builtin weights are deterministic inits — real weights come in through
+# import_torch_resnet / save_model / file:// payloads.
+_BUILTIN: Dict[str, Dict[str, Any]] = {
+    "ResNet18": dict(arch="resnet", stage_sizes=(2, 2, 2, 2), width=64,
+                     block="basic", num_classes=1000, input_hw=(224, 224)),
+    "ResNet34": dict(arch="resnet", stage_sizes=(3, 4, 6, 3), width=64,
+                     block="basic", num_classes=1000, input_hw=(224, 224)),
+    "ResNet50": dict(arch="resnet", stage_sizes=(3, 4, 6, 3), width=64,
+                     block="bottleneck", num_classes=1000,
+                     input_hw=(224, 224)),
+    "ResNet101": dict(arch="resnet", stage_sizes=(3, 4, 23, 3), width=64,
+                      block="bottleneck", num_classes=1000,
+                      input_hw=(224, 224)),
+    "ResNet152": dict(arch="resnet", stage_sizes=(3, 8, 36, 3), width=64,
+                      block="bottleneck", num_classes=1000,
+                      input_hw=(224, 224)),
+    "AlexNet": dict(arch="alexnet", num_classes=1000, input_hw=(224, 224),
+                    width_mult=1.0),
     # small variants for tests / CI
-    "ResNet18Tiny": ((2, 2, 2, 2), 16, 1000, (224, 224)),
-    "ResNet10Micro": ((1, 1, 1, 1), 8, 1000, (64, 64)),
-    "ConvNetMNIST": ((1, 1), 8, 10, (28, 28)),
+    "ResNet18Tiny": dict(arch="resnet", stage_sizes=(2, 2, 2, 2), width=16,
+                         block="basic", num_classes=1000,
+                         input_hw=(224, 224)),
+    "ResNet50Tiny": dict(arch="resnet", stage_sizes=(1, 1, 1, 1), width=8,
+                         block="bottleneck", num_classes=10,
+                         input_hw=(64, 64)),
+    "ResNet10Micro": dict(arch="resnet", stage_sizes=(1, 1, 1, 1), width=8,
+                          block="basic", num_classes=1000,
+                          input_hw=(64, 64)),
+    "AlexNetTiny": dict(arch="alexnet", num_classes=10, input_hw=(64, 64),
+                        width_mult=0.0625),
+    "ConvNetMNIST": dict(arch="resnet", stage_sizes=(1, 1), width=8,
+                         block="basic", num_classes=10, input_hw=(28, 28)),
 }
+
+
+def _layer_names(spec: Dict[str, Any]) -> List[str]:
+    if spec["arch"] == "alexnet":
+        return [f"conv{i}" for i in range(1, 6)] + ["fc6", "fc7", "logits"]
+    return (["stem"]
+            + [f"stage{s}_block{b}"
+               for s, nb in enumerate(spec["stage_sizes"])
+               for b in range(nb)] + ["pool", "logits"])
+
+
+def _num_layers(spec: Dict[str, Any]) -> int:
+    if spec["arch"] == "alexnet":
+        return 8
+    per_block = {"basic": 2, "bottleneck": 3}[spec["block"]]
+    return per_block * sum(spec["stage_sizes"]) + 2
+
+
+# -- payload (de)serialization ----------------------------------------------
+
+
+def _flatten(tree: Dict[str, Any], prefix="") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    for k, v in tree.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "/"))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    tree: Dict[str, Any] = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        d = tree
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return tree
+
+
+def serialize_payload(params: Dict[str, Any], config: Dict[str, Any]) -> bytes:
+    """npz payload: flattened param pytree + a JSON config entry."""
+    arrays = _flatten(params, "param/")
+    arrays["config_json"] = np.frombuffer(
+        json.dumps(config).encode(), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def deserialize_payload(data: bytes,
+                        allow_pickle: bool = True) -> Dict[str, Any]:
+    """Parse a model payload. ``allow_pickle=False`` (mandatory for bytes
+    fetched from remote sources) accepts only the npz format — pickle is
+    arbitrary code execution on attacker-controlled data. The pickle branch
+    exists solely for pre-npz payloads already sitting in local repos."""
+    if data[:2] == b"PK":              # npz (zip magic) — the safe format
+        z = np.load(io.BytesIO(data), allow_pickle=False)
+        config = json.loads(bytes(z["config_json"]).decode())
+        params = _unflatten({k[len("param/"):]: z[k] for k in z.files
+                             if k.startswith("param/")})
+        return {"params": params, "config": config}
+    if not allow_pickle:
+        raise IOError("remote model payload is not npz-format; refusing to "
+                      "unpickle bytes from a remote source")
+    return pickle.loads(data)          # legacy local repos
 
 
 class ModelDownloader:
@@ -90,13 +189,10 @@ class ModelDownloader:
         """The builtin catalog (the Azure-blob listing analog)."""
         return [ModelSchema(name=n, modelType="image",
                             uri=f"builtin://{n}",
-                            inputDims=[*_BUILTIN[n][3], 3],
-                            numLayers=2 * sum(_BUILTIN[n][0]) + 2,
-                            layerNames=["stem"]
-                            + [f"stage{s}_block{b}"
-                               for s, nb in enumerate(_BUILTIN[n][0])
-                               for b in range(nb)] + ["pool", "logits"])
-                for n in _BUILTIN]
+                            inputDims=[*spec["input_hw"], 3],
+                            numLayers=_num_layers(spec),
+                            layerNames=_layer_names(spec))
+                for n, spec in _BUILTIN.items()]
 
     # -- fetching -----------------------------------------------------------
     def download_model(self, schema_or_name) -> ModelSchema:
@@ -108,6 +204,10 @@ class ModelDownloader:
             return self._read_schema(schema.name)
         os.makedirs(target, exist_ok=True)
         data = retry_with_timeout(lambda: self._fetch(schema))
+        if schema.uri.startswith(("http://", "https://")):
+            # validate BEFORE persisting: remote bytes must be npz (a local
+            # pickle file would otherwise execute on the next load_model)
+            deserialize_payload(data, allow_pickle=False)
         digest = hashlib.sha256(data).hexdigest()
         if schema.sha256 and digest != schema.sha256:
             raise IOError(f"hash mismatch for {schema.name}: "
@@ -119,17 +219,66 @@ class ModelDownloader:
             f.write(schema.to_json())
         return schema
 
+    def save_model(self, name: str, params: Dict[str, Any],
+                   config: Dict[str, Any]) -> ModelSchema:
+        """Install a user-built pytree (e.g. converted pretrained weights)
+        into the repository as an npz payload."""
+        data = serialize_payload(_flatten_to_tree(params), config)
+        target = os.path.join(self.repo_dir, name)
+        os.makedirs(target, exist_ok=True)
+        with open(os.path.join(target, "model.pkl"), "wb") as f:
+            f.write(data)
+        schema = ModelSchema(
+            name=name, modelType="image", uri=f"local://{name}",
+            sha256=hashlib.sha256(data).hexdigest(),
+            inputDims=[*config.get("input_hw", (224, 224)), 3])
+        with open(os.path.join(target, "schema.json"), "w") as f:
+            f.write(schema.to_json())
+        return schema
+
+    def import_torch_resnet(self, name: str, state_dict: Dict[str, Any],
+                            arch_name: str = "ResNet50") -> ModelSchema:
+        """Install genuinely pretrained weights from a torchvision-format
+        ``resnet*`` state_dict (numpy or torch tensors); batch-norm running
+        stats are folded for inference (the trained-model ingestion the
+        reference does by downloading CNTK models —
+        downloader/ModelDownloader.scala:37-276)."""
+        from .cnn import CNNConfig, from_torch_resnet_state_dict
+
+        spec = dict(_BUILTIN[arch_name])
+        sd = {k: np.asarray(getattr(v, "numpy", lambda: v)())
+              for k, v in state_dict.items()}
+        cfg = CNNConfig(num_classes=int(sd["fc.bias"].shape[0]),
+                        stage_sizes=spec["stage_sizes"], width=spec["width"],
+                        block=spec["block"], input_hw=spec["input_hw"])
+        params = from_torch_resnet_state_dict(sd, cfg)
+        config = dict(arch="resnet", num_classes=cfg.num_classes,
+                      stage_sizes=cfg.stage_sizes, width=cfg.width,
+                      block=cfg.block, input_hw=cfg.input_hw)
+        return self.save_model(name, params, config)
+
     def load_model(self, name: str):
         """-> (params, cfg, apply_fn) ready for DNNModel."""
-        from .cnn import CNNConfig, apply_cnn
-
         payload = os.path.join(self.repo_dir, name, "model.pkl")
         if not os.path.exists(payload):
             self.download_model(name)
         with open(payload, "rb") as f:
-            d = pickle.load(f)
-        cfg = CNNConfig(**d["config"])
-        apply_fn = lambda p, x, capture=(): apply_cnn(p, x, cfg, capture)  # noqa: E731
+            d = deserialize_payload(f.read())
+        config = dict(d["config"])
+        arch = config.pop("arch", "resnet")
+        if arch == "alexnet":
+            from .cnn import AlexNetConfig, apply_alexnet
+            config["input_hw"] = tuple(config["input_hw"])
+            cfg = AlexNetConfig(**config)
+            apply_fn = lambda p, x, capture=(): apply_alexnet(  # noqa: E731
+                p, x, cfg, capture)
+        else:
+            from .cnn import CNNConfig, apply_cnn
+            config["stage_sizes"] = tuple(config["stage_sizes"])
+            config["input_hw"] = tuple(config["input_hw"])
+            cfg = CNNConfig(**config)
+            apply_fn = lambda p, x, capture=(): apply_cnn(  # noqa: E731
+                p, x, cfg, capture)
         return d["params"], cfg, apply_fn
 
     # -- internals ----------------------------------------------------------
@@ -168,16 +317,23 @@ class ModelDownloader:
     def _materialize_builtin(self, name: str) -> bytes:
         import jax
 
-        from .cnn import CNNConfig, init_cnn_params
-
-        stage_sizes, width, num_classes, hw = _BUILTIN[name]
-        cfg = CNNConfig(num_classes=num_classes, stage_sizes=stage_sizes,
-                        width=width, input_hw=hw)
-        params = init_cnn_params(cfg, jax.random.PRNGKey(
-            int(hashlib.sha256(name.encode()).hexdigest()[:8], 16)))
+        spec = dict(_BUILTIN[name])
+        arch = spec.pop("arch")
+        key = jax.random.PRNGKey(
+            int(hashlib.sha256(name.encode()).hexdigest()[:8], 16))
+        if arch == "alexnet":
+            from .cnn import AlexNetConfig, init_alexnet_params
+            cfg = AlexNetConfig(**spec)
+            params = init_alexnet_params(cfg, key)
+        else:
+            from .cnn import CNNConfig, init_cnn_params
+            cfg = CNNConfig(**spec)
+            params = init_cnn_params(cfg, key)
         params = jax.tree_util.tree_map(np.asarray, params)
-        return pickle.dumps({
-            "params": params,
-            "config": {"num_classes": cfg.num_classes,
-                       "stage_sizes": cfg.stage_sizes, "width": cfg.width,
-                       "input_hw": cfg.input_hw}})
+        return serialize_payload(params, {"arch": arch, **spec})
+
+
+def _flatten_to_tree(params):
+    """Identity for dict pytrees; normalizes array leaves to numpy."""
+    import jax
+    return jax.tree_util.tree_map(np.asarray, params)

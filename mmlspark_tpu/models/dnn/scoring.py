@@ -57,11 +57,18 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
 
         d = ModelDownloader(repo_dir)
         params, cfg, _ = d.load_model(name)
-        spec = {"kind": "cnn",
-                "config": {"num_classes": cfg.num_classes,
-                           "stage_sizes": tuple(cfg.stage_sizes),
-                           "width": cfg.width,
-                           "input_hw": tuple(cfg.input_hw)}}
+        if type(cfg).__name__ == "AlexNetConfig":
+            spec = {"kind": "alexnet",
+                    "config": {"num_classes": cfg.num_classes,
+                               "input_hw": tuple(cfg.input_hw),
+                               "width_mult": cfg.width_mult}}
+        else:
+            spec = {"kind": "cnn",
+                    "config": {"num_classes": cfg.num_classes,
+                               "stage_sizes": tuple(cfg.stage_sizes),
+                               "width": cfg.width,
+                               "block": cfg.block,
+                               "input_hw": tuple(cfg.input_hw)}}
         return cls(params, apply_spec=spec, **kwargs)
 
     # -- model surgery (CNTKModel.setOutputNode analog) ---------------------
@@ -184,6 +191,13 @@ def _build_apply(spec: Dict[str, Any]) -> Callable:
         cfg_d["input_hw"] = tuple(cfg_d["input_hw"])
         cfg = CNNConfig(**cfg_d)
         return lambda p, x, capture=(): apply_cnn(p, x, cfg, capture)
+    if kind == "alexnet":
+        from .cnn import AlexNetConfig, apply_alexnet
+
+        cfg_d = dict(spec["config"])
+        cfg_d["input_hw"] = tuple(cfg_d["input_hw"])
+        cfg = AlexNetConfig(**cfg_d)
+        return lambda p, x, capture=(): apply_alexnet(p, x, cfg, capture)
     raise ValueError(f"unknown apply_spec kind {kind!r}")
 
 
@@ -221,7 +235,13 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
                 .resize(h, w)
                 .normalize(mean=(127.5, 127.5, 127.5),
                            std=(127.5, 127.5, 127.5)))
-        node = "pool" if self.get_or_default("cutOutputLayers") >= 1 else "logits"
+        # the featurization layer is architecture-specific: global-average
+        # pool for resnets, fc7 for alexnet (image/ImageFeaturizer.scala's
+        # per-model cut-layer map)
+        spec = getattr(self.dnn_model, "apply_spec", None) or {}
+        feat_node = "fc7" if spec.get("kind") == "alexnet" else "pool"
+        node = (feat_node if self.get_or_default("cutOutputLayers") >= 1
+                else "logits")
         if not hasattr(self, "_dnn_clone"):
             self._dnn_clone = self.dnn_model.cloned_with_shared_params()
         dnn = self._dnn_clone.set(

@@ -94,17 +94,43 @@ class Booster:
     def _obj(self) -> Objective:
         return get_objective(self.objective, self.num_class, **self.objective_kwargs)
 
+    def _forest_eval(self, t_end: int):
+        """Persistent compiled forest evaluator for the first ``t_end`` trees.
+
+        The forest rides as jit constants (device-resident after the first
+        call); callers bucket row counts so repeat scoring — the serving
+        hot path — is one cached executable dispatch, not a fresh trace +
+        forest re-upload per request (the reference keeps one loaded native
+        booster per executor the same way, LightGBMBooster.scala:186-249).
+        """
+        if self._predict_fn is None or self._predict_fn[0] != t_end:
+            trees = jax.tree_util.tree_map(
+                lambda a: jnp.asarray(np.asarray(a)[:t_end]), self.trees)
+            thr = jnp.asarray(self.thr_raw[:t_end])
+            depth_cap = self.depth_cap
+            fn = jax.jit(lambda X: predict_forest_raw(trees, thr, X,
+                                                      depth_cap))
+            self._predict_fn = (t_end, fn)
+        return self._predict_fn[1]
+
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
         """Raw margin scores: [n, num_class] (num_class=1 for binary/regression)."""
         X = np.asarray(X, dtype=np.float32)
         if num_iteration is None or num_iteration < 0:
             num_iteration = self.num_iterations
         t_end = num_iteration * self.num_class
-        trees = jax.tree_util.tree_map(lambda a: jnp.asarray(a[:t_end]), self.trees)
-        per_tree = predict_forest_raw(trees, jnp.asarray(self.thr_raw[:t_end]),
-                                      jnp.asarray(X), self.depth_cap)  # [T, n]
-        per_tree = np.asarray(per_tree)
         n = X.shape[0]
+        # power-of-two row bucket for SMALL batches only: serving's varying
+        # micro-batch sizes hit log2 cached executables instead of one trace
+        # per size. Large batch scoring keeps its exact shape — padding
+        # 600k rows to 1M would waste up to 2x forest compute.
+        if 0 < n <= 8192:
+            n_pad = 1 << (n - 1).bit_length()
+        else:
+            n_pad = max(n, 1)
+        Xp = np.pad(X, ((0, n_pad - n), (0, 0))) if n_pad != n else X
+        per_tree = np.asarray(
+            self._forest_eval(t_end)(jnp.asarray(Xp)))[:, :n]  # [T, n]
         out = np.tile(self.base_score[None, :], (n, 1)).astype(np.float32)
         for k in range(self.num_class):
             out[:, k] += per_tree[k::self.num_class].sum(axis=0)

@@ -1,0 +1,134 @@
+"""Reference accuracy baselines on real datasets.
+
+The reference's quantitative ground truth is its checked-in benchmark CSVs
+(src/test/resources/benchmarks/benchmarks_VerifyLightGBMClassifier.csv etc.,
+compared with per-metric tolerance by core/test/benchmarks/Benchmarks.scala:
+16-60). This suite runs the same protocol on the real datasets available in
+this zero-egress image:
+
+* breast-cancer — the reference's ``breast-cancer.train.csv`` is the UCI
+  Wisconsin breast-cancer data; sklearn bundles the same Wisconsin
+  (diagnostic) dataset offline. Our AUC is asserted against the REFERENCE's
+  recorded values within the REFERENCE's own tolerance for every boosting
+  type it records (gbdt/rf/dart/goss).
+* wine / diabetes — stand-ins for the reference's multiclass
+  (BreastTissue/CarEvaluation) and regression (airfoil/energyefficiency)
+  legs; the exact UCI files are not redistributable here, so these rows pin
+  OUR values in the checked-in baseline with the reference's tolerance
+  discipline rather than asserting against the reference's dataset-specific
+  numbers.
+
+Reference values quoted from benchmarks_VerifyLightGBMClassifier.csv:
+  breast-cancer gbdt 0.9924667959194766 (tol 0.1)
+  breast-cancer rf   0.9868180253311348 (tol 0.1)
+  breast-cancer dart 0.9915381688379931 (tol 0.1)
+  breast-cancer goss 0.9924667959194766 (tol 0.1)
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.benchmarks import Benchmarks
+from mmlspark_tpu.core.dataset import Dataset
+
+BASELINE_DIR = os.path.join(os.path.dirname(__file__), "resources",
+                            "benchmarks")
+
+REFERENCE_BREAST_CANCER_AUC = {
+    # benchmarks_VerifyLightGBMClassifier.csv rows for breast-cancer.train
+    "gbdt": (0.9924667959194766, 0.1),
+    "rf": (0.9868180253311348, 0.1),
+    "dart": (0.9915381688379931, 0.1),
+    "goss": (0.9924667959194766, 0.1),
+}
+
+
+def _auc(y, p):
+    p = np.asarray(p)
+    if p.ndim == 2:
+        p = p[:, 1]
+    order = np.argsort(p)
+    ranks = np.empty(len(p))
+    ranks[order] = np.arange(1, len(p) + 1)
+    pos = y == 1
+    n1, n0 = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0)
+
+
+def _split(X, y, seed=42, frac=0.8):
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(len(y))
+    cut = int(len(y) * frac)
+    tr, te = idx[:cut], idx[cut:]
+    return X[tr], y[tr], X[te], y[te]
+
+
+@pytest.fixture(scope="module")
+def breast_cancer():
+    from sklearn.datasets import load_breast_cancer
+    d = load_breast_cancer()
+    return d.data.astype(np.float32), d.target.astype(np.float32)
+
+
+def _fit_auc(X, y, boosting, seed=0):
+    from mmlspark_tpu.models.gbdt.api import LightGBMClassifier
+    Xtr, ytr, Xte, yte = _split(X, y)
+    ds = Dataset({"features": Xtr, "label": ytr})
+    kw = {}
+    if boosting == "rf":
+        kw = dict(baggingFraction=0.8, baggingFreq=1)
+    model = LightGBMClassifier(numIterations=50, numLeaves=31,
+                               minDataInLeaf=20, learningRate=0.1,
+                               boostingType=boosting, baggingSeed=seed,
+                               **kw).fit(ds)
+    out = model.transform(Dataset({"features": Xte, "label": yte}))
+    return float(_auc(yte, out.array("probability")))
+
+
+@pytest.mark.parametrize("boosting", ["gbdt", "rf", "dart", "goss"])
+def test_breast_cancer_auc_vs_reference(breast_cancer, boosting):
+    """AUC within the reference's own tolerance of its recorded value."""
+    X, y = breast_cancer
+    auc = _fit_auc(X, y, boosting)
+    ref, tol = REFERENCE_BREAST_CANCER_AUC[boosting]
+    assert abs(auc - ref) <= tol, (
+        f"{boosting}: AUC {auc:.5f} vs reference {ref:.5f} (tol {tol})")
+
+
+def test_real_dataset_regression_baselines(breast_cancer):
+    """Pin our values on the real datasets in the promotion harness (the
+    reference's Benchmarks compare-and-promote flow) with tight tolerances,
+    so accuracy drift on real data fails CI."""
+    from sklearn.datasets import load_diabetes, load_wine
+
+    from mmlspark_tpu.models.gbdt.api import (LightGBMClassifier,
+                                              LightGBMRegressor)
+
+    bm = Benchmarks("ReferenceDatasets")
+
+    X, y = breast_cancer
+    bm.record("breast_cancer_auc_gbdt", _fit_auc(X, y, "gbdt"), 0.01)
+
+    w = load_wine()
+    Xtr, ytr, Xte, yte = _split(w.data.astype(np.float32),
+                                w.target.astype(np.float32))
+    m = LightGBMClassifier(numIterations=40, numLeaves=15, minDataInLeaf=5,
+                           objective="multiclass").fit(
+        Dataset({"features": Xtr, "label": ytr}))
+    acc = float((m.transform(Dataset({"features": Xte, "label": yte}))
+                 .array("prediction") == yte).mean())
+    bm.record("wine_multiclass_accuracy", acc, 0.03)
+
+    d = load_diabetes()
+    Xtr, ytr, Xte, yte = _split(d.data.astype(np.float32),
+                                d.target.astype(np.float32))
+    r = LightGBMRegressor(numIterations=60, numLeaves=15,
+                          minDataInLeaf=10).fit(
+        Dataset({"features": Xtr, "label": ytr}))
+    pred = r.transform(Dataset({"features": Xte, "label": yte}))
+    rmse = float(np.sqrt(np.mean((pred.array("prediction") - yte) ** 2)))
+    bm.record("diabetes_rmse", rmse, 3.0)
+
+    bm.verify(BASELINE_DIR)

@@ -1,0 +1,96 @@
+"""Serving latency proof vs the reference's ~1 ms continuous-serving claim.
+
+Reference: docs/mmlspark-serving.md:10-11 ("millisecond latency" for Spark
+Serving continuous mode, HTTPSourceV2.scala:45-700). This measures true
+end-to-end HTTP p50/p99 over loopback against a persistent compiled program:
+
+* idle load (sequential requests): with eager batching a lone request must
+  NOT pay the micro-batch deadline — p50 is the transform cost, single-digit
+  ms on a 1-core CI box.
+* concurrent load: batches must actually form (batches_served <<
+  requests_served), or the MXU would see batch-1 shapes under load.
+
+CI bounds are deliberately loose multiples of the target (shared boxes jitter);
+bench.py records the tight numbers on the bench host.
+"""
+
+import http.client
+import threading
+import time
+
+import numpy as np
+
+from mmlspark_tpu.io.serving import serve
+
+
+def _measure(host, port, path, n, payload=b'{"x": 1.0}'):
+    lat = []
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    for _ in range(n):
+        t0 = time.perf_counter()
+        conn.request("POST", path, body=payload,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        lat.append(time.perf_counter() - t0)
+        assert resp.status == 200
+    conn.close()
+    return np.asarray(lat) * 1e3  # ms
+
+
+def serving_latency_stats(n_seq=200, n_conc=8, conc_each=50):
+    """Start a trivial-model serving query, return latency stats (ms)."""
+
+    def transform(ds):
+        vals = ds["value"]
+        return ds.with_column(
+            "reply", [{"entity": {"y": (v or {}).get("x", 0.0)},
+                       "statusCode": 200} for v in vals])
+
+    q = (serve().address("localhost", 0, "bench")
+         .batch(max_batch=64, max_latency_ms=5)
+         .transform(transform).start())
+    host, port = q.server.host, q.server.port
+    path = "/bench"
+    try:
+        _measure(host, port, path, 20)              # warm
+        seq = _measure(host, port, path, n_seq)
+
+        results = []
+        def worker():
+            results.append(_measure(host, port, path, conc_each))
+        threads = [threading.Thread(target=worker) for _ in range(n_conc)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        conc = np.concatenate(results)
+        stats = {
+            "p50_ms": float(np.percentile(seq, 50)),
+            "p99_ms": float(np.percentile(seq, 99)),
+            "concurrent_p50_ms": float(np.percentile(conc, 50)),
+            "concurrent_p99_ms": float(np.percentile(conc, 99)),
+            "concurrent_rps": float(n_conc * conc_each / wall),
+            "batches_served": q.batches_served,
+            "requests_served": q.requests_served,
+        }
+        return stats
+    finally:
+        q.stop()
+
+
+def test_sequential_latency_does_not_pay_batch_deadline():
+    stats = serving_latency_stats(n_seq=150, n_conc=4, conc_each=25)
+    # reference regime is ~1 ms; allow a loose CI multiple but a lone request
+    # must clearly undercut request-rate * deadline behavior (5 ms deadline
+    # + transform would push p50 over ~6 ms)
+    assert stats["p50_ms"] < 5.0, stats
+    assert stats["p99_ms"] < 50.0, stats
+    # under concurrency, batching must actually batch
+    assert stats["batches_served"] < stats["requests_served"], stats
+
+
+if __name__ == "__main__":
+    print(serving_latency_stats())
