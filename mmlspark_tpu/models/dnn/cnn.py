@@ -44,7 +44,11 @@ class CNNConfig:
     block: str = "basic"
 
 
-_EXPANSION = {"basic": 1, "bottleneck": 4}
+# single source of truth for per-block structure, shared with the
+# downloader catalog (numLayers) and the weight importer
+BLOCK_SPECS = {"basic": {"convs": 2, "expansion": 1},
+               "bottleneck": {"convs": 3, "expansion": 4}}
+_EXPANSION = {k: v["expansion"] for k, v in BLOCK_SPECS.items()}
 
 
 def _conv_init(key, kh, kw, cin, cout):
@@ -58,8 +62,8 @@ def _bn_unit(cout):
 
 
 def init_cnn_params(cfg: CNNConfig, key) -> Dict[str, Any]:
-    expansion = _EXPANSION[cfg.block]
-    n_convs = {"basic": 2, "bottleneck": 3}[cfg.block]
+    expansion = BLOCK_SPECS[cfg.block]["expansion"]
+    n_convs = BLOCK_SPECS[cfg.block]["convs"]
     keys = iter(jax.random.split(
         key, 4 + (n_convs + 1) * sum(cfg.stage_sizes) + 2))
     params: Dict[str, Any] = {
@@ -292,7 +296,7 @@ def from_torch_resnet_state_dict(sd: Dict[str, np.ndarray],
 
     params: Dict[str, Any] = {
         "stem": {"w": conv("conv1"), **bn("bn1")}}
-    n_convs = {"basic": 2, "bottleneck": 3}[cfg.block]
+    n_convs = BLOCK_SPECS[cfg.block]["convs"]
     for s, n_blocks in enumerate(cfg.stage_sizes):
         for b in range(n_blocks):
             t = f"layer{s + 1}.{b}"

@@ -111,7 +111,8 @@ def _layer_names(spec: Dict[str, Any]) -> List[str]:
 def _num_layers(spec: Dict[str, Any]) -> int:
     if spec["arch"] == "alexnet":
         return 8
-    per_block = {"basic": 2, "bottleneck": 3}[spec["block"]]
+    from .cnn import BLOCK_SPECS
+    per_block = BLOCK_SPECS[spec["block"]]["convs"]
     return per_block * sum(spec["stage_sizes"]) + 2
 
 

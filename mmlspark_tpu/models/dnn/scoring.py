@@ -213,12 +213,28 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
                             TypeConverters.to_int)
     miniBatchSize = Param("miniBatchSize", "rows per device batch", 32,
                           TypeConverters.to_int)
+    featureNode = Param("featureNode", "capture node for featurization; "
+                        "None = infer from the model's apply_spec "
+                        "(pool for resnets, fc7 for alexnet)", None)
+
+    # IMAGENET_STATS: pass as mean/std when featurizing with weights trained
+    # on torchvision-preprocessed ImageNet (0..255 pixel scale)
+    IMAGENET_MEAN = (123.675, 116.28, 103.53)
+    IMAGENET_STD = (58.395, 57.12, 57.375)
 
     def __init__(self, dnn_model: DNNModel = None, input_hw=(224, 224),
+                 mean=(127.5, 127.5, 127.5), std=(127.5, 127.5, 127.5),
                  **kwargs):
+        """``mean``/``std``: input normalization in 0..255 pixel units.
+        The default maps pixels to [-1, 1] (fine for the deterministic-init
+        catalog); for genuinely pretrained torchvision imports use
+        ``mean=ImageFeaturizer.IMAGENET_MEAN, std=ImageFeaturizer.
+        IMAGENET_STD`` to match the checkpoint's training preprocessing."""
         super().__init__(**kwargs)
         self.dnn_model = dnn_model
         self.input_hw = tuple(input_hw)
+        self.norm_mean = tuple(mean)
+        self.norm_std = tuple(std)
 
     def set_model(self, m: DNNModel) -> "ImageFeaturizer":
         self.dnn_model = m
@@ -233,13 +249,15 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         prep = (ImageTransformer()
                 .set(inputCol=in_col, outputCol="_img_prepped")
                 .resize(h, w)
-                .normalize(mean=(127.5, 127.5, 127.5),
-                           std=(127.5, 127.5, 127.5)))
+                .normalize(mean=self.norm_mean, std=self.norm_std))
         # the featurization layer is architecture-specific: global-average
         # pool for resnets, fc7 for alexnet (image/ImageFeaturizer.scala's
-        # per-model cut-layer map)
-        spec = getattr(self.dnn_model, "apply_spec", None) or {}
-        feat_node = "fc7" if spec.get("kind") == "alexnet" else "pool"
+        # per-model cut-layer map); featureNode overrides for models
+        # constructed without an apply_spec
+        feat_node = self.get_or_default("featureNode")
+        if feat_node is None:
+            spec = getattr(self.dnn_model, "apply_spec", None) or {}
+            feat_node = "fc7" if spec.get("kind") == "alexnet" else "pool"
         node = (feat_node if self.get_or_default("cutOutputLayers") >= 1
                 else "logits")
         if not hasattr(self, "_dnn_clone"):
@@ -253,10 +271,17 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         from ...core.pipeline import save_stage
         save_stage(self.dnn_model, os.path.join(path, "dnn"))
         with open(os.path.join(path, "hw.pkl"), "wb") as f:
-            pickle.dump(self.input_hw, f)
+            pickle.dump({"input_hw": self.input_hw, "mean": self.norm_mean,
+                         "std": self.norm_std}, f)
 
     def _load_extra(self, path: str) -> None:
         from ...core.pipeline import load_stage
         self.dnn_model = load_stage(os.path.join(path, "dnn"))
         with open(os.path.join(path, "hw.pkl"), "rb") as f:
-            self.input_hw = pickle.load(f)
+            d = pickle.load(f)
+        if isinstance(d, dict):
+            self.input_hw = tuple(d["input_hw"])
+            self.norm_mean, self.norm_std = tuple(d["mean"]), tuple(d["std"])
+        else:                       # pre-mean/std save format
+            self.input_hw = tuple(d)
+            self.norm_mean = self.norm_std = (127.5, 127.5, 127.5)

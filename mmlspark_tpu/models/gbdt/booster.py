@@ -103,15 +103,20 @@ class Booster:
         forest re-upload per request (the reference keeps one loaded native
         booster per executor the same way, LightGBMBooster.scala:186-249).
         """
-        if self._predict_fn is None or self._predict_fn[0] != t_end:
+        if self._predict_fn is None:
+            self._predict_fn = {}
+        fn = self._predict_fn.get(t_end)
+        if fn is None:
             trees = jax.tree_util.tree_map(
                 lambda a: jnp.asarray(np.asarray(a)[:t_end]), self.trees)
             thr = jnp.asarray(self.thr_raw[:t_end])
             depth_cap = self.depth_cap
             fn = jax.jit(lambda X: predict_forest_raw(trees, thr, X,
                                                       depth_cap))
-            self._predict_fn = (t_end, fn)
-        return self._predict_fn[1]
+            # keyed by t_end: services alternate full-model and
+            # best_iteration scoring; both must stay cached executables
+            self._predict_fn[t_end] = fn
+        return fn
 
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
         """Raw margin scores: [n, num_class] (num_class=1 for binary/regression)."""

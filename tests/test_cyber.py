@@ -168,3 +168,25 @@ def test_access_anomaly_neg_score_zero_still_trains():
     model.preserve_history = False
     raw = model.transform(_access_df()).array("anomaly_score")
     assert np.all(np.isfinite(raw))
+
+
+def test_als_scales_without_densifying():
+    """50k users x 50k items with 5k observations: the old dense
+    formulation would materialize a 10 GB [U, I] matrix; the sparse
+    blocked path is O((U + I) * rank^2 + nnz)."""
+    from mmlspark_tpu.cyber.anomaly import als_fit
+
+    rng = np.random.default_rng(0)
+    nnz, U, I = 5_000, 50_000, 50_000
+    u = rng.integers(0, U, nnz)
+    i = rng.integers(0, I, nnz)
+    r = rng.uniform(5, 10, nnz)
+    x, y = als_fit(u, i, r, U, I, rank=8, max_iter=3, reg=1.0,
+                   implicit=True, alpha=1.0)
+    assert x.shape == (U, 8) and y.shape == (I, 8)
+    assert np.isfinite(x).all() and np.isfinite(y).all()
+    # observed pairs should score above random pairs on average
+    obs = np.einsum("nk,nk->n", x[u[:500]], y[i[:500]]).mean()
+    rand = np.einsum("nk,nk->n", x[rng.integers(0, U, 500)],
+                     y[rng.integers(0, I, 500)]).mean()
+    assert obs > rand
