@@ -39,10 +39,21 @@ def _tpu_reachable(timeout_s: int = 90) -> bool:
 
 
 def main() -> None:
-    on_tpu = os.environ.get("GRAFT_BENCH_FORCE_CPU") != "1" and _tpu_reachable()
-    if not on_tpu:
-        os.environ["PALLAS_AXON_POOL_IPS"] = ""
-        os.environ["JAX_PLATFORMS"] = "cpu"
+    on_tpu = (os.environ.get("GRAFT_BENCH_FORCE_CPU") != "1"
+              and os.environ.get("GRAFT_BENCH_CPU_REEXEC") != "1"
+              and _tpu_reachable())
+    if not on_tpu and os.environ.get("GRAFT_BENCH_CPU_REEXEC") != "1":
+        # The TPU PJRT plugin registers at interpreter start (sitecustomize,
+        # keyed on PALLAS_AXON_POOL_IPS); once registered, backend discovery
+        # touches the relay even under JAX_PLATFORMS=cpu and hangs when the
+        # relay is down. Clearing env vars in-process is too late — re-exec
+        # with a cleaned environment before importing jax.
+        env = dict(os.environ)
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["JAX_PLATFORMS"] = "cpu"
+        env["GRAFT_BENCH_CPU_REEXEC"] = "1"
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)], env)
 
     import jax
 

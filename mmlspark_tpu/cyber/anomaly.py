@@ -105,7 +105,12 @@ def als_fit(user_idx: np.ndarray, item_idx: np.ndarray, rating: np.ndarray,
     y0 = jax.random.uniform(ki, (n_items, rank), dtype=jnp.float32) * 0.1
 
     mesh = meshlib.get_default_mesh()
-    nshards = meshlib.num_shards(mesh) if mesh is not None else 1
+    # shard over the mesh's DATA axis specifically (a multi-axis mesh, e.g.
+    # {'model': 4, 'data': 2}, must not shard observations over 'model')
+    data_axis = (meshlib.DATA_AXIS
+                 if mesh is not None and meshlib.DATA_AXIS in mesh.shape
+                 else None)
+    nshards = mesh.shape[data_axis] if data_axis else 1
     n_pad = -(-max(nnz, 1) // nshards) * nshards
     pad = n_pad - nnz
 
@@ -116,16 +121,38 @@ def als_fit(user_idx: np.ndarray, item_idx: np.ndarray, rating: np.ndarray,
 
     eye = jnp.eye(rank, dtype=jnp.float32) * reg
 
+    # COO chunking: the per-observation outer-product intermediate is
+    # [chunk, k, k], not [nnz_local, k, k] — peak memory stays at the
+    # documented O((U + I) * rank^2 + nnz) even for 100M-observation shards
+    obs_chunk = 65536
+
     def solve_side(other, idx_self, idx_other, cm1, tgt, n_self, base_gram,
                    axis_name):
         """Normal equations for one side from local COO shards + psum."""
-        yo = other[idx_other]                                 # [Nl, k]
-        a_part = (cm1[:, None, None] * yo[:, :, None] * yo[:, None, :])
-        a = jnp.zeros((n_self, rank, rank), jnp.float32).at[idx_self].add(
-            a_part, mode="drop")
-        bw = cm1 * tgt + (tgt if base_gram else 0.0)
-        b = jnp.zeros((n_self, rank), jnp.float32).at[idx_self].add(
-            bw[:, None] * yo, mode="drop")
+        nl = idx_self.shape[0]
+        nc = -(-nl // obs_chunk)
+        cpad = nc * obs_chunk - nl
+        # pad with weight-0 observations pointing at index 0
+        isf = jnp.pad(idx_self, (0, cpad)).reshape(nc, obs_chunk)
+        iot = jnp.pad(idx_other, (0, cpad)).reshape(nc, obs_chunk)
+        cm1c = jnp.pad(cm1, (0, cpad)).reshape(nc, obs_chunk)
+        tgtc = jnp.pad(tgt, (0, cpad)).reshape(nc, obs_chunk)
+
+        def chunk_body(carry, xs):
+            a, b = carry
+            ics, ico, c1, tg = xs
+            yo = other[ico]                               # [C, k]
+            a_part = c1[:, None, None] * yo[:, :, None] * yo[:, None, :]
+            a = a.at[ics].add(a_part, mode="drop")
+            bw = c1 * tg + (tg if base_gram else 0.0)
+            b = b.at[ics].add(bw[:, None] * yo, mode="drop")
+            return (a, b), None
+
+        (a, b), _ = lax.scan(
+            chunk_body,
+            (jnp.zeros((n_self, rank, rank), jnp.float32),
+             jnp.zeros((n_self, rank), jnp.float32)),
+            (isf, iot, cm1c, tgtc))
         if axis_name is not None:
             a = lax.psum(a, axis_name)
             b = lax.psum(b, axis_name)
@@ -159,7 +186,7 @@ def als_fit(user_idx: np.ndarray, item_idx: np.ndarray, rating: np.ndarray,
         return x, y
 
     if mesh is not None and nshards > 1:
-        axis = list(mesh.shape.keys())[0]
+        axis = data_axis
         fitted = jax.jit(jax.shard_map(
             lambda x, y, ul, il, rl, wl: run(x, y, ul, il, rl, wl, axis),
             mesh=mesh,

@@ -104,7 +104,7 @@ class Booster:
         booster per executor the same way, LightGBMBooster.scala:186-249).
         """
         if self._predict_fn is None:
-            self._predict_fn = {}
+            self._predict_fn = OrderedDict()
         fn = self._predict_fn.get(t_end)
         if fn is None:
             trees = jax.tree_util.tree_map(
@@ -114,8 +114,14 @@ class Booster:
             fn = jax.jit(lambda X: predict_forest_raw(trees, thr, X,
                                                       depth_cap))
             # keyed by t_end: services alternate full-model and
-            # best_iteration scoring; both must stay cached executables
+            # best_iteration scoring; both must stay cached executables.
+            # Bounded LRU: each entry pins a device tree-slice, so a
+            # learning-curve sweep over every t_end must not pin O(T^2)
             self._predict_fn[t_end] = fn
+            while len(self._predict_fn) > 4:
+                self._predict_fn.popitem(last=False)
+        else:
+            self._predict_fn.move_to_end(t_end)
         return fn
 
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
