@@ -22,12 +22,24 @@ import numpy as np
 ColumnData = Union[np.ndarray, list]
 
 
+def _is_sparse(v) -> bool:
+    """scipy.sparse column (CSR feature matrices ride the Dataset natively —
+    LGBM_DatasetCreateFromCSR parity, reference LightGBMUtils.scala:227)."""
+    try:
+        import scipy.sparse as sp
+    except ImportError:
+        return False
+    return sp.issparse(v)
+
+
 def _length(col: ColumnData) -> int:
+    if _is_sparse(col):
+        return col.shape[0]
     return len(col)
 
 
 def _take(col: ColumnData, idx: np.ndarray) -> ColumnData:
-    if isinstance(col, np.ndarray):
+    if isinstance(col, np.ndarray) or _is_sparse(col):
         return col[idx]
     return [col[i] for i in idx]
 
@@ -41,7 +53,7 @@ class Dataset:
         for k, v in columns.items():
             if isinstance(v, (np.ndarray, np.generic)):
                 v = np.asarray(v)
-            elif not isinstance(v, list):
+            elif not isinstance(v, list) and not _is_sparse(v):
                 v = list(v)
             if n is None:
                 n = _length(v)

@@ -123,8 +123,10 @@ def als_fit(user_idx: np.ndarray, item_idx: np.ndarray, rating: np.ndarray,
 
     # COO chunking: the per-observation outer-product intermediate is
     # [chunk, k, k], not [nnz_local, k, k] — peak memory stays at the
-    # documented O((U + I) * rank^2 + nnz) even for 100M-observation shards
-    obs_chunk = 65536
+    # documented O((U + I) * rank^2 + nnz) even for 100M-observation shards.
+    # Small fits use one right-sized chunk (lane-aligned), not 65536 padding.
+    nnz_local = n_pad // nshards
+    obs_chunk = min(65536, -(-max(nnz_local, 1) // 128) * 128)
 
     def solve_side(other, idx_self, idx_other, cm1, tgt, n_self, base_gram,
                    axis_name):

@@ -17,7 +17,7 @@ from typing import Optional
 
 import numpy as np
 
-from ...core.dataset import Dataset
+from ...core.dataset import Dataset, _is_sparse
 from ...core.params import (HasFeaturesCol, HasGroupCol, HasInitScoreCol,
                             HasLabelCol, HasPredictionCol, HasProbabilityCol,
                             HasRawPredictionCol, HasValidationIndicatorCol,
@@ -118,6 +118,15 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
         "featuresShapCol", "If set, output per-feature SHAP-style contributions "
         "here (reference: LightGBMBooster.scala:250-269)", None,
         TypeConverters.to_string)
+    categoricalSlotIndexes = Param(
+        "categoricalSlotIndexes", "Feature-vector slots to treat as "
+        "categorical (values are category ids; splits are LightGBM "
+        "sorted-subset bitsets — reference: LightGBMParams "
+        "categoricalSlotIndexes, core/schema/Categoricals.scala)", None)
+    categoricalSlotNames = Param(
+        "categoricalSlotNames", "Categorical slots by feature name; requires "
+        "a featuresCol with slot names (use categoricalSlotIndexes for "
+        "plain arrays)", None)
 
     def _grow_config(self) -> GrowConfig:
         return GrowConfig(
@@ -136,11 +145,24 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
         )
 
     def _extract_arrays(self, dataset: Dataset):
-        X = dataset.array(self.get_or_default("featuresCol"), np.float32)
+        fcol = self.get_or_default("featuresCol")
+        raw = dataset[fcol]
+        # sparse CSR features pass through untouched (train_booster densifies
+        # per row block — LGBM_DatasetCreateFromCSR parity)
+        X = raw if _is_sparse(raw) else dataset.array(fcol, np.float32)
         y = dataset.array(self.get_or_default("labelCol"), np.float32)
         wcol = self.get_or_default("weightCol")
         w = dataset.array(wcol, np.float32) if wcol else None
         return X, y, w
+
+    def _categorical_indexes(self):
+        if self.get_or_default("categoricalSlotNames"):
+            raise ValueError(
+                "categoricalSlotNames requires named feature slots; this "
+                "columnar Dataset API carries plain arrays — use "
+                "categoricalSlotIndexes")
+        idx = self.get_or_default("categoricalSlotIndexes")
+        return tuple(int(i) for i in idx) if idx else ()
 
     def _split_validation(self, dataset: Dataset):
         """validationIndicatorCol semantics (reference: LightGBMBase.scala:214-219)."""
@@ -186,6 +208,7 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
             max_drop=self.get_or_default("maxDrop"),
             skip_drop=self.get_or_default("skipDrop"),
             drop_seed=self.get_or_default("dropSeed"),
+            categorical_features=self._categorical_indexes(),
         )
         num_iterations = self.get_or_default("numIterations")
         if (num_batches and num_batches > 1
@@ -306,7 +329,7 @@ class LightGBMClassificationModel(_LightGBMModelBase, HasRawPredictionCol,
                        TypeConverters.to_list_float)
 
     def transform(self, dataset: Dataset) -> Dataset:
-        X = dataset.array(self.get_or_default("featuresCol"), np.float32)
+        X = _features_dense(dataset, self.get_or_default("featuresCol"))
         raw = self.booster.predict_raw(X)  # [n, K]
         K = self.get_or_default("numClasses")
         if self.booster.num_class == 1:  # binary: margin for [neg, pos]
@@ -361,7 +384,7 @@ class LightGBMRegressor(Estimator, _LightGBMParams):
 
 class LightGBMRegressionModel(_LightGBMModelBase):
     def transform(self, dataset: Dataset) -> Dataset:
-        X = dataset.array(self.get_or_default("featuresCol"), np.float32)
+        X = _features_dense(dataset, self.get_or_default("featuresCol"))
         pred = self.booster.predict(X).astype(np.float64)
         out = dataset.with_column(self.get_or_default("predictionCol"), pred)
         return self._add_introspection_cols(out, X)
@@ -370,6 +393,16 @@ class LightGBMRegressionModel(_LightGBMModelBase):
     def load_native_model(path: str) -> "LightGBMRegressionModel":
         with open(path) as f:
             return LightGBMRegressionModel(Booster.from_string(f.read()))
+
+
+def _features_dense(dataset: Dataset, col: str) -> np.ndarray:
+    """Features column as dense float32 (scoring path accepts the same
+    sparse CSR input fit does)."""
+    from .booster import _densify
+    raw = dataset[col]
+    if _is_sparse(raw):
+        return _densify(raw)
+    return dataset.array(col, np.float32)
 
 
 def _pad_groups(X: np.ndarray, y: np.ndarray, w: Optional[np.ndarray],
@@ -487,7 +520,7 @@ class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
 
 class LightGBMRankerModel(_LightGBMModelBase):
     def transform(self, dataset: Dataset) -> Dataset:
-        X = dataset.array(self.get_or_default("featuresCol"), np.float32)
+        X = _features_dense(dataset, self.get_or_default("featuresCol"))
         score = self.booster.predict_raw(X)[:, 0].astype(np.float64)
         out = dataset.with_column(self.get_or_default("predictionCol"), score)
         return self._add_introspection_cols(out, X)

@@ -59,7 +59,31 @@ def _with_tree_defaults(fields: Dict) -> Dict:
     (SHAP contributions then attribute only at leaves)."""
     if "node_value" not in fields:
         fields["node_value"] = np.asarray(fields["leaf_value"])
+    if "cat_bitset" not in fields:
+        shape = np.asarray(fields["feat"]).shape   # [T, M] or [M]
+        fields["cat_bitset"] = np.zeros((*shape, 1), np.uint32)
+    else:
+        fields["cat_bitset"] = np.asarray(
+            fields["cat_bitset"]).astype(np.uint32)
     return fields
+
+
+def _densify(X):
+    """Accept scipy.sparse CSR/CSC input (LGBM_DatasetCreateFromCSR parity,
+    reference: lightgbm/LightGBMUtils.scala:227): densify in row blocks so
+    peak host memory is the output array plus one block, then feed the
+    standard dense path (pad/densify-per-shard is the TPU-native layout —
+    histograms need dense bin matrices on the MXU anyway)."""
+    from ...core.dataset import _is_sparse
+    if not _is_sparse(X):
+        return X
+    X = X.tocsr()
+    n, F = X.shape
+    out = np.zeros((n, F), dtype=np.float32)
+    step = max(1, (8 << 20) // max(F * 4, 1))
+    for start in range(0, n, step):
+        out[start:start + step] = X[start:start + step].toarray()
+    return out
 
 
 class Booster:
@@ -91,8 +115,33 @@ class Booster:
     def num_iterations(self) -> int:
         return self.num_trees // self.num_class
 
+    def __getstate__(self):
+        # compiled-predictor cache holds jitted closures: rebuilt on demand,
+        # never pickled (stage persistence pickles fitted models whole)
+        d = dict(self.__dict__)
+        d["_predict_fn"] = None
+        return d
+
     def _obj(self) -> Objective:
         return get_objective(self.objective, self.num_class, **self.objective_kwargs)
+
+    def _cat_max_idx(self) -> int:
+        """Largest valid category bin id (the binner's catch-all bin)."""
+        mb = self.binner_state.get("max_bin") or 0
+        if mb > 0:
+            return mb - 1
+        return int(np.asarray(self.trees.cat_bitset).shape[-1]) * 32 - 1
+
+    def _is_cat(self):
+        """[F] bool device mask of categorical features, or None."""
+        cats = self.binner_state.get("categorical_features") or ()
+        F = self.binner_state["upper_bounds"].shape[0]
+        cats = [int(i) for i in cats if 0 <= int(i) < F]
+        if not cats:
+            return None
+        m = np.zeros(F, dtype=bool)
+        m[np.asarray(cats, dtype=int)] = True
+        return jnp.asarray(m)
 
     def _forest_eval(self, t_end: int):
         """Persistent compiled forest evaluator for the first ``t_end`` trees.
@@ -111,8 +160,11 @@ class Booster:
                 lambda a: jnp.asarray(np.asarray(a)[:t_end]), self.trees)
             thr = jnp.asarray(self.thr_raw[:t_end])
             depth_cap = self.depth_cap
-            fn = jax.jit(lambda X: predict_forest_raw(trees, thr, X,
-                                                      depth_cap))
+            is_cat = self._is_cat()
+            cat_max_bin = self.binner_state.get("max_bin") or 0
+            fn = jax.jit(lambda X: predict_forest_raw(
+                trees, thr, X, depth_cap, is_cat=is_cat,
+                cat_max_bin=cat_max_bin))
             # keyed by t_end: services alternate full-model and
             # best_iteration scoring; both must stay cached executables.
             # Bounded LRU: each entry pins a device tree-slice, so a
@@ -168,6 +220,8 @@ class Booster:
         Xd = jnp.asarray(X)
         trees = jax.tree_util.tree_map(jnp.asarray, self.trees)
         thr = jnp.asarray(self.thr_raw)
+        is_cat = self._is_cat()
+        cat_max_idx = self._cat_max_idx()
         n, F = X.shape
         K = self.num_class
         T = self.num_trees
@@ -184,7 +238,14 @@ class Booster:
                 node, contrib = st
                 f = ts.feat[node]
                 x = jnp.take_along_axis(Xd, f[:, None], axis=1)[:, 0]
-                nxt = jnp.where(x > thr_t[node], ts.right[node], ts.left[node])
+                go_left = ~(x > thr_t[node])
+                if is_cat is not None:
+                    from .growth import bit_test, raw_to_cat_bin
+                    cbin = raw_to_cat_bin(x, cat_max_idx)
+                    go_left = jnp.where(
+                        is_cat[f], bit_test(ts.cat_bitset[node], cbin),
+                        go_left)
+                nxt = jnp.where(go_left, ts.left[node], ts.right[node])
                 internal = ~ts.is_leaf[node]
                 delta = ts.node_value[nxt] - ts.node_value[node]
                 contrib = contrib.at[jnp.arange(n), f].add(
@@ -213,13 +274,23 @@ class Booster:
         trees = jax.tree_util.tree_map(jnp.asarray, self.trees)
         n = X.shape[0]
 
+        is_cat = self._is_cat()
+        cat_max_idx = self._cat_max_idx()
+
         def one_tree(ts, thr):
             node = jnp.zeros(n, dtype=jnp.int32)
 
             def body(_, node):
                 f = ts.feat[node]
                 x = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
-                nxt = jnp.where(x > thr[node], ts.right[node], ts.left[node])
+                go_left = ~(x > thr[node])
+                if is_cat is not None:
+                    from .growth import bit_test, raw_to_cat_bin
+                    cbin = raw_to_cat_bin(x, cat_max_idx)
+                    go_left = jnp.where(
+                        is_cat[f], bit_test(ts.cat_bitset[node], cbin),
+                        go_left)
+                nxt = jnp.where(go_left, ts.left[node], ts.right[node])
                 return jnp.where(ts.is_leaf[node], node, nxt)
 
             return jax.lax.fori_loop(0, self.depth_cap, body, node)
@@ -254,7 +325,10 @@ class Booster:
             binner=dict(max_bin=self.binner_state["max_bin"],
                         sample_count=self.binner_state["sample_count"],
                         seed=self.binner_state["seed"],
-                        num_features=self.binner_state["num_features"]),
+                        num_features=self.binner_state["num_features"],
+                        categorical_features=list(
+                            self.binner_state.get("categorical_features")
+                            or [])),
         )
         arrays["meta_json"] = np.frombuffer(
             json.dumps(meta).encode(), dtype=np.uint8)
@@ -289,12 +363,14 @@ class Booster:
         ``to_lightgbm_string``). base_score is 0: LightGBM folds any init
         score into the first iteration's leaves."""
         from .lgbm_format import parse_lightgbm_string
-        trees, thr_raw, K, objective, kwargs, F = parse_lightgbm_string(s)
+        (trees, thr_raw, K, objective, kwargs, F,
+         cat_features) = parse_lightgbm_string(s)
         M = trees.feat.shape[1]
         depth_cap = max(1, (M + 1) // 2 - 1)
         binner_state = dict(upper_bounds=np.zeros((F, 1), np.float32),
                             max_bin=0, sample_count=0, seed=0,
-                            num_features=F)
+                            num_features=F,
+                            categorical_features=list(cat_features))
         return Booster(trees, thr_raw, K, np.zeros(K, np.float32), objective,
                        depth_cap, binner_state, objective_kwargs=kwargs)
 
@@ -372,6 +448,7 @@ def train_booster(
     drop_seed: int = 4,
     checkpoint_dir: Optional[str] = None,
     checkpoint_period: int = 10,
+    categorical_features=(),
 ) -> Booster:
     """Train a boosted ensemble, rows sharded over the mesh ``data`` axis.
 
@@ -425,6 +502,7 @@ def train_booster(
             # bin_sample_count/boost_from_average change bin boundaries /
             # the base score, so a changed value must invalidate resume.
             config=(objective, num_class, cfg_norm, max_bin, bin_sample_count,
+                    tuple(int(i) for i in categorical_features),
                     boost_from_average, feature_fraction,
                     bagging_fraction, bagging_freq, seed, boosting_type,
                     top_rate, other_rate,
@@ -451,6 +529,7 @@ def train_booster(
     mesh = mesh or meshlib.get_default_mesh()
     cfg = cfg or GrowConfig()
     cfg = cfg._replace(num_bins=max_bin)
+    X = _densify(X)
     if boosting_type == "rf":
         # random forest: no shrinkage; the averaged ensemble is scaled at
         # finalize time instead (LightGBM rf semantics)
@@ -464,7 +543,18 @@ def train_booster(
     w = np.ones_like(y) if weight is None else np.asarray(weight, np.float32)
     n, F = X.shape
 
-    binner = QuantileBinner(max_bin, bin_sample_count, seed).fit(X)
+    bad_cats = [int(i) for i in categorical_features
+                if not (0 <= int(i) < F)]
+    if bad_cats:
+        raise ValueError(
+            f"categorical_features indexes {bad_cats} out of range for "
+            f"{F} features")
+    binner = QuantileBinner(max_bin, bin_sample_count, seed,
+                            categorical_features).fit(X)
+    # categorical routing mask: None when absent so the purely-numeric path
+    # compiles with zero bitset overhead
+    is_cat_np = binner.is_cat_mask()
+    is_cat_j = jnp.asarray(is_cat_np) if is_cat_np.any() else None
 
     nshards = meshlib.num_shards(mesh)
     # Binning runs ON DEVICE, producing the column-major [F, n_local] layout
@@ -511,7 +601,7 @@ def train_booster(
     has_valid = valid_set is not None
     if has_valid:
         Xv, yv, wv = valid_set
-        Xv = np.asarray(Xv, np.float32)
+        Xv = np.asarray(_densify(Xv), np.float32)
         yv = np.asarray(yv, np.float32)
         wv = np.ones_like(yv) if wv is None else np.asarray(wv, np.float32)
         nv = len(yv)
@@ -550,7 +640,8 @@ def train_booster(
             iteration_callback=iteration_callback,
             metric_eval_period=metric_eval_period,
             drop_rate=drop_rate, max_drop=max_drop, skip_drop=skip_drop,
-            drop_seed=drop_seed, binner=binner, max_bin=max_bin)
+            drop_seed=drop_seed, binner=binner, max_bin=max_bin,
+            is_cat_j=is_cat_j)
 
     def step_local(binned_t, yl, wl, vmask, scores, vbinned, vy, vw,
                    vscores, key, bag_key, it_f):
@@ -602,7 +693,8 @@ def train_booster(
                 else grow_tree)
         for k in range(K):
             tree, row_node = grow(binned_t, grad[:, k], hess[:, k], row_mask,
-                                  fmask, cfg, axis_name="data")
+                                  fmask, cfg, axis_name="data",
+                                  is_cat=is_cat_j)
             if not is_rf:
                 # rf: trees are independent (gradients stay at the base
                 # score); gbdt/goss: boost on the updated margin
@@ -616,7 +708,8 @@ def train_booster(
             for k in range(K):
                 tr = jax.tree_util.tree_map(lambda a: a[k], trees_stacked)
                 vscores = vscores.at[:, k].add(
-                    predict_tree_binned(tr, vbinned, depth_cap))
+                    predict_tree_binned(tr, vbinned, depth_cap,
+                                        is_cat=is_cat_j))
             if is_rf:
                 # ensemble-so-far = base + average of accumulated raw trees
                 vbase = jnp.asarray(base)[None, :]
@@ -648,6 +741,7 @@ def train_booster(
     # cache the compiled step across train_booster calls: the closure is fresh
     # per call, so jit's identity-keyed cache would otherwise recompile
     cache_key = (cfg, K, objective, tuple(sorted(objective_kwargs.items())),
+                 tuple(np.flatnonzero(is_cat_np).tolist()),
                  Xbt_d.shape, None if not has_valid else Xvb_d.shape,
                  use_bagging, bagging_fraction, bagging_freq,
                  feature_fraction, depth_cap,
@@ -814,7 +908,7 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
                 feature_fraction, use_bagging, bagging_fraction, bagging_freq,
                 early_stopping_rounds, iteration_callback, metric_eval_period,
                 drop_rate, max_drop, skip_drop, drop_seed,
-                binner, max_bin) -> Booster:
+                binner, max_bin, is_cat_j=None) -> Booster:
     """DART boosting: Dropouts meet Multiple Additive Regression Trees.
 
     Parity target: LightGBM's ``boosting=dart`` (reference exposes it via
@@ -860,7 +954,8 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
         trees_out, new_contrib = [], []
         for k in range(K):
             tree, row_node = grow(binned_t, grad[:, k], hess[:, k], row_mask,
-                                  fmask, cfg, axis_name="data")
+                                  fmask, cfg, axis_name="data",
+                                  is_cat=is_cat_j)
             new_contrib.append(tree.leaf_value[row_node])
             trees_out.append(tree)
         nc = jnp.stack(new_contrib, axis=1)                # [n_local, K]
@@ -871,7 +966,8 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
             vc = jnp.stack(
                 [predict_tree_binned(
                     jax.tree_util.tree_map(lambda a: a[k], trees_stacked),
-                    vbinned, depth_cap) for k in range(K)], axis=1)
+                    vbinned, depth_cap, is_cat=is_cat_j)
+                 for k in range(K)], axis=1)
             vcontribs = lax.dynamic_update_slice(
                 vcontribs, vc[None], (it_i, 0, 0))
         return contribs, vcontribs, trees_stacked
@@ -894,7 +990,10 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
     # fresh per fit() call, so jit's identity-keyed cache would recompile on
     # every trial of a sweep
     cache_key = ("dart", cfg, K, objective,
-                 tuple(sorted(objective_kwargs.items())), Xbt_d.shape,
+                 tuple(sorted(objective_kwargs.items())),
+                 None if is_cat_j is None
+                 else tuple(np.flatnonzero(np.asarray(is_cat_j)).tolist()),
+                 Xbt_d.shape,
                  None if not has_valid else Xvb_d.shape, T_max,
                  use_bagging, bagging_fraction, bagging_freq,
                  feature_fraction, depth_cap, metric_name,
@@ -1025,7 +1124,8 @@ def _pad_tree_slots(trees: Tree, thr: np.ndarray, M: int):
         if a.ndim == 1:          # per-tree scalars (node_count)
             return a
         fill = {"is_leaf": True}.get(name, 0)
-        return np.pad(a, ((0, 0), (0, pad)), constant_values=fill)
+        width = [(0, 0), (0, pad)] + [(0, 0)] * (a.ndim - 2)
+        return np.pad(a, width, constant_values=fill)
 
     trees = Tree(**{k: pad_field(k, v)
                     for k, v in trees._asdict().items()})
@@ -1041,8 +1141,19 @@ def _merge_boosters(first: Booster, second: Booster) -> Booster:
     model vs freshly grown trees): both sides are padded to the wider M."""
     assert first.num_class == second.num_class
     M = max(first.trees.feat.shape[1], second.trees.feat.shape[1])
-    t1, thr1 = _pad_tree_slots(first.trees, first.thr_raw, M)
-    t2, thr2 = _pad_tree_slots(second.trees, second.thr_raw, M)
+    # bitset word widths may also differ (e.g. max_bin 63 vs 255 models)
+    BW = max(first.trees.cat_bitset.shape[-1],
+             second.trees.cat_bitset.shape[-1])
+
+    def widen_bits(t: Tree) -> Tree:
+        cur = t.cat_bitset.shape[-1]
+        if cur == BW:
+            return t
+        return t._replace(cat_bitset=np.pad(
+            np.asarray(t.cat_bitset), ((0, 0), (0, 0), (0, BW - cur))))
+
+    t1, thr1 = _pad_tree_slots(widen_bits(first.trees), first.thr_raw, M)
+    t2, thr2 = _pad_tree_slots(widen_bits(second.trees), second.thr_raw, M)
     trees = jax.tree_util.tree_map(
         lambda a, c: np.concatenate([np.asarray(a), np.asarray(c)], axis=0),
         t1, t2)

@@ -61,6 +61,12 @@ class GrowConfig(NamedTuple):
     # all-reduced — two small collectives instead of one [F,3,B] psum.
     voting: bool = False
     top_k: int = 20
+    # categorical splits (reference ingests categorical metadata natively:
+    # core/schema/Categoricals.scala, LightGBMUtils.scala:227,256): category
+    # bins are sorted by smoothed gradient ratio and scanned as prefixes
+    # (LightGBM's sorted-subset search); the chosen subset is a bitset.
+    cat_smooth: float = 10.0
+    max_cat_threshold: int = 32
 
 
 def _soft_threshold(g, l1):
@@ -72,28 +78,88 @@ def _leaf_objective(g, h, cfg):
     return sg * sg / (h + cfg.lambda_l2 + 1e-38)
 
 
-def _best_split(hist, tot_g, tot_h, tot_c, cfg: GrowConfig, feat_mask, allow):
-    """Best (feature, bin) split of one node from its histogram.
+def bitset_words(num_bins: int) -> int:
+    return -(-int(num_bins) // 32)
 
-    hist: [F, 3, B] (grad, hess, count per bin). Split "bin <= b" for
-    b in [0, B-2]. Returns (gain, feat, bin, left_g, left_h, left_c).
+
+def _pack_bits(member: jnp.ndarray) -> jnp.ndarray:
+    """[B] bool -> [ceil(B/32)] uint32 bitset."""
+    B = member.shape[0]
+    BW = bitset_words(B)
+    m = jnp.pad(member.astype(jnp.uint32), (0, BW * 32 - B))
+    m = m.reshape(BW, 32)
+    return jnp.sum(m << jnp.arange(32, dtype=jnp.uint32)[None, :], axis=1,
+                   dtype=jnp.uint32)
+
+
+def bit_test(bits: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """bits: [..., BW] uint32; idx: [...] int — membership test, broadcast
+    over leading dims."""
+    word = jnp.take_along_axis(
+        bits, (idx >> 5)[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return ((word >> (idx.astype(jnp.uint32) & 31)) & 1).astype(bool)
+
+
+def _best_split(hist, tot_g, tot_h, tot_c, cfg: GrowConfig, feat_mask, allow,
+                is_cat=None):
+    """Best split of one node from its histogram — numeric or categorical.
+
+    hist: [F, 3, B] (grad, hess, count per bin). Numeric features split
+    "bin <= b" for b in [0, B-2]. Categorical features (``is_cat`` [F] bool)
+    use LightGBM's sorted-subset search: bins ordered by smoothed ratio
+    g/(h + cat_smooth), prefixes scanned as candidate left-subsets (capped at
+    ``max_cat_threshold`` categories), the winner encoded as a bin bitset.
+    Returns (gain, feat, bin, left_g, left_h, left_c, bits[BW] uint32) —
+    ``bits`` is all-zero for a numeric winner.
     """
     B = hist.shape[-1]
-    gl = jnp.cumsum(hist[:, 0, :], axis=-1)
-    hl = jnp.cumsum(hist[:, 1, :], axis=-1)
-    cl = jnp.cumsum(hist[:, 2, :], axis=-1)
+    g, h, c = hist[:, 0, :], hist[:, 1, :], hist[:, 2, :]
+    gl = jnp.cumsum(g, axis=-1)
+    hl = jnp.cumsum(h, axis=-1)
+    cl = jnp.cumsum(c, axis=-1)
+    prefix_ok = jnp.ones((hist.shape[0], B), dtype=bool)
+    rank = None
+    if is_cat is not None:
+        # categorical tables: cumsums in smoothed-ratio order; empty bins
+        # sort last (+inf) so prefixes enumerate real categories first
+        ratio = jnp.where(c > 0, g / (h + cfg.cat_smooth), jnp.inf)
+        order = jnp.argsort(ratio, axis=-1)                     # [F, B]
+        rank = jnp.zeros_like(order).at[
+            jnp.arange(order.shape[0])[:, None], order].set(
+            jnp.broadcast_to(jnp.arange(B), order.shape))
+        gs = jnp.take_along_axis(g, order, axis=-1)
+        hs = jnp.take_along_axis(h, order, axis=-1)
+        cs = jnp.take_along_axis(c, order, axis=-1)
+        glc = jnp.cumsum(gs, axis=-1)
+        hlc = jnp.cumsum(hs, axis=-1)
+        clc = jnp.cumsum(cs, axis=-1)
+        icat = is_cat[:, None]
+        gl = jnp.where(icat, glc, gl)
+        hl = jnp.where(icat, hlc, hl)
+        cl = jnp.where(icat, clc, cl)
+        # prefix length b+1 capped (LightGBM max_cat_threshold)
+        prefix_ok = jnp.where(
+            icat, jnp.arange(B)[None, :] < int(cfg.max_cat_threshold),
+            prefix_ok)
     gr, hr, cr = tot_g - gl, tot_h - hl, tot_c - cl
     gain = (_leaf_objective(gl, hl, cfg) + _leaf_objective(gr, hr, cfg)
             - _leaf_objective(tot_g, tot_h, cfg))
     ok = ((cl >= cfg.min_data_in_leaf) & (cr >= cfg.min_data_in_leaf)
           & (hl >= cfg.min_sum_hessian_in_leaf) & (hr >= cfg.min_sum_hessian_in_leaf)
-          & feat_mask[:, None] & allow)
+          & feat_mask[:, None] & allow & prefix_ok)
     ok = ok.at[:, B - 1].set(False)  # last bin: empty right side
     gain = jnp.where(ok, gain, NEG_INF)
     flat = jnp.argmax(gain)
     f, b = flat // B, flat % B
     pick = lambda a: a[f, b]
-    return gain[f, b], f.astype(jnp.int32), b.astype(jnp.int32), pick(gl), pick(hl), pick(cl)
+    BW = bitset_words(B)
+    if is_cat is None:
+        bits = jnp.zeros(BW, dtype=jnp.uint32)
+    else:
+        member = is_cat[f] & (rank[f] <= b)                     # [B] bool
+        bits = _pack_bits(member)
+    return (gain[f, b], f.astype(jnp.int32), b.astype(jnp.int32),
+            pick(gl), pick(hl), pick(cl), bits)
 
 
 class Tree(NamedTuple):
@@ -110,11 +176,14 @@ class Tree(NamedTuple):
     node_cnt: jnp.ndarray   # [M] f32
     split_gain: jnp.ndarray  # [M] f32 gain of the split at internal nodes
     node_value: jnp.ndarray  # [M] f32 expected value at every node (SHAP path)
+    cat_bitset: jnp.ndarray  # [M, BW] uint32 left-subset bitset (categorical
+    #                          splits; all-zero rows are numeric splits)
 
 
 def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               valid: jnp.ndarray, feat_mask: jnp.ndarray, cfg: GrowConfig,
-              axis_name: Optional[str] = None):
+              axis_name: Optional[str] = None,
+              is_cat: Optional[jnp.ndarray] = None):
     """Grow one tree on (possibly sharded) rows.
 
     binned_t: [F, n] int32 (column-major); grad/hess: [n] f32; valid: [n] f32
@@ -127,6 +196,7 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     L = int(cfg.num_leaves)
     M = 2 * L - 1
     B = int(cfg.num_bins)
+    BW = bitset_words(B)
 
     vm = valid.astype(jnp.float32)
     base_t = jnp.stack([grad * vm, hess * vm, vm], axis=0)   # [3, n]
@@ -181,11 +251,13 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     # cfg is static Python config: root may split unless max_depth == 0
     root_allow = jnp.bool_(cfg.max_depth < 0 or cfg.max_depth >= 1)
-    g0, f0, b0, lg0, lh0, lc0 = _best_split(
-        root_hist, tot_g, tot_h, tot_c, cfg, feat_mask & sel0, root_allow)
+    g0, f0, b0, lg0, lh0, lc0, bits0 = _best_split(
+        root_hist, tot_g, tot_h, tot_c, cfg, feat_mask & sel0, root_allow,
+        is_cat)
 
     zi = jnp.zeros(M, dtype=jnp.int32)
     zf = jnp.zeros(M, dtype=jnp.float32)
+    zbits = jnp.zeros((M, BW), dtype=jnp.uint32)
     state = dict(
         row_node=jnp.zeros(n, dtype=jnp.int32),
         feat=zi, thr=zi, left=zi, right=zi,
@@ -195,6 +267,8 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         cg=jnp.full(M, NEG_INF).at[0].set(g0),
         cf=zi.at[0].set(f0), cb=zi.at[0].set(b0),
         clg=zf.at[0].set(lg0), clh=zf.at[0].set(lh0), clc=zf.at[0].set(lc0),
+        cbits=zbits.at[0].set(bits0),
+        tbits=zbits,
         gain=zf,
         num_nodes=jnp.int32(1),
     )
@@ -204,12 +278,17 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         best_gain = st["cg"][node]
         do = best_gain > cfg.min_gain_to_split
         bf, bb = st["cf"][node], st["cb"][node]
+        nbits = st["cbits"][node]
         lid = st["num_nodes"]
         rid = lid + 1
 
         col = lax.dynamic_index_in_dim(binned_t, bf, axis=0, keepdims=False)
         in_node = st["row_node"] == node
         go_left = col <= bb
+        if is_cat is not None:
+            word = nbits[col >> 5]
+            member = ((word >> (col.astype(jnp.uint32) & 31)) & 1).astype(bool)
+            go_left = jnp.where(is_cat[bf], member, go_left)
         # side: 0 = left child, 1 = right child, -1 = not in the split node
         side = jnp.where(in_node, jnp.where(go_left, 0, 1), -1).astype(jnp.int32)
         h2, sel = all_hist(side, 2)
@@ -220,10 +299,10 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         child_depth = st["depth"][node] + 1
         can_split_child = jnp.where(
             cfg.max_depth < 0, True, child_depth + 1 <= cfg.max_depth)
-        gL, fL, bL, lgL, lhL, lcL = _best_split(
-            hist_l, lg, lh, lc, cfg, feat_mask & sel, can_split_child)
-        gR, fR, bR, lgR, lhR, lcR = _best_split(
-            hist_r, rg, rh, rc, cfg, feat_mask & sel, can_split_child)
+        gL, fL, bL, lgL, lhL, lcL, bitsL = _best_split(
+            hist_l, lg, lh, lc, cfg, feat_mask & sel, can_split_child, is_cat)
+        gR, fR, bR, lgR, lhR, lcR, bitsR = _best_split(
+            hist_r, rg, rh, rc, cfg, feat_mask & sel, can_split_child, is_cat)
 
         new = dict(st)
         new["row_node"] = jnp.where(
@@ -244,6 +323,8 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         new["clg"] = st["clg"].at[lid].set(lgL).at[rid].set(lgR)
         new["clh"] = st["clh"].at[lid].set(lhL).at[rid].set(lhR)
         new["clc"] = st["clc"].at[lid].set(lcL).at[rid].set(lcR)
+        new["cbits"] = st["cbits"].at[lid].set(bitsL).at[rid].set(bitsR)
+        new["tbits"] = st["tbits"].at[node].set(nbits)
         new["num_nodes"] = st["num_nodes"] + 2
         return jax.tree_util.tree_map(
             lambda a, b: jnp.where(do, a, b), new, st)
@@ -261,7 +342,7 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         right=state["right"], is_leaf=state["is_leaf"], leaf_value=leaf_value,
         node_count=state["num_nodes"], node_grad=state["ng"],
         node_hess=state["nh"], node_cnt=state["nc"], split_gain=state["gain"],
-        node_value=node_value)
+        node_value=node_value, cat_bitset=state["tbits"])
     # row_node is each row's final leaf: leaf_value[row_node] is this tree's
     # prediction for the training rows — no traversal needed during boosting.
     return tree, state["row_node"]
@@ -270,7 +351,8 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
                         hess: jnp.ndarray, valid: jnp.ndarray,
                         feat_mask: jnp.ndarray, cfg: GrowConfig,
-                        axis_name: Optional[str] = None):
+                        axis_name: Optional[str] = None,
+                        is_cat: Optional[jnp.ndarray] = None):
     """Level-synchronous growth: one histogram pass per level.
 
     Every node on the level frontier contributes 3 stat channels
@@ -288,6 +370,7 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
     L = int(cfg.num_leaves)
     M = 2 * L - 1
     B = int(cfg.num_bins)
+    BW = bitset_words(B)
     # Without an explicit max_depth, allow two levels of slack beyond the
     # balanced depth so moderately skewed trees can still spend the leaf
     # budget (extreme skew is leafwise's domain — a perfectly unbalanced
@@ -302,7 +385,8 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
     tree_arrays = dict(
         feat=zi, thr=zi, left=zi, right=zi,
         is_leaf=jnp.ones(M, dtype=bool), gain=zf,
-        ng=zf, nh=zf, nc=zf)
+        ng=zf, nh=zf, nc=zf,
+        bits=jnp.zeros((M, BW), dtype=jnp.uint32))
 
     row_node = jnp.zeros(n, dtype=jnp.int32)
     num_nodes = jnp.int32(1)
@@ -319,7 +403,7 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
     # frontier: node slot ids at the current level (-1 = inactive slot)
     frontier = jnp.full(L, -1, dtype=jnp.int32).at[0].set(0)
 
-    vsplit = jax.vmap(_best_split, in_axes=(0, 0, 0, 0, None, None, 0))
+    vsplit = jax.vmap(_best_split, in_axes=(0, 0, 0, 0, None, None, 0, None))
 
     def make_level(depth: int, W: int):
         def level_work(state):
@@ -348,8 +432,9 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
 
             allow = active & jnp.bool_(cfg.max_depth < 0
                                        or depth + 1 <= cfg.max_depth)
-            gains, feats, bins_, lgs, lhs, lcs = vsplit(
-                h, tot[:, 0], tot[:, 1], tot[:, 2], cfg, feat_mask, allow)
+            gains, feats, bins_, lgs, lhs, lcs, bits_w = vsplit(
+                h, tot[:, 0], tot[:, 1], tot[:, 2], cfg, feat_mask, allow,
+                is_cat)
             gains = jnp.where(active, gains, NEG_INF)
 
             # budget: leaves + #splits <= num_leaves — best gains first
@@ -372,6 +457,11 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
             move = pos_oh & do[:, None]                          # [W, n]
             rows = binned_t[feats]                               # [W, n]
             goleft_w = rows <= bins_[:, None]
+            if is_cat is not None:
+                word = jnp.take_along_axis(bits_w, rows >> 5, axis=1)
+                member = ((word >> (rows.astype(jnp.uint32) & 31)) & 1
+                          ).astype(bool)
+                goleft_w = jnp.where(is_cat[feats][:, None], member, goleft_w)
             do_row = jnp.any(move, axis=0)
             go_left = jnp.any(move & goleft_w, axis=0)
             lid_row = jnp.sum(jnp.where(move, lid[:, None], 0), axis=0)
@@ -389,6 +479,7 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
             ta["right"] = ta["right"].at[slot].set(rid, mode="drop")
             ta["is_leaf"] = ta["is_leaf"].at[slot].set(False, mode="drop")
             ta["gain"] = ta["gain"].at[slot].set(gains, mode="drop")
+            ta["bits"] = ta["bits"].at[slot].set(bits_w, mode="drop")
             # children stats
             parent_g, parent_h, parent_c = tot[:, 0], tot[:, 1], tot[:, 2]
             lslot = jnp.where(do, lid, M)
@@ -439,11 +530,13 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
         is_leaf=tree_arrays["is_leaf"], leaf_value=leaf_value,
         node_count=num_nodes, node_grad=tree_arrays["ng"],
         node_hess=tree_arrays["nh"], node_cnt=tree_arrays["nc"],
-        split_gain=tree_arrays["gain"], node_value=node_value)
+        split_gain=tree_arrays["gain"], node_value=node_value,
+        cat_bitset=tree_arrays["bits"])
     return tree, row_node
 
 
-def predict_tree_binned(tree: Tree, binned: jnp.ndarray, depth_cap: int) -> jnp.ndarray:
+def predict_tree_binned(tree: Tree, binned: jnp.ndarray, depth_cap: int,
+                        is_cat: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Evaluate one tree on binned rows: [n, F] -> [n] leaf values."""
     n = binned.shape[0]
     node = jnp.zeros(n, dtype=jnp.int32)
@@ -452,31 +545,56 @@ def predict_tree_binned(tree: Tree, binned: jnp.ndarray, depth_cap: int) -> jnp.
         f = tree.feat[node]
         t = tree.thr_bin[node]
         x = jnp.take_along_axis(binned, f[:, None], axis=1)[:, 0]
-        nxt = jnp.where(x <= t, tree.left[node], tree.right[node])
+        go_left = x <= t
+        if is_cat is not None:
+            go_left = jnp.where(is_cat[f],
+                                bit_test(tree.cat_bitset[node], x), go_left)
+        nxt = jnp.where(go_left, tree.left[node], tree.right[node])
         return jnp.where(tree.is_leaf[node], node, nxt)
 
     node = lax.fori_loop(0, depth_cap, body, node)
     return tree.leaf_value[node]
 
 
+def raw_to_cat_bin(x: jnp.ndarray, max_bin_idx: int) -> jnp.ndarray:
+    """Raw categorical value -> bin id: round-to-nearest, NaN/negative -> 0
+    (matching the binner's 0.5-boundary categorical bins)."""
+    b = jnp.where(jnp.isnan(x), 0.0, jnp.floor(x + 0.5))
+    return jnp.clip(b, 0, max_bin_idx).astype(jnp.int32)
+
+
 def predict_forest_raw(trees, thr_raw, features: jnp.ndarray,
-                       depth_cap: int) -> jnp.ndarray:
+                       depth_cap: int,
+                       is_cat: Optional[jnp.ndarray] = None,
+                       cat_max_bin: int = 0) -> jnp.ndarray:
     """Evaluate a stacked forest on RAW float features.
 
     trees: Tree of arrays stacked on a leading [T] axis; thr_raw: [T, M] f32 raw
     thresholds ("go left if x <= thr", NaN goes left — matching the binning
-    convention of NaN -> bin 0). features: [n, F]. Returns [T, n].
+    convention of NaN -> bin 0). Categorical features (``is_cat``) route by
+    bitset membership of the rounded category id. features: [n, F].
+    Returns [T, n].
     """
     n = features.shape[0]
 
     def one_tree(tree_slice, thr):
         node = jnp.zeros(n, dtype=jnp.int32)
+        # clip to the BINNER's last bin (the training-time catch-all), not
+        # the bitset word boundary — otherwise out-of-range ids route
+        # differently at serving than they did during training
+        max_bin_idx = (cat_max_bin - 1 if cat_max_bin > 0
+                       else tree_slice.cat_bitset.shape[-1] * 32 - 1)
 
         def body(_, node):
             f = tree_slice.feat[node]
             t = thr[node]
             x = jnp.take_along_axis(features, f[:, None], axis=1)[:, 0]
             go_left = ~(x > t)  # NaN compares false -> goes left
+            if is_cat is not None:
+                cbin = raw_to_cat_bin(x, max_bin_idx)
+                go_left = jnp.where(
+                    is_cat[f], bit_test(tree_slice.cat_bitset[node], cbin),
+                    go_left)
             nxt = jnp.where(go_left, tree_slice.left[node], tree_slice.right[node])
             return jnp.where(tree_slice.is_leaf[node], node, nxt)
 

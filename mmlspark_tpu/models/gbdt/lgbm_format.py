@@ -95,7 +95,12 @@ def _fmt(x: float) -> str:
 
 def _tree_to_string(tree: Tree, thr_raw: np.ndarray, idx: int,
                     add_bias: float, shrinkage: float) -> str:
-    """One ``Tree=i`` block from the fixed-shape slot arrays."""
+    """One ``Tree=i`` block from the fixed-shape slot arrays.
+
+    Categorical splits emit LightGBM's bitset encoding: decision_type bit 0
+    set, ``threshold`` holding the split's index into ``cat_boundaries``,
+    and ``cat_threshold`` carrying the uint32 membership words
+    (Tree::ToString / FindInBitset semantics: member -> left)."""
     n_nodes = int(tree.node_count)
     is_leaf = np.asarray(tree.is_leaf)[:n_nodes]
     internal_slots = [s for s in range(n_nodes) if not is_leaf[s]]
@@ -109,7 +114,10 @@ def _tree_to_string(tree: Tree, thr_raw: np.ndarray, idx: int,
         return (int_index[slot] if slot in int_index
                 else -leaf_index[slot] - 1)
 
-    lines = [f"Tree={idx}", f"num_leaves={num_leaves}", "num_cat=0"]
+    bits = np.asarray(tree.cat_bitset, np.uint32)
+    cat_slots = [s for s in internal_slots if bits[s].any()]
+    lines = [f"Tree={idx}", f"num_leaves={num_leaves}",
+             f"num_cat={len(cat_slots)}"]
     lv = np.asarray(tree.leaf_value, np.float64)
     nv = np.asarray(tree.node_value, np.float64)
     nh = np.asarray(tree.node_hess, np.float64)
@@ -117,14 +125,31 @@ def _tree_to_string(tree: Tree, thr_raw: np.ndarray, idx: int,
     gain = np.asarray(tree.split_gain, np.float64)
     if internal_slots:
         feats = [int(np.asarray(tree.feat)[s]) for s in internal_slots]
-        # decision_type: numerical, default-left, missing=NaN (our binning
-        # sends NaN to bin 0, i.e. left)
-        dt = 2 | (_KNOWN_MISSING_NAN << 2)
+        # decision_type: numerical splits are default-left w/ missing=NaN
+        # (our binning sends NaN to bin 0, i.e. left); categorical splits
+        # set bit 0 and route by bitset membership
+        dt_num = 2 | (_KNOWN_MISSING_NAN << 2)
+        dts, thrs = [], []
+        cat_boundaries = [0]
+        cat_words: List[int] = []
+        for s_ in internal_slots:
+            if s_ in set(cat_slots):
+                dts.append(1)
+                thrs.append(str(len(cat_boundaries) - 1))   # cat_idx
+                words = [int(w) for w in bits[s_]]
+                # trim trailing zero words (LightGBM stores minimal width)
+                while len(words) > 1 and words[-1] == 0:
+                    words.pop()
+                cat_words.extend(words)
+                cat_boundaries.append(len(cat_words))
+            else:
+                dts.append(dt_num)
+                thrs.append(_fmt(thr_raw[s_]))
         lines += [
             "split_feature=" + " ".join(str(f) for f in feats),
             "split_gain=" + " ".join(_fmt(gain[s]) for s in internal_slots),
-            "threshold=" + " ".join(_fmt(thr_raw[s]) for s in internal_slots),
-            "decision_type=" + " ".join([str(dt)] * len(internal_slots)),
+            "threshold=" + " ".join(thrs),
+            "decision_type=" + " ".join(str(d) for d in dts),
             "left_child=" + " ".join(
                 str(child_ref(int(np.asarray(tree.left)[s])))
                 for s in internal_slots),
@@ -132,6 +157,11 @@ def _tree_to_string(tree: Tree, thr_raw: np.ndarray, idx: int,
                 str(child_ref(int(np.asarray(tree.right)[s])))
                 for s in internal_slots),
         ]
+        if cat_slots:
+            lines += [
+                "cat_boundaries=" + " ".join(str(b) for b in cat_boundaries),
+                "cat_threshold=" + " ".join(str(w) for w in cat_words),
+            ]
     lines += [
         "leaf_value=" + " ".join(_fmt(lv[s] + add_bias) for s in leaf_slots),
         "leaf_weight=" + " ".join(_fmt(nh[s]) for s in leaf_slots),
@@ -206,8 +236,11 @@ def parse_lightgbm_string(s: str):
     """Parse a LightGBM text model into Booster constructor pieces.
 
     Returns (trees: Tree stacked [T, M], thr_raw [T, M], num_class,
-    objective, objective_kwargs, num_features). The parsed model predicts
-    with base_score = 0: LightGBM folds any init score into tree leaves.
+    objective, objective_kwargs, num_features, categorical_features).
+    The parsed model predicts with base_score = 0: LightGBM folds any init
+    score into tree leaves. Categorical splits (decision_type bit 0) load
+    their cat_threshold bitsets; the features they split on are returned so
+    the Booster routes them by category-id membership.
     """
     if not s.lstrip().startswith("tree"):
         raise ValueError("not a LightGBM text model (must start with 'tree')")
@@ -222,10 +255,16 @@ def parse_lightgbm_string(s: str):
 
     tree_blocks = parts[1:]
     max_leaves = 1
+    max_cat_words = 1
     for blk in tree_blocks:
         fields = _parse_block("x=" + blk)  # keep first line (index) harmless
         max_leaves = max(max_leaves, int(fields["num_leaves"][0]))
+        bounds = [int(x) for x in fields.get("cat_boundaries", [])]
+        for a, b in zip(bounds, bounds[1:]):
+            max_cat_words = max(max_cat_words, b - a)
     M = 2 * max_leaves - 1
+    BW = max_cat_words
+    cat_features: set = set()
 
     def zeros_i():
         return np.zeros(M, np.int32)
@@ -239,14 +278,13 @@ def parse_lightgbm_string(s: str):
         fields = _parse_block("idx=" + blk)
         nl = int(fields["num_leaves"][0])
         n_int = nl - 1
-        if int(fields.get("num_cat", ["0"])[0]) > 0:
-            raise NotImplementedError(
-                "categorical splits in LightGBM model files are not "
-                "supported yet")
         feat, thr, left, right = zeros_i(), zeros_f(), zeros_i(), zeros_i()
         is_leaf = np.ones(M, bool)
         leaf_value, node_value = zeros_f(), zeros_f()
         node_hess, node_cnt, gain = zeros_f(), zeros_f(), zeros_f()
+        cat_bits = np.zeros((M, BW), np.uint32)
+        cat_boundaries = [int(x) for x in fields.get("cat_boundaries", [])]
+        cat_words = [int(x) for x in fields.get("cat_threshold", [])]
 
         def slot(ref: int) -> int:
             # internal i -> slot i; leaf j -> slot n_int + j
@@ -276,9 +314,23 @@ def parse_lightgbm_string(s: str):
             sg = [float(x) for x in fields.get("split_gain", ["0"] * n_int)]
             for i in range(n_int):
                 if dts[i] & 1:
-                    raise NotImplementedError(
-                        "categorical decision_type in LightGBM model files "
-                        "is not supported yet")
+                    # categorical split: threshold holds the cat_idx into
+                    # cat_boundaries; membership words -> our bitset rows
+                    cat_idx = int(float(fields["threshold"][i]))
+                    w0, w1 = cat_boundaries[cat_idx], cat_boundaries[cat_idx + 1]
+                    words = cat_words[w0:w1][:BW]
+                    cat_bits[i, :len(words)] = np.asarray(words, np.uint32)
+                    is_leaf[i] = False
+                    feat[i] = sf[i]
+                    thr[i] = np.inf       # unused: routing is by bitset
+                    left[i] = slot(lch[i])
+                    right[i] = slot(rch[i])
+                    node_value[i] = iv[i] if i < len(iv) else 0.0
+                    node_hess[i] = iw[i] if i < len(iw) else 0.0
+                    node_cnt[i] = ic[i] if i < len(ic) else 0.0
+                    gain[i] = sg[i] if i < len(sg) else 0.0
+                    cat_features.add(sf[i])
+                    continue
                 # This predictor always routes NaN left (`~(x > thr)`).
                 # A split whose stored missing handling differs would
                 # silently mispredict: default-right with NaN missing type,
@@ -315,9 +367,11 @@ def parse_lightgbm_string(s: str):
         stacked["node_cnt"].append(node_cnt)
         stacked["split_gain"].append(gain)
         stacked["node_value"].append(node_value)
+        stacked["cat_bitset"].append(cat_bits)
         thr_leaf = np.where(is_leaf, np.float32(np.inf), thr)
         thr_all.append(thr_leaf.astype(np.float32))
 
     trees = Tree(**{k: np.stack(v) for k, v in stacked.items()})
     thr_raw = np.stack(thr_all)
-    return trees, thr_raw, num_class, objective, obj_kwargs, F
+    return (trees, thr_raw, num_class, objective, obj_kwargs, F,
+            sorted(cat_features))

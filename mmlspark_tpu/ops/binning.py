@@ -42,12 +42,23 @@ def bin_cols_device(X: "jnp.ndarray", upper_bounds: "jnp.ndarray"):
 
 
 class QuantileBinner:
-    """Fit per-feature quantile bin boundaries; transform floats -> bin indices."""
+    """Fit per-feature quantile bin boundaries; transform floats -> bin indices.
 
-    def __init__(self, max_bin: int = 255, sample_count: int = 200_000, seed: int = 0):
+    ``categorical_features``: indices whose values are category ids — their
+    bins are the ids themselves (boundaries at c + 0.5, so bin(c) == c for
+    c in [0, max_bin-1], round-to-nearest for non-integral values, NaN and
+    negatives -> bin 0). The same searchsorted machinery (native C++, numpy
+    and on-device compare-sum) then handles both kinds with no special cases
+    (reference ingests categorical metadata natively:
+    core/schema/Categoricals.scala, LightGBMUtils.scala:227,256).
+    """
+
+    def __init__(self, max_bin: int = 255, sample_count: int = 200_000,
+                 seed: int = 0, categorical_features=()):
         self.max_bin = int(max_bin)
         self.sample_count = int(sample_count)
         self.seed = seed
+        self.categorical_features = tuple(int(i) for i in categorical_features)
         self.upper_bounds: Optional[np.ndarray] = None  # [F, max_bin-1] f32
         self.num_features: Optional[int] = None
 
@@ -61,7 +72,12 @@ class QuantileBinner:
         B = self.max_bin
         bounds = np.empty((F, B - 1), dtype=np.float32)
         qs = np.linspace(0.0, 1.0, B + 1)[1:-1]  # interior quantiles
+        cat = set(self.categorical_features)
         for f in range(F):
+            if f in cat:
+                # identity bins for category ids (bin(c) == c, clipped)
+                bounds[f] = np.arange(B - 1, dtype=np.float32) + 0.5
+                continue
             col = X[:, f]
             col = col[~np.isnan(col)]
             if col.size == 0:
@@ -113,11 +129,24 @@ class QuantileBinner:
             "seed": self.seed,
             "upper_bounds": self.upper_bounds,
             "num_features": self.num_features,
+            "categorical_features": list(self.categorical_features),
         }
 
     @staticmethod
     def from_state(state: dict) -> "QuantileBinner":
-        b = QuantileBinner(state["max_bin"], state["sample_count"], state["seed"])
+        b = QuantileBinner(state["max_bin"], state["sample_count"],
+                           state["seed"],
+                           state.get("categorical_features") or ())
         b.upper_bounds = state["upper_bounds"]
         b.num_features = state["num_features"]
         return b
+
+    def is_cat_mask(self) -> np.ndarray:
+        """[F] bool mask of categorical features."""
+        F = self.num_features or (
+            self.upper_bounds.shape[0] if self.upper_bounds is not None else 0)
+        m = np.zeros(F, dtype=bool)
+        for i in self.categorical_features:
+            if 0 <= i < F:
+                m[i] = True
+        return m

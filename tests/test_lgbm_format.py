@@ -114,10 +114,28 @@ class TestGoldenStockModel:
         np.testing.assert_allclose(b.predict_raw(X)[0, 0], 0.25 - 0.0625,
                                    rtol=1e-6)
 
-    def test_categorical_rejected_for_now(self):
-        s = GOLDEN.replace("decision_type=2 2", "decision_type=3 2")
-        with pytest.raises(NotImplementedError, match="categorical"):
-            Booster.from_string(s)
+    def test_categorical_decision_parses(self):
+        """decision_type bit 0 (categorical) loads its cat_threshold bitset
+        and routes by category-id membership."""
+        s = GOLDEN.replace("decision_type=2 2", "decision_type=1 2")
+        # split 0 becomes categorical with cat_idx 0: left-set = {1, 3}
+        s = s.replace("threshold=0.5 1.5", "threshold=0 1.5")
+        s = s.replace("left_child=", "cat_boundaries=0 1\n"
+                                     "cat_threshold=10\nleft_child=", 1)
+        b = Booster.from_string(s)
+        assert b.binner_state["categorical_features"], "cat feature recorded"
+        bits = np.asarray(b.trees.cat_bitset)
+        assert bits.any(), "bitset loaded"
+        # categories 1 and 3 (bits of 10 = 0b1010) go left at the root
+        f = int(np.asarray(b.trees.feat)[0, 0])
+        n_feat = b.binner_state["num_features"]
+        row = np.zeros((1, n_feat), np.float32)
+        row_in = row.copy()
+        row_in[0, f] = 1.0      # in set
+        row_out = row.copy()
+        row_out[0, f] = 2.0     # out of set
+        assert (b.predict_raw(row_in)[0, 0]
+                != b.predict_raw(row_out)[0, 0])
 
 
 class TestEmitParseRoundTrip:
