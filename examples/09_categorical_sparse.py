@@ -1,0 +1,49 @@
+"""Categorical splits + sparse CSR features.
+
+The "LightGBM - Overview" sample of the reference covers categorical
+metadata and sparse vectors (categoricalSlotIndexes, CSR ingestion —
+LightGBMUtils.scala:227,256). Here: a signal carried by a NON-CONTIGUOUS
+set of category ids — a single ordered split cannot separate ids {2, 5, 8}
+from their neighbors, a sorted-subset categorical split can — trained from
+a scipy CSR matrix end-to-end.
+"""
+
+import numpy as np
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.models.gbdt.api import LightGBMClassifier
+
+
+def main():
+    import scipy.sparse as sp
+
+    rng = np.random.default_rng(0)
+    n = 1500
+    merchant = rng.integers(0, 10, n).astype(np.float32)   # category ids
+    amount = rng.lognormal(3.0, 1.0, n).astype(np.float32)
+    hour = rng.integers(0, 24, n).astype(np.float32)
+    risky = np.isin(merchant, [2, 5, 8])                   # interleaved ids
+    fraud = (risky & (amount > 20) ^ (rng.uniform(size=n) < 0.05)
+             ).astype(np.float32)
+
+    X = sp.csr_matrix(np.column_stack([merchant, amount, hour]))
+    ds = Dataset({"features": X, "label": fraud})
+
+    model = LightGBMClassifier(
+        numIterations=20, numLeaves=7, minDataInLeaf=10, maxBin=63,
+        categoricalSlotIndexes=[0],          # merchant is categorical
+    ).fit(ds)
+
+    dense = Dataset({"features": X.toarray(), "label": fraud})
+    acc = (model.transform(dense).array("prediction") == fraud).mean()
+    print(f"categorical+CSR accuracy: {acc:.3f}")
+    assert acc > 0.9
+
+    # the model exports to the stock LightGBM text format, bitsets included
+    s = model.get_native_model()
+    assert "cat_threshold=" in s
+    print("native model string carries categorical bitsets")
+
+
+if __name__ == "__main__":
+    main()

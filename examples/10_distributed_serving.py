@@ -1,0 +1,61 @@
+"""Distributed serving: worker pool + gateway with failover.
+
+The reference's "Spark Serving" deployment spreads request handling over
+per-executor servers behind one endpoint (DistributedHTTPSource.scala).
+Here: two serving workers (each with its own compiled model program and
+micro-batcher) behind a load-balancing gateway; one worker is killed
+mid-traffic and requests keep flowing.
+"""
+
+import http.client
+import json
+
+import numpy as np
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.io.distributed_serving import DistributedServing
+from mmlspark_tpu.models.gbdt.api import LightGBMRegressor
+
+
+def main():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 4)).astype(np.float32)
+    y = (X @ np.array([1.0, -2.0, 0.5, 0.0])).astype(np.float32)
+    model = LightGBMRegressor(numIterations=8, numLeaves=7,
+                              minDataInLeaf=5).fit(
+        Dataset({"features": X, "label": y}))
+
+    def transform(ds):
+        rows = np.asarray([v["features"] for v in ds["value"]], np.float32)
+        preds = model.transform(Dataset({"features": rows}))
+        return ds.with_column("reply", [
+            {"entity": {"prediction": float(p)}, "statusCode": 200}
+            for p in preds.array("prediction")])
+
+    pool = DistributedServing(transform, num_workers=2).start()
+    try:
+        def post(row):
+            conn = http.client.HTTPConnection(pool.gateway.host,
+                                              pool.gateway.port, timeout=10)
+            conn.request("POST", "/serving",
+                         body=json.dumps({"features": row.tolist()}))
+            r = conn.getresponse()
+            body = json.loads(r.read())
+            conn.close()
+            return r.status, body
+
+        for i in range(10):
+            status, body = post(X[i])
+            assert status == 200
+
+        pool.kill_worker(0)                    # simulate a crash
+        ok = sum(post(X[i])[0] == 200 for i in range(10))
+        print(f"after worker crash: {ok}/10 requests served "
+              f"(failovers: {pool.gateway.failovers})")
+        assert ok == 10
+    finally:
+        pool.stop()
+
+
+if __name__ == "__main__":
+    main()

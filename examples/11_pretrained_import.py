@@ -1,0 +1,50 @@
+"""Importing genuinely pretrained CNN weights for featurization.
+
+The reference downloads trained CNTK models (AlexNet/ResNet-50) from its
+repository and featurizes with them (downloader/ModelDownloader.scala,
+image/ImageFeaturizer.scala). Here: a torchvision-format ResNet state_dict
+(any `resnet*` checkpoint saved as numpy/torch tensors) converts into the
+repository with batch-norm folded for inference, then drives the
+ImageFeaturizer with ImageNet preprocessing.
+"""
+
+import tempfile
+
+import numpy as np
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.models.dnn import (DNNModel, ImageFeaturizer,
+                                     ModelDownloader)
+
+
+def main():
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tests"))
+    from test_resnet50 import _rand_sd   # stand-in torchvision state_dict
+
+    repo = tempfile.mkdtemp()
+    d = ModelDownloader(repo)
+
+    # in production: sd = torch.load("resnet50-weights.pth"); here a random
+    # state_dict in the exact torchvision format (zero-egress image)
+    sd = _rand_sd(np.random.default_rng(0))
+    d.import_torch_resnet("MyPretrained", sd, arch_name="ResNet50Tiny")
+
+    model = DNNModel.from_downloader(repo, "MyPretrained")
+    feat = ImageFeaturizer(
+        dnn_model=model, input_hw=(64, 64),
+        # real torchvision checkpoints want ImageNet stats:
+        mean=ImageFeaturizer.IMAGENET_MEAN, std=ImageFeaturizer.IMAGENET_STD,
+        inputCol="image", outputCol="features")
+
+    imgs = [np.random.default_rng(i).integers(0, 256, (80, 60, 3))
+            .astype(np.uint8) for i in range(4)]
+    out = feat.transform(Dataset({"image": imgs}))
+    feats = np.asarray(list(out["features"]))
+    print(f"featurized {feats.shape[0]} images -> dim {feats.shape[1]}")
+    assert feats.shape == (4, 256) and np.isfinite(feats).all()
+
+
+if __name__ == "__main__":
+    main()

@@ -471,6 +471,8 @@ class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
         nshards = meshlib.num_shards(meshlib.get_default_mesh())
 
         X, y, w = self._extract_arrays(train_ds)
+        from .booster import _densify
+        X = _densify(X)            # ranker pads groups before train_booster
         group = np.asarray(train_ds[gcol])
         sizes = np.unique(group, return_counts=True)[1]
         S = int(min(self.get_or_default("maxGroupSize"),
@@ -480,6 +482,7 @@ class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
         valid_set = None
         if valid_ds is not None and len(valid_ds) > 0:
             Xv, yv, _ = self._extract_arrays(valid_ds)
+            Xv = _densify(Xv)
             gv = np.asarray(valid_ds[gcol])
             Xvp, yvp, _, validv, _ = _pad_groups(Xv, yv, None, gv, S, nshards)
             # per-row metric weight 1/group_size -> weighted mean == mean NDCG

@@ -132,6 +132,11 @@ class Booster:
             return mb - 1
         return int(np.asarray(self.trees.cat_bitset).shape[-1]) * 32 - 1
 
+    def _cat_strict(self) -> bool:
+        """Imported stock-LightGBM models (no binner): FindInBitset
+        semantics — out-of-range/NaN categories route right."""
+        return (self.binner_state.get("max_bin") or 0) <= 0
+
     def _is_cat(self):
         """[F] bool device mask of categorical features, or None."""
         cats = self.binner_state.get("categorical_features") or ()
@@ -222,6 +227,7 @@ class Booster:
         thr = jnp.asarray(self.thr_raw)
         is_cat = self._is_cat()
         cat_max_idx = self._cat_max_idx()
+        cat_strict = self._cat_strict()
         n, F = X.shape
         K = self.num_class
         T = self.num_trees
@@ -240,10 +246,11 @@ class Booster:
                 x = jnp.take_along_axis(Xd, f[:, None], axis=1)[:, 0]
                 go_left = ~(x > thr_t[node])
                 if is_cat is not None:
-                    from .growth import bit_test, raw_to_cat_bin
-                    cbin = raw_to_cat_bin(x, cat_max_idx)
+                    from .growth import cat_member
                     go_left = jnp.where(
-                        is_cat[f], bit_test(ts.cat_bitset[node], cbin),
+                        is_cat[f],
+                        cat_member(ts.cat_bitset[node], x, cat_max_idx,
+                                   cat_strict),
                         go_left)
                 nxt = jnp.where(go_left, ts.left[node], ts.right[node])
                 internal = ~ts.is_leaf[node]
@@ -276,6 +283,7 @@ class Booster:
 
         is_cat = self._is_cat()
         cat_max_idx = self._cat_max_idx()
+        cat_strict = self._cat_strict()
 
         def one_tree(ts, thr):
             node = jnp.zeros(n, dtype=jnp.int32)
@@ -285,10 +293,11 @@ class Booster:
                 x = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
                 go_left = ~(x > thr[node])
                 if is_cat is not None:
-                    from .growth import bit_test, raw_to_cat_bin
-                    cbin = raw_to_cat_bin(x, cat_max_idx)
+                    from .growth import cat_member
                     go_left = jnp.where(
-                        is_cat[f], bit_test(ts.cat_bitset[node], cbin),
+                        is_cat[f],
+                        cat_member(ts.cat_bitset[node], x, cat_max_idx,
+                                   cat_strict),
                         go_left)
                 nxt = jnp.where(go_left, ts.left[node], ts.right[node])
                 return jnp.where(ts.is_leaf[node], node, nxt)

@@ -563,6 +563,24 @@ def raw_to_cat_bin(x: jnp.ndarray, max_bin_idx: int) -> jnp.ndarray:
     return jnp.clip(b, 0, max_bin_idx).astype(jnp.int32)
 
 
+def cat_member(bits_rows: jnp.ndarray, x: jnp.ndarray, max_bin_idx: int,
+               strict: bool) -> jnp.ndarray:
+    """Categorical membership for raw values.
+
+    ``strict=False`` (models trained HERE): ids bin exactly as training did
+    — NaN/negative -> bin 0, out-of-range clips into the catch-all bin.
+    ``strict=True`` (models imported from stock LightGBM, which has no
+    catch-all): FindInBitset semantics — NaN or any id outside the bitset
+    routes right (non-member).
+    """
+    if not strict:
+        return bit_test(bits_rows, raw_to_cat_bin(x, max_bin_idx))
+    b = jnp.where(jnp.isnan(x), -1.0, jnp.floor(x + 0.5))
+    in_range = (b >= 0) & (b <= max_bin_idx)
+    cbin = jnp.clip(b, 0, max_bin_idx).astype(jnp.int32)
+    return bit_test(bits_rows, cbin) & in_range
+
+
 def predict_forest_raw(trees, thr_raw, features: jnp.ndarray,
                        depth_cap: int,
                        is_cat: Optional[jnp.ndarray] = None,
@@ -580,8 +598,10 @@ def predict_forest_raw(trees, thr_raw, features: jnp.ndarray,
     def one_tree(tree_slice, thr):
         node = jnp.zeros(n, dtype=jnp.int32)
         # clip to the BINNER's last bin (the training-time catch-all), not
-        # the bitset word boundary — otherwise out-of-range ids route
-        # differently at serving than they did during training
+        # the bitset word boundary — out-of-range ids must route exactly as
+        # they did during training. Imported stock-LightGBM models
+        # (cat_max_bin == 0) have no catch-all: out-of-range routes right.
+        strict = cat_max_bin <= 0
         max_bin_idx = (cat_max_bin - 1 if cat_max_bin > 0
                        else tree_slice.cat_bitset.shape[-1] * 32 - 1)
 
@@ -591,9 +611,10 @@ def predict_forest_raw(trees, thr_raw, features: jnp.ndarray,
             x = jnp.take_along_axis(features, f[:, None], axis=1)[:, 0]
             go_left = ~(x > t)  # NaN compares false -> goes left
             if is_cat is not None:
-                cbin = raw_to_cat_bin(x, max_bin_idx)
                 go_left = jnp.where(
-                    is_cat[f], bit_test(tree_slice.cat_bitset[node], cbin),
+                    is_cat[f],
+                    cat_member(tree_slice.cat_bitset[node], x, max_bin_idx,
+                               strict),
                     go_left)
             nxt = jnp.where(go_left, tree_slice.left[node], tree_slice.right[node])
             return jnp.where(tree_slice.is_leaf[node], node, nxt)

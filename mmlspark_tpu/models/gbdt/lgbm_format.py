@@ -94,13 +94,20 @@ def _fmt(x: float) -> str:
 
 
 def _tree_to_string(tree: Tree, thr_raw: np.ndarray, idx: int,
-                    add_bias: float, shrinkage: float) -> str:
+                    add_bias: float, shrinkage: float,
+                    catchall_bin: int = -1) -> str:
     """One ``Tree=i`` block from the fixed-shape slot arrays.
 
     Categorical splits emit LightGBM's bitset encoding: decision_type bit 0
     set, ``threshold`` holding the split's index into ``cat_boundaries``,
     and ``cat_threshold`` carrying the uint32 membership words
-    (Tree::ToString / FindInBitset semantics: member -> left)."""
+    (Tree::ToString / FindInBitset semantics: member -> left).
+
+    Caveat: ids >= maxBin-1 share the binner's catch-all bin during
+    training; in the exported format that bin's bit reads as exactly the
+    single category maxBin-1, so exports are bit-exact only when every
+    category id is < maxBin-1 (keep maxBin above the categorical
+    cardinality — a warning fires otherwise)."""
     n_nodes = int(tree.node_count)
     is_leaf = np.asarray(tree.is_leaf)[:n_nodes]
     internal_slots = [s for s in range(n_nodes) if not is_leaf[s]]
@@ -132,11 +139,22 @@ def _tree_to_string(tree: Tree, thr_raw: np.ndarray, idx: int,
         dts, thrs = [], []
         cat_boundaries = [0]
         cat_words: List[int] = []
+        cat_set = set(cat_slots)
         for s_ in internal_slots:
-            if s_ in set(cat_slots):
+            if s_ in cat_set:
                 dts.append(1)
                 thrs.append(str(len(cat_boundaries) - 1))   # cat_idx
                 words = [int(w) for w in bits[s_]]
+                if (catchall_bin >= 0
+                        and (words[catchall_bin >> 5]
+                             >> (catchall_bin & 31)) & 1):
+                    import warnings
+                    warnings.warn(
+                        "categorical split includes the catch-all bin "
+                        f"({catchall_bin}): ids >= maxBin-1 shared that bin "
+                        "in training, but stock LightGBM will read it as "
+                        "the single category id; re-train with maxBin above "
+                        "the categorical cardinality for a bit-exact export")
                 # trim trailing zero words (LightGBM stores minimal width)
                 while len(words) > 1 and words[-1] == 0:
                     words.pop()
@@ -208,8 +226,10 @@ def to_lightgbm_string(booster) -> str:
         tree = Tree(*[np.asarray(a)[t] for a in trees])
         # base score folds into the first iteration's trees (LightGBM rule)
         bias = float(booster.base_score[t % K]) if t < K else 0.0
+        mb = booster.binner_state.get("max_bin") or 0
         blocks.append(_tree_to_string(tree, np.asarray(booster.thr_raw[t]),
-                                      t, bias, 1.0))
+                                      t, bias, 1.0,
+                                      catchall_bin=mb - 1 if mb else -1))
     importances = booster.feature_importances("split")
     imp_lines = [f"Column_{i}={int(importances[i])}"
                  for i in np.argsort(-importances) if importances[i] > 0]

@@ -82,6 +82,9 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
                 out["adaptive"] = True; i += 1
             elif t == "--sgd":
                 out["adaptive"] = False; i += 1
+            elif t == "--bfgs":
+                # VW batch mode: full-batch L-BFGS, --passes bounds iterations
+                out["optimizer"] = "bfgs"; i += 1
             elif t == "--loss_function":
                 out["loss"] = val(); i += 2
             elif t == "--power_t":
@@ -124,7 +127,16 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
         ckpt_dir = self.get_or_default("checkpointDir")
         sw_time = StopWatch()
         with sw_time:
-            if ckpt_dir:
+            if cfg.optimizer == "bfgs":
+                if ckpt_dir:
+                    raise ValueError(
+                        "checkpointDir is not supported with --bfgs "
+                        "(batch iterations are cheap to rerun; step-level "
+                        "checkpointing covers the sgd path)")
+                from .sgd import train_bfgs
+                weights = train_bfgs(idx, val, y, sw, cfg,
+                                     initial_weights=init)
+            elif ckpt_dir:
                 from .sgd import train_sgd_checkpointed
                 weights = train_sgd_checkpointed(idx, val, y, sw, cfg,
                                                  ckpt_dir,
@@ -199,7 +211,12 @@ class VowpalWabbitClassificationModel(_VowpalWabbitModelBase,
                                       HasRawPredictionCol, HasProbabilityCol):
     def transform(self, dataset: Dataset) -> Dataset:
         margin = self._margin(dataset)
-        p1 = 1.0 / (1.0 + np.exp(-margin))
+        # stable sigmoid: exp only of non-positive args (BFGS-fit models can
+        # produce very large margins on separable data)
+        p1 = np.where(margin >= 0,
+                      1.0 / (1.0 + np.exp(-np.clip(margin, 0, None))),
+                      np.exp(np.clip(margin, None, 0))
+                      / (1.0 + np.exp(np.clip(margin, None, 0))))
         probs = np.stack([1 - p1, p1], axis=1)
         return dataset.with_columns({
             self.get_or_default("rawPredictionCol"): np.stack([-margin, margin], 1),

@@ -11,7 +11,11 @@ the replicas are psum-averaged at every pass end — the same
 sync-at-pass-boundary semantics as VW AllReduce, over ICI instead of sockets.
 
 Adaptive (AdaGrad) and normalized updates mirror VW's ``--adaptive``
-``--normalized`` flags; plain SGD when both off.
+``--normalized`` flags; plain SGD when both off. ``--bfgs`` switches to a
+full-batch L-BFGS (two-loop recursion, Armijo backtracking) whose gradient
+is one psum over the mesh per iteration — the batch-mode counterpart the
+reference exposes through VW's own --bfgs passthrough
+(vw/VowpalWabbitBase.scala passThroughArgs).
 """
 
 from __future__ import annotations
@@ -40,6 +44,7 @@ class SGDConfig(NamedTuple):
     batch_size: int = 128
     quantile_tau: float = 0.5
     link: str = "identity"
+    optimizer: str = "sgd"        # sgd | bfgs (VW --bfgs)
 
 
 from collections import OrderedDict
@@ -62,6 +67,151 @@ def _loss_grad(loss: str, pred, y, tau: float):
         d = pred - y
         return jnp.where(d >= 0, 1.0 - tau, -tau)
     raise ValueError(f"unknown loss {loss!r}")
+
+
+def _loss_value(loss: str, pred, y, tau: float):
+    """Pointwise loss values (L-BFGS needs objectives, not just gradients)."""
+    if loss == "squared":
+        return 0.5 * (pred - y) ** 2
+    if loss == "logistic":
+        # y in {0,1}: log(1 + exp(-s*pred)) with s = ±1, stable form
+        s = 2.0 * y - 1.0
+        return jax.nn.softplus(-s * pred)
+    if loss == "hinge":
+        s = 2.0 * y - 1.0
+        return jnp.maximum(0.0, 1.0 - s * pred)
+    if loss == "quantile":
+        d = pred - y
+        return jnp.where(d >= 0, (1.0 - tau) * d, -tau * d)
+    raise ValueError(f"unknown loss {loss!r}")
+
+
+def train_bfgs(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
+               sample_weight: Optional[np.ndarray], cfg: SGDConfig,
+               mesh: Optional[Mesh] = None,
+               initial_weights: Optional[np.ndarray] = None,
+               history: int = 10) -> np.ndarray:
+    """VW ``--bfgs`` parity: full-batch L-BFGS over the hashed linear model.
+
+    Each iteration computes the global objective/gradient with one psum over
+    the mesh ``data`` axis (rows sharded, weights replicated), updates the
+    [m, D] curvature history, and line-searches with Armijo backtracking —
+    all inside a single jitted shard_map program (``num_passes`` iterations,
+    matching VW where --passes bounds BFGS iterations). L2 regularizes the
+    objective; L1 applies as the same truncate-at-end used by the SGD path.
+    """
+    mesh = mesh or meshlib.get_default_mesh()
+    D = 1 << cfg.num_bits
+    nnz = indices.shape[1]
+    w0 = (np.zeros(D, np.float32) if initial_weights is None
+          else np.asarray(initial_weights, np.float32))
+    idx_d, val_d, y_d, sw_d = _prep_sgd_data(
+        indices, values, labels, sample_weight, cfg, mesh)
+    m = int(history)
+    iters = max(int(cfg.num_passes), 1)
+
+    def local(idx, val, y, sw, w):
+        wsum = lax.psum(jnp.sum(sw), "data")
+
+        def obj_grad(w):
+            pred = jnp.sum(w[idx] * val, axis=1)
+            lv = _loss_value(cfg.loss, pred, y, cfg.quantile_tau)
+            gp = _loss_grad(cfg.loss, pred, y, cfg.quantile_tau) * sw
+            loss = lax.psum(jnp.sum(lv * sw), "data") / wsum
+            grad = jnp.zeros(D, jnp.float32).at[idx.reshape(-1)].add(
+                (gp[:, None] * val).reshape(-1))
+            grad = lax.psum(grad, "data") / wsum
+            if cfg.l2 > 0:
+                loss = loss + 0.5 * cfg.l2 * jnp.sum(w * w)
+                grad = grad + cfg.l2 * w
+            return loss, grad
+
+        def two_loop(grad, S, Y, rho, k):
+            """L-BFGS direction from the curvature history (ring buffer)."""
+            def bwd(i, carry):
+                q, alphas = carry
+                j = (k - 1 - i) % m
+                valid = i < jnp.minimum(k, m)
+                a = jnp.where(valid, rho[j] * jnp.dot(S[j], q), 0.0)
+                q = q - a * Y[j] * valid
+                return q, alphas.at[j].set(a)
+
+            q, alphas = lax.fori_loop(0, m, bwd,
+                                      (grad, jnp.zeros(m, jnp.float32)))
+            j_last = (k - 1) % m
+            sy = jnp.dot(S[j_last], Y[j_last])
+            yy = jnp.dot(Y[j_last], Y[j_last])
+            gamma = jnp.where((k > 0) & (yy > 0), sy / (yy + 1e-12), 1.0)
+            r = gamma * q
+
+            def fwd(i, r):
+                j = (k - jnp.minimum(k, m) + i) % m
+                valid = i < jnp.minimum(k, m)
+                b = jnp.where(valid, rho[j] * jnp.dot(Y[j], r), 0.0)
+                return r + (alphas[j] - b) * S[j] * valid
+
+            return lax.fori_loop(0, m, fwd, r)
+
+        def iteration(carry, _):
+            w, f, g, S, Y, rho, k = carry
+            d = -two_loop(g, S, Y, rho, k)
+            gtd = jnp.dot(g, d)
+            # fall back to steepest descent if the direction lost descent
+            use_sd = gtd >= 0
+            d = jnp.where(use_sd, -g, d)
+            gtd = jnp.where(use_sd, -jnp.dot(g, g), gtd)
+
+            def ls_cond(st):
+                step, tries, fnew, _, _ = st
+                # NOT(sufficient decrease): a NaN/inf trial objective keeps
+                # backtracking instead of being accepted (NaN > x is False)
+                return ~(fnew <= f + 1e-4 * step * gtd) & (tries < 20)
+
+            def ls_body(st):
+                step, tries, _, _, _ = st
+                step = step * 0.5
+                fnew, gnew = obj_grad(w + step * d)
+                return step, tries + 1, fnew, gnew, w + step * d
+
+            f1, g1 = obj_grad(w + d)
+            step, _, fnew, gnew, wnew = lax.while_loop(
+                ls_cond, ls_body, (jnp.float32(1.0), jnp.int32(0), f1, g1,
+                                   w + d))
+            s_vec = wnew - w
+            y_vec = gnew - g
+            sy = jnp.dot(s_vec, y_vec)
+            ok = sy > 1e-10                     # curvature condition
+            j = k % m
+            S = jnp.where(ok, S.at[j].set(s_vec), S)
+            Y = jnp.where(ok, Y.at[j].set(y_vec), Y)
+            rho = jnp.where(ok, rho.at[j].set(1.0 / (sy + 1e-12)), rho)
+            k = k + ok.astype(jnp.int32)
+            return (wnew, fnew, gnew, S, Y, rho, k), fnew
+
+        f0, g0 = obj_grad(w)
+        init = (w, f0, g0,
+                jnp.zeros((m, D), jnp.float32), jnp.zeros((m, D), jnp.float32),
+                jnp.zeros(m, jnp.float32), jnp.int32(0))
+        (w, f, g, *_), _ = lax.scan(iteration, init, None, length=iters)
+        if cfg.l1 > 0:
+            w = jnp.sign(w) * jnp.maximum(jnp.abs(w) - cfg.l1, 0.0)
+        return w
+
+    cache_key = ("bfgs", cfg, nnz, D, m, tuple(mesh.axis_names),
+                 tuple(d.id for d in mesh.devices.flat))
+    fn = _SGD_FN_CACHE.get(cache_key)
+    if fn is None:
+        fn = jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P("data", None), P("data", None), P("data"), P("data"),
+                      P()),
+            out_specs=P(), check_vma=False))
+        _SGD_FN_CACHE[cache_key] = fn
+        while len(_SGD_FN_CACHE) > _SGD_FN_CACHE_MAX:
+            _SGD_FN_CACHE.popitem(last=False)
+    else:
+        _SGD_FN_CACHE.move_to_end(cache_key)
+    return np.asarray(fn(idx_d, val_d, y_d, sw_d, jnp.asarray(w0)))
 
 
 def _prep_sgd_data(indices: np.ndarray, values: np.ndarray,

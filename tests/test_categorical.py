@@ -131,3 +131,21 @@ def test_csr_through_estimator():
     pred = model.transform(Dataset({"features": X, "label": y}))
     rmse = float(np.sqrt(np.mean((pred.array("prediction") - y) ** 2)))
     assert rmse < 0.4, rmse
+
+
+def test_csr_through_ranker():
+    import scipy.sparse as sp
+    from mmlspark_tpu.models.gbdt.api import LightGBMRanker
+
+    rng = np.random.default_rng(2)
+    n_groups, per = 30, 8
+    X = rng.normal(size=(n_groups * per, 5)).astype(np.float32)
+    rel = (X[:, 0] > 0.3).astype(np.float32) + (X[:, 1] > 0.5)
+    group = np.repeat(np.arange(n_groups), per)
+    ds = Dataset({"features": sp.csr_matrix(X), "label": rel,
+                  "group": group})
+    model = LightGBMRanker(numIterations=4, numLeaves=7, minDataInLeaf=3,
+                           maxBin=31, groupCol="group").fit(ds)
+    out = model.transform(Dataset({"features": X, "label": rel,
+                                   "group": group}))
+    assert np.isfinite(out.array("prediction")).all()

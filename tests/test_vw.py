@@ -162,3 +162,58 @@ class TestVWLearners:
         a = np.asarray(model.transform(ds)["prediction"])
         b = np.asarray(loaded.transform(ds)["prediction"])
         assert np.all(a == b)
+
+
+class TestBFGS:
+    """VW --bfgs parity (vw/VowpalWabbitBase.scala passThroughArgs)."""
+
+    def test_bfgs_regressor_beats_few_pass_sgd(self):
+        rng = np.random.default_rng(0)
+        n, d = 800, 6
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        beta = np.array([1.5, -2.0, 0.7, 0.0, 0.3, -1.0], np.float32)
+        y = X @ beta + 0.05 * rng.normal(size=n).astype(np.float32)
+        ds = Dataset({"features": [row for row in X], "label": y})
+        feat = VowpalWabbitFeaturizer(inputCols=["features"],
+                                      outputCol="features")
+        dsf = feat.transform(ds)
+
+        bfgs = VowpalWabbitRegressor(
+            numBits=12, passThroughArgs="--bfgs --passes 30").fit(dsf)
+        pred = bfgs.transform(dsf).array("prediction")
+        rmse_bfgs = float(np.sqrt(np.mean((pred - y) ** 2)))
+        assert rmse_bfgs < 0.2, rmse_bfgs
+
+        sgd1 = VowpalWabbitRegressor(numBits=12, numPasses=1).fit(dsf)
+        rmse_sgd = float(np.sqrt(np.mean(
+            (sgd1.transform(dsf).array("prediction") - y) ** 2)))
+        assert rmse_bfgs < rmse_sgd, (rmse_bfgs, rmse_sgd)
+
+    def test_bfgs_classifier(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(600, 4)).astype(np.float32)
+        y = (X[:, 0] - X[:, 1] > 0).astype(np.float32)
+        ds = Dataset({"features": [row for row in X], "label": y})
+        dsf = VowpalWabbitFeaturizer(inputCols=["features"],
+                                     outputCol="features").transform(ds)
+        clf = VowpalWabbitClassifier(
+            numBits=12, passThroughArgs="--bfgs --passes 25 "
+            "--loss_function logistic").fit(dsf)
+        acc = (clf.transform(dsf).array("prediction") == y).mean()
+        assert acc > 0.97, acc
+
+    def test_bfgs_l2_shrinks_weights(self):
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(300, 4)).astype(np.float32)
+        y = (X @ np.array([1.0, 1.0, 0.0, 0.0], np.float32))
+        ds = Dataset({"features": [row for row in X], "label": y})
+        dsf = VowpalWabbitFeaturizer(inputCols=["features"],
+                                     outputCol="features").transform(ds)
+        w_free = VowpalWabbitRegressor(
+            numBits=10, passThroughArgs="--bfgs --passes 20").fit(dsf)
+        w_reg = VowpalWabbitRegressor(
+            numBits=10,
+            passThroughArgs="--bfgs --passes 20 --l2 1.0").fit(dsf)
+        n_free = float(np.abs(w_free.weights).sum())
+        n_reg = float(np.abs(w_reg.weights).sum())
+        assert n_reg < n_free, (n_reg, n_free)
