@@ -190,9 +190,12 @@ class GatewayServer:
 
     # -- routing -------------------------------------------------------------
     def _live_workers(self) -> List[WorkerInfo]:
+        # registry scan (filesystem I/O for file-backed registries) stays
+        # OUTSIDE the routing lock; only the dead-map lookup needs it
+        workers = self.registry.workers()
         now = time.monotonic()
         with self._lock:
-            return [w for w in self.registry.workers()
+            return [w for w in workers
                     if self._dead.get(w.worker_id, 0) < now]
 
     def _pick(self, exclude=()) -> Optional[WorkerInfo]:
@@ -230,9 +233,10 @@ class GatewayServer:
                 conn.close()
                 self.forwarded += 1
                 return resp.status, payload, headers
-            except OSError:
-                # connection-level failure: the worker is gone — mark dead
-                # until a health sweep readmits it, retry on another worker
+            except (OSError, http.client.HTTPException):
+                # connection-level failure OR a worker dying mid-response
+                # (BadStatusLine/IncompleteRead): mark dead until a health
+                # sweep readmits it, retry on another worker
                 with self._lock:
                     self._dead[w.worker_id] = (time.monotonic()
                                                + 10 * self.health_interval)
@@ -248,8 +252,10 @@ class GatewayServer:
         while not self._stop.wait(self.health_interval):
             now = time.monotonic()
             with self._lock:
+                # probe EVERY still-blacklisted worker: a recovered worker
+                # readmits at the next sweep, not after the TTL lapses
                 dead = [wid for wid, until in self._dead.items()
-                        if until < now + self.health_interval]
+                        if until >= now]
             for w in self.registry.workers():
                 if w.worker_id not in dead:
                     continue

@@ -38,10 +38,19 @@ from jax.experimental import pallas as pl
 _ONEHOT_BUDGET = 64 * 1024 * 1024
 
 
+def _interpret_mode() -> bool:
+    import os
+    return bool(os.environ.get("MMLSPARK_TPU_PALLAS_INTERPRET"))
+
+
 def _use_pallas() -> bool:
     import os
     if os.environ.get("MMLSPARK_TPU_DISABLE_PALLAS_HIST"):
         return False
+    if _interpret_mode():
+        # CI leg: run the real kernel logic through the Pallas interpreter
+        # on CPU so packing/layout bugs surface without TPU hardware
+        return True
     try:
         # device_kind, not just jax.default_backend(): TPU PJRT plugins may
         # register under a different platform name (e.g. a tunneled plugin)
@@ -204,34 +213,81 @@ _PALLAS_VMEM_BUDGET = 10 * 1024 * 1024   # headroom under the 16 MB scoped
 # 16.15 MB scoped allocation at S=96)
 
 
+def _bin_packing(B: int):
+    """(BP, P): per-feature lane width and features packed per 128-lane dot.
+
+    Small-bin configs (LightGBM's own GPU guidance recommends max_bin=63 on
+    accelerators) would otherwise pad to 128 lanes and waste the MXU: with
+    B <= 64 the kernel packs P = 128//BP features' one-hots side by side in
+    one dot, cutting the unit-matmul count by P.
+    """
+    if B <= 64:
+        BP = 1 << max(int(B - 1).bit_length(), 3)   # pow2, >= 8
+        return BP, 128 // BP
+    return -(-B // 128) * 128, 1
+
+
 def _pick_row_block(n: int, F: int, S: int, B: int, fused_w: int = 0) -> int:
     """Largest row-block size whose resident VMEM fits the budget.
 
     VMEM model (matches the kernels): input blocks are double-buffered across
-    grid steps (binned [F, RB] int32 and stats [Sp, RB] bf16 — or, fused,
-    [8, RB] f32 base + [1, RB] i32 positions); the [F, Sp, BP] f32 accumulator
-    stays resident; kernel scratch is the per-feature one-hot [RB, BP] bf16
-    plus, fused, the rebuilt [W, 3, RB] + [Sp, RB] masked stats.
+    grid steps (binned [Fp, RB] int32 and stats [Sp, RB] bf16 — or, fused,
+    [8, RB] f32 base + [1, RB] i32 positions); the [Fp, Sp, BP] f32
+    accumulator stays resident; kernel scratch is the packed one-hot
+    [RB, max(BP,128)] bf16 plus, fused, the rebuilt [W, 3, RB] + [Sp, RB]
+    masked stats.
     """
-    BP = -(-B // 128) * 128
+    BP, P = _bin_packing(B)
+    Fp = -(-F // P) * P
     Sp = -(-max(S, 1) // 16) * 16
     for RB in (8192, 4096, 2048, 1024, 512):
         if RB > max(512, n):
             continue  # don't pad a small input up to a huge block
-        binned_block = F * RB * 4
+        binned_block = Fp * RB * 4
         if fused_w:
             in_blocks = binned_block + RB * 4 + 8 * RB * 4
-            scratch = RB * BP * 2 + 2 * (fused_w * 3 * RB * 2) + Sp * RB * 2
+            scratch = (RB * max(BP, 128) * 2
+                       + 2 * (fused_w * 3 * RB * 2) + Sp * RB * 2)
         else:
             in_blocks = binned_block + Sp * RB * 2
-            scratch = RB * BP * 2
-        out_block = F * Sp * BP * 4
+            scratch = RB * max(BP, 128) * 2
+        out_block = Fp * Sp * BP * 4
         if 2 * in_blocks + out_block + scratch <= _PALLAS_VMEM_BUDGET:
             return RB
     return 0
 
 
-def _make_hist_kernel(F: int, BP: int):
+def _hist_dot_accumulate(o_ref, b_ref, sb, Fp: int, BP: int, P: int):
+    """Shared inner loop: per step, pack P features' one-hots into one
+    128-lane dot with the [Sp, RB] stats and accumulate the [Sp, BP] slices
+    into their o_ref rows."""
+    RB = sb.shape[1]
+
+    def body(g, _):
+        if P == 1:
+            row = b_ref[g, :]                       # [RB] int32
+            bins = lax.broadcasted_iota(jnp.int32, (RB, BP), 1)
+            oh = (row[:, None] == bins).astype(sb.dtype)
+            h = lax.dot_general(sb, oh, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            o_ref[g] += h
+        else:
+            pieces = []
+            for p in range(P):
+                row = b_ref[g * P + p, :]
+                bins = lax.broadcasted_iota(jnp.int32, (RB, BP), 1)
+                pieces.append((row[:, None] == bins).astype(sb.dtype))
+            oh = jnp.concatenate(pieces, axis=1)    # [RB, P*BP] = 128 lanes
+            h = lax.dot_general(sb, oh, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            for p in range(P):
+                o_ref[g * P + p] += h[:, p * BP:(p + 1) * BP]
+        return 0
+
+    lax.fori_loop(0, Fp // P, body, 0)
+
+
+def _make_hist_kernel(Fp: int, BP: int, P: int):
     def kernel(b_ref, s_ref, o_ref):
         j = pl.program_id(0)
         sb = s_ref[:, :]                            # [Sp, RB] bf16
@@ -240,22 +296,12 @@ def _make_hist_kernel(F: int, BP: int):
         def _():
             o_ref[...] = jnp.zeros_like(o_ref)
 
-        def body(f, _):
-            # sequential features: exactly one [RB, BP] one-hot live in VMEM
-            row = b_ref[f, :]                       # [RB] int32
-            bins = lax.broadcasted_iota(jnp.int32, (row.shape[0], BP), 1)
-            oh = (row[:, None] == bins).astype(sb.dtype)  # VMEM-only
-            h = lax.dot_general(sb, oh, (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [Sp, BP]
-            o_ref[f] += h
-            return 0
-
-        lax.fori_loop(0, F, body, 0)
+        _hist_dot_accumulate(o_ref, b_ref, sb, Fp, BP, P)
 
     return kernel
 
 
-def _make_node_hist_kernel(F: int, W: int, Sp: int, BP: int):
+def _make_node_hist_kernel(Fp: int, W: int, Sp: int, BP: int, P: int):
     def kernel(b_ref, p_ref, base_ref, o_ref):
         j = pl.program_id(0)
         pos = p_ref[0, :]                           # [RB] int32
@@ -271,16 +317,7 @@ def _make_node_hist_kernel(F: int, W: int, Sp: int, BP: int):
         def _():
             o_ref[...] = jnp.zeros_like(o_ref)
 
-        def body(f, _):
-            row = b_ref[f, :]                       # [RB] int32
-            bins = lax.broadcasted_iota(jnp.int32, (row.shape[0], BP), 1)
-            oh = (row[:, None] == bins).astype(sb.dtype)  # VMEM-only
-            h = lax.dot_general(sb, oh, (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [Sp, BP]
-            o_ref[f] += h
-            return 0
-
-        lax.fori_loop(0, F, body, 0)
+        _hist_dot_accumulate(o_ref, b_ref, sb, Fp, BP, P)
 
     return kernel
 
@@ -293,44 +330,56 @@ def _pad_rows_to(x, n_pad, fill=0):
     return jnp.pad(x, width, constant_values=fill)
 
 
+def _pad_features_to(binned_t, Fp):
+    F = binned_t.shape[0]
+    if Fp == F:
+        return binned_t
+    # padding features bin everything to 0; their histogram rows are sliced
+    # off the output
+    return jnp.pad(binned_t, ((0, Fp - F), (0, 0)), constant_values=0)
+
+
 def _hist_pallas(binned_t: jnp.ndarray, stats_t: jnp.ndarray,
                  num_bins: int) -> jnp.ndarray:
     F, n = binned_t.shape
     S = stats_t.shape[0]
     B = int(num_bins)
-    BP = -(-B // 128) * 128                        # pad bins to lane multiple
+    BP, P = _bin_packing(B)
+    Fp = -(-F // P) * P
     Sp = -(-S // 16) * 16                          # pad stats to sublane tile
     RB = _pick_row_block(n, F, S, B)
     n_pad = -(-max(n, RB) // RB) * RB
     # zero stats on padding rows: they contribute nothing to any bin
-    binned_t = _pad_rows_to(binned_t, n_pad)
+    binned_t = _pad_features_to(_pad_rows_to(binned_t, n_pad), Fp)
     stats_t = _pad_rows_to(stats_t, n_pad)
     if Sp != S:
         stats_t = jnp.pad(stats_t, ((0, Sp - S), (0, 0)))
     nb = n_pad // RB
 
     out = pl.pallas_call(
-        _make_hist_kernel(F, BP),
+        _make_hist_kernel(Fp, BP, P),
         grid=(nb,),
         in_specs=[
-            pl.BlockSpec((F, RB), lambda j: (0, j)),
+            pl.BlockSpec((Fp, RB), lambda j: (0, j)),
             pl.BlockSpec((Sp, RB), lambda j: (0, j)),
         ],
-        out_specs=pl.BlockSpec((F, Sp, BP), lambda j: (0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((F, Sp, BP), jnp.float32),
+        out_specs=pl.BlockSpec((Fp, Sp, BP), lambda j: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((Fp, Sp, BP), jnp.float32),
+        interpret=_interpret_mode(),
     )(binned_t, stats_t)
-    return out[:, :S, :B]
+    return out[:F, :S, :B]
 
 
 def _node_hist_pallas(binned_t: jnp.ndarray, row_pos: jnp.ndarray,
                       base_t: jnp.ndarray, W: int, B: int) -> jnp.ndarray:
     F, n = binned_t.shape
     S = 3 * W
-    BP = -(-B // 128) * 128
+    BP, P = _bin_packing(B)
+    Fp = -(-F // P) * P
     Sp = -(-S // 16) * 16
     RB = _pick_row_block(n, F, S, B, fused_w=W)
     n_pad = -(-max(n, RB) // RB) * RB
-    binned_t = _pad_rows_to(binned_t, n_pad)
+    binned_t = _pad_features_to(_pad_rows_to(binned_t, n_pad), Fp)
     # padding rows: position -1 matches no frontier node -> contribute nothing
     row_pos = _pad_rows_to(row_pos, n_pad, fill=-1)[None, :]
     # base rides f32 [8, n] (sublane-aligned); rows 3..7 are dead padding
@@ -339,14 +388,15 @@ def _node_hist_pallas(binned_t: jnp.ndarray, row_pos: jnp.ndarray,
     nb = n_pad // RB
 
     out = pl.pallas_call(
-        _make_node_hist_kernel(F, W, Sp, BP),
+        _make_node_hist_kernel(Fp, W, Sp, BP, P),
         grid=(nb,),
         in_specs=[
-            pl.BlockSpec((F, RB), lambda j: (0, j)),
+            pl.BlockSpec((Fp, RB), lambda j: (0, j)),
             pl.BlockSpec((1, RB), lambda j: (0, j)),
             pl.BlockSpec((8, RB), lambda j: (0, j)),
         ],
-        out_specs=pl.BlockSpec((F, Sp, BP), lambda j: (0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((F, Sp, BP), jnp.float32),
+        out_specs=pl.BlockSpec((Fp, Sp, BP), lambda j: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((Fp, Sp, BP), jnp.float32),
+        interpret=_interpret_mode(),
     )(binned_t, row_pos, base8)
-    return out[:, :S, :B]
+    return out[:F, :S, :B]

@@ -91,3 +91,37 @@ def test_bin_cols_device_boundary_equality():
     dev = np.asarray(bin_cols_device(jnp.asarray(X), jnp.asarray(ub)))[0]
     host = np.searchsorted(ub[0], X[:, 0], side="left")
     np.testing.assert_array_equal(dev, host)
+
+
+class TestPallasInterpret:
+    """Run the REAL Pallas kernels through the interpreter on CPU so the
+    packed-feature layouts are validated without TPU hardware."""
+
+    @pytest.fixture(autouse=True)
+    def _interp(self, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_PALLAS_INTERPRET", "1")
+
+    @pytest.mark.parametrize("B", [255, 63, 31])   # P = 1, 2, 4
+    def test_kernel_matches_xla_fallback(self, B, monkeypatch):
+        rng = np.random.default_rng(0)
+        n, F, S = 1200, 5, 6
+        binned_t = jnp.asarray(
+            rng.integers(0, B, size=(F, n), dtype=np.int32))
+        stats_t = jnp.asarray(rng.normal(size=(S, n)).astype(np.float32))
+        got = np.asarray(histogram_cols(binned_t, stats_t, B))
+        monkeypatch.setenv("MMLSPARK_TPU_DISABLE_PALLAS_HIST", "1")
+        want = np.asarray(histogram_cols(binned_t, stats_t, B))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("B,W", [(255, 3), (63, 4), (31, 2)])
+    def test_node_kernel_matches_xla_fallback(self, B, W, monkeypatch):
+        rng = np.random.default_rng(1)
+        n, F = 1100, 6
+        binned_t = jnp.asarray(
+            rng.integers(0, B, size=(F, n), dtype=np.int32))
+        pos = jnp.asarray(rng.integers(-1, W, size=n).astype(np.int32))
+        base = jnp.asarray(rng.normal(size=(3, n)).astype(np.float32))
+        got = np.asarray(node_histogram(binned_t, pos, base, W, B))
+        monkeypatch.setenv("MMLSPARK_TPU_DISABLE_PALLAS_HIST", "1")
+        want = np.asarray(node_histogram(binned_t, pos, base, W, B))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
