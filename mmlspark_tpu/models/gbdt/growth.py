@@ -73,6 +73,51 @@ def _soft_threshold(g, l1):
     return jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
 
 
+def _feature_best_gains(hist, fm, cfg):
+    """[F] best LOCAL split gain per feature from a local [F, 3, B]
+    histogram (node totals taken from the local histogram itself) — the
+    per-shard vote of voting_parallel."""
+    B = hist.shape[-1]
+    gl = jnp.cumsum(hist[:, 0, :], axis=-1)
+    hl = jnp.cumsum(hist[:, 1, :], axis=-1)
+    cl = jnp.cumsum(hist[:, 2, :], axis=-1)
+    tg, th, tc = gl[:, -1:], hl[:, -1:], cl[:, -1:]
+    gr, hr, cr = tg - gl, th - hl, tc - cl
+    gain = (_leaf_objective(gl, hl, cfg) + _leaf_objective(gr, hr, cfg)
+            - _leaf_objective(tg, th, cfg))
+    ok = ((cl >= cfg.min_data_in_leaf) & (cr >= cfg.min_data_in_leaf)
+          & (hl >= cfg.min_sum_hessian_in_leaf)
+          & (hr >= cfg.min_sum_hessian_in_leaf) & fm[:, None])
+    ok = ok.at[:, B - 1].set(False)
+    return jnp.max(jnp.where(ok, gain, NEG_INF), axis=-1)
+
+
+def _voting_select(h, feat_mask, cfg, axis_name, W):
+    """voting_parallel feature selection (LightGBMParams.scala:13-27):
+    each shard votes its top_k features by best local gain (max over the
+    W frontier nodes), votes are psum'd, and only the global top-2k
+    features' histograms are all-reduced — scattered back into a zeroed
+    full array so downstream split search keeps static shapes.
+    Returns (h_global, selected_mask)."""
+    F, _, B = h.shape
+    hw = h.reshape(F, W, 3, B).transpose(1, 0, 2, 3)          # [W, F, 3, B]
+    g = jnp.max(jax.vmap(_feature_best_gains, in_axes=(0, None, None))(
+        hw, feat_mask, cfg), axis=0)                           # [F]
+    k = min(int(cfg.top_k), F)
+    top_g, local_top = lax.top_k(g, k)
+    # a shard with no locally-feasible split (all NEG_INF — common for
+    # small nodes at deep levels) must not cast junk votes for the
+    # arbitrary indices top_k returns
+    ballots = (top_g > NEG_INF).astype(jnp.float32)
+    votes = lax.psum(jnp.zeros(F).at[local_top].add(ballots), axis_name)
+    # deterministic tie-break toward low feature index on every shard
+    _, sel = lax.top_k(votes - jnp.arange(F) * 1e-6, min(2 * k, F))
+    sel = jnp.sort(sel)
+    hsel = lax.psum(h[sel], axis_name)
+    hfull = jnp.zeros_like(h).at[sel].set(hsel)
+    return hfull, jnp.zeros(F, dtype=bool).at[sel].set(True)
+
+
 def _leaf_objective(g, h, cfg):
     sg = _soft_threshold(g, cfg.lambda_l1)
     return sg * sg / (h + cfg.lambda_l2 + 1e-38)
@@ -201,21 +246,6 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     vm = valid.astype(jnp.float32)
     base_t = jnp.stack([grad * vm, hess * vm, vm], axis=0)   # [3, n]
 
-    def _feature_best_gains(hist, fm):
-        """[F] best local split gain per feature (for the voting step)."""
-        gl = jnp.cumsum(hist[:, 0, :], axis=-1)
-        hl = jnp.cumsum(hist[:, 1, :], axis=-1)
-        cl = jnp.cumsum(hist[:, 2, :], axis=-1)
-        tg, th, tc = gl[:, -1:], hl[:, -1:], cl[:, -1:]
-        gr, hr, cr = tg - gl, th - hl, tc - cl
-        gain = (_leaf_objective(gl, hl, cfg) + _leaf_objective(gr, hr, cfg)
-                - _leaf_objective(tg, th, cfg))
-        ok = ((cl >= cfg.min_data_in_leaf) & (cr >= cfg.min_data_in_leaf)
-              & (hl >= cfg.min_sum_hessian_in_leaf)
-              & (hr >= cfg.min_sum_hessian_in_leaf) & fm[:, None])
-        ok = ok.at[:, B - 1].set(False)
-        return jnp.max(jnp.where(ok, gain, NEG_INF), axis=-1)
-
     def all_hist(row_pos, W):
         """Global per-node histogram [F, W*3, B] + selected-feature mask.
 
@@ -228,18 +258,7 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             return h, jnp.ones(F, dtype=bool)
         if not cfg.voting:
             return lax.psum(h, axis_name), jnp.ones(F, dtype=bool)
-        gains = _feature_best_gains(h[:, 0:3], feat_mask)
-        if W == 2:
-            gains = jnp.maximum(gains, _feature_best_gains(h[:, 3:6], feat_mask))
-        k = min(int(cfg.top_k), F)
-        _, local_top = lax.top_k(gains, k)
-        votes = lax.psum(jnp.zeros(F).at[local_top].add(1.0), axis_name)
-        # deterministic tie-break toward low feature index on every shard
-        _, sel = lax.top_k(votes - jnp.arange(F) * 1e-6, min(2 * k, F))
-        sel = jnp.sort(sel)
-        hsel = lax.psum(h[sel], axis_name)
-        hfull = jnp.zeros_like(h).at[sel].set(hsel)
-        return hfull, jnp.zeros(F, dtype=bool).at[sel].set(True)
+        return _voting_select(h, feat_mask, cfg, axis_name, W)
 
     root_hist, sel0 = all_hist(jnp.zeros(n, dtype=jnp.int32), 1)
     # totals from the raw stats (not the histogram: under voting_parallel an
@@ -363,9 +382,6 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
     level's candidate splits by gain. Same Tree layout / slot allocation
     discipline as ``grow_tree`` (slot ids in allocation order).
     """
-    if cfg.voting:
-        raise NotImplementedError(
-            "voting_parallel requires leafwise growth (growthPolicy)")
     F, n = binned_t.shape
     L = int(cfg.num_leaves)
     M = 2 * L - 1
@@ -421,8 +437,17 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
             # one fused histogram pass covers the whole level: the
             # row->position one-hot and masked stats are built in VMEM
             h = node_histogram(binned_t, row_pos, base_t, W, B)  # [F, W*3, B]
+            feat_mask_lvl = feat_mask
             if axis_name is not None:
-                h = lax.psum(h, axis_name)
+                if cfg.voting:
+                    # per-level voting: shards vote top_k features by their
+                    # best local gain across the WHOLE frontier, then only
+                    # the global top-2k features' level histograms cross
+                    # the interconnect
+                    h, sel = _voting_select(h, feat_mask, cfg, axis_name, W)
+                    feat_mask_lvl = feat_mask & sel
+                else:
+                    h = lax.psum(h, axis_name)
             h = h.reshape(F, W, 3, B).transpose(1, 0, 2, 3)      # [W, F, 3, B]
 
             tot = jnp.stack([tree_arrays["ng"][jnp.maximum(fr, 0)],
@@ -433,8 +458,8 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
             allow = active & jnp.bool_(cfg.max_depth < 0
                                        or depth + 1 <= cfg.max_depth)
             gains, feats, bins_, lgs, lhs, lcs, bits_w = vsplit(
-                h, tot[:, 0], tot[:, 1], tot[:, 2], cfg, feat_mask, allow,
-                is_cat)
+                h, tot[:, 0], tot[:, 1], tot[:, 2], cfg, feat_mask_lvl,
+                allow, is_cat)
             gains = jnp.where(active, gains, NEG_INF)
 
             # budget: leaves + #splits <= num_leaves — best gains first

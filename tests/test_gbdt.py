@@ -405,12 +405,21 @@ class TestParallelModes:
         nodes = int(model.booster.trees.node_count[0])
         assert model.booster.trees.is_leaf[0][:nodes].sum() <= 31
 
-    def test_depthwise_voting_rejected(self):
-        Xtr, _, ytr, _ = _binary_data()
-        with pytest.raises(NotImplementedError):
-            LightGBMClassifier(numIterations=2, growthPolicy="depthwise",
-                               parallelism="voting_parallel").fit(
+    def test_depthwise_voting_matches_quality(self):
+        """Per-level voting_parallel (two small collectives per level
+        instead of the full [F, W*3, B] psum) stays within quality noise
+        of full data_parallel depthwise growth."""
+        Xtr, Xte, ytr, yte = _binary_data()
+        accs = {}
+        for par in ("data_parallel", "voting_parallel"):
+            m = LightGBMClassifier(numIterations=15, numLeaves=15,
+                                   minDataInLeaf=5,
+                                   growthPolicy="depthwise",
+                                   parallelism=par, topK=5).fit(
                 _to_ds(Xtr, ytr))
+            out = m.transform(_to_ds(Xte, yte))
+            accs[par] = (out.array("prediction") == yte).mean()
+        assert accs["voting_parallel"] >= accs["data_parallel"] - 0.05, accs
 
 
 class TestBoostingTypes:
