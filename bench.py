@@ -117,6 +117,9 @@ def main() -> None:
     leafwise_tps = _rate(cfg_over=dict(growth_policy="leafwise"))
     # train_booster derives cfg.num_bins from max_bin itself
     maxbin63_tps = _rate(max_bin=63)
+    # int8 quantized-gradient histograms (2x-rate MXU path) at both widths
+    quant_tps = _rate(cfg_over=dict(quantized_grad=True))
+    quant63_tps = _rate(max_bin=63, cfg_over=dict(quantized_grad=True))
 
     # sanity: the model must actually learn this signal
     acc = ((booster.predict(X[:100_000]) > 0.5) == y[:100_000]).mean()
@@ -133,6 +136,8 @@ def main() -> None:
         "platform": "tpu" if on_tpu else "cpu-fallback",
         "leafwise_trees_per_sec": leafwise_tps,
         "maxbin63_trees_per_sec": maxbin63_tps,
+        "quantized_trees_per_sec": quant_tps,
+        "quantized_maxbin63_trees_per_sec": quant63_tps,
         # secondary headline (BASELINE.json config 3): ResNet-50 featurizer
         # throughput; no absolute reference anchor is published, so the raw
         # number is reported without a vs_ ratio

@@ -123,6 +123,10 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
         "categorical (values are category ids; splits are LightGBM "
         "sorted-subset bitsets — reference: LightGBMParams "
         "categoricalSlotIndexes, core/schema/Categoricals.scala)", None)
+    useQuantizedGrad = Param(
+        "useQuantizedGrad", "Quantized-gradient histograms (LightGBM "
+        "use_quantized_grad): int8 grad/hess with stochastic rounding ride "
+        "the 2x-rate int8 MXU path", False, TypeConverters.to_bool)
     categoricalSlotNames = Param(
         "categoricalSlotNames", "Categorical slots by feature name; requires "
         "a featuresCol with slot names (use categoricalSlotIndexes for "
@@ -142,6 +146,7 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
             voting=self.get_or_default("parallelism") == "voting_parallel",
             top_k=self.get_or_default("topK"),
             growth_policy=self.get_or_default("growthPolicy"),
+            quantized_grad=self.get_or_default("useQuantizedGrad"),
         )
 
     def _extract_arrays(self, dataset: Dataset):

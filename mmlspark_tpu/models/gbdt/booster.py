@@ -703,7 +703,9 @@ def train_booster(
         for k in range(K):
             tree, row_node = grow(binned_t, grad[:, k], hess[:, k], row_mask,
                                   fmask, cfg, axis_name="data",
-                                  is_cat=is_cat_j)
+                                  is_cat=is_cat_j,
+                                  qkey=(jax.random.fold_in(key, 13 + k)
+                                        if cfg.quantized_grad else None))
             if not is_rf:
                 # rf: trees are independent (gradients stay at the base
                 # score); gbdt/goss: boost on the updated margin
@@ -964,7 +966,9 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
         for k in range(K):
             tree, row_node = grow(binned_t, grad[:, k], hess[:, k], row_mask,
                                   fmask, cfg, axis_name="data",
-                                  is_cat=is_cat_j)
+                                  is_cat=is_cat_j,
+                                  qkey=(jax.random.fold_in(key, 13 + k)
+                                        if cfg.quantized_grad else None))
             new_contrib.append(tree.leaf_value[row_node])
             trees_out.append(tree)
         nc = jnp.stack(new_contrib, axis=1)                # [n_local, K]

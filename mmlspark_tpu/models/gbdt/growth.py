@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ...ops.histogram import node_histogram
+from ...ops.histogram import node_histogram, quantize_stats
 
 NEG_INF = jnp.float32(-jnp.inf)
 
@@ -67,6 +67,10 @@ class GrowConfig(NamedTuple):
     # (LightGBM's sorted-subset search); the chosen subset is a bitset.
     cat_smooth: float = 10.0
     max_cat_threshold: int = 32
+    # quantized-gradient histograms (LightGBM use_quantized_grad): grad/hess
+    # quantize to int8 per tree (stochastic rounding) and histograms ride
+    # the 2x-rate int8 MXU path with exact int32 accumulation.
+    quantized_grad: bool = False
 
 
 def _soft_threshold(g, l1):
@@ -228,7 +232,7 @@ class Tree(NamedTuple):
 def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               valid: jnp.ndarray, feat_mask: jnp.ndarray, cfg: GrowConfig,
               axis_name: Optional[str] = None,
-              is_cat: Optional[jnp.ndarray] = None):
+              is_cat: Optional[jnp.ndarray] = None, qkey=None):
     """Grow one tree on (possibly sharded) rows.
 
     binned_t: [F, n] int32 (column-major); grad/hess: [n] f32; valid: [n] f32
@@ -245,6 +249,9 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     vm = valid.astype(jnp.float32)
     base_t = jnp.stack([grad * vm, hess * vm, vm], axis=0)   # [3, n]
+    qscales = None
+    if cfg.quantized_grad:
+        base_t, qscales = quantize_stats(base_t, qkey)
 
     def all_hist(row_pos, W):
         """Global per-node histogram [F, W*3, B] + selected-feature mask.
@@ -253,7 +260,7 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         locally, psum the votes, psum only the global top-2k features'
         histograms (scattered back into a zeroed full array so downstream
         split search keeps static shapes; unselected features are masked)."""
-        h = node_histogram(binned_t, row_pos, base_t, W, B)
+        h = node_histogram(binned_t, row_pos, base_t, W, B, scales=qscales)
         if axis_name is None:
             return h, jnp.ones(F, dtype=bool)
         if not cfg.voting:
@@ -262,8 +269,12 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     root_hist, sel0 = all_hist(jnp.zeros(n, dtype=jnp.int32), 1)
     # totals from the raw stats (not the histogram: under voting_parallel an
-    # unselected feature's rows are zeroed there)
-    tot = jnp.sum(base_t, axis=1)
+    # unselected feature's rows are zeroed there). Quantized mode totals the
+    # DEQUANTIZED stats so node stats stay consistent with histogram sums.
+    if qscales is not None:
+        tot = jnp.sum(base_t.astype(jnp.int32), axis=1) * qscales
+    else:
+        tot = jnp.sum(base_t, axis=1)
     if axis_name is not None:
         tot = lax.psum(tot, axis_name)
     tot_g, tot_h, tot_c = tot[0], tot[1], tot[2]
@@ -371,7 +382,7 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
                         hess: jnp.ndarray, valid: jnp.ndarray,
                         feat_mask: jnp.ndarray, cfg: GrowConfig,
                         axis_name: Optional[str] = None,
-                        is_cat: Optional[jnp.ndarray] = None):
+                        is_cat: Optional[jnp.ndarray] = None, qkey=None):
     """Level-synchronous growth: one histogram pass per level.
 
     Every node on the level frontier contributes 3 stat channels
@@ -396,6 +407,9 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
 
     vm = valid.astype(jnp.float32)
     base_t = jnp.stack([grad * vm, hess * vm, vm], axis=0)   # [3, n]
+    qscales = None
+    if cfg.quantized_grad:
+        base_t, qscales = quantize_stats(base_t, qkey)
     zi = jnp.zeros(M, dtype=jnp.int32)
     zf = jnp.zeros(M, dtype=jnp.float32)
     tree_arrays = dict(
@@ -408,8 +422,11 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
     num_nodes = jnp.int32(1)
     leaves = jnp.int32(1)
 
-    # root totals
-    tot0 = jnp.sum(base_t, axis=1)
+    # root totals (dequantized sums: consistent with histogram sums)
+    if qscales is not None:
+        tot0 = jnp.sum(base_t.astype(jnp.int32), axis=1) * qscales
+    else:
+        tot0 = jnp.sum(base_t, axis=1)
     if axis_name is not None:
         tot0 = lax.psum(tot0, axis_name)
     tree_arrays["ng"] = tree_arrays["ng"].at[0].set(tot0[0])
@@ -436,7 +453,8 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
 
             # one fused histogram pass covers the whole level: the
             # row->position one-hot and masked stats are built in VMEM
-            h = node_histogram(binned_t, row_pos, base_t, W, B)  # [F, W*3, B]
+            h = node_histogram(binned_t, row_pos, base_t, W, B,
+                               scales=qscales)                 # [F, W*3, B]
             feat_mask_lvl = feat_mask
             if axis_name is not None:
                 if cfg.voting:
