@@ -1,0 +1,133 @@
+"""Deployment entrypoint: run a serving worker or gateway from the CLI.
+
+The reference ships its serving stack as container images + cluster tooling
+(reference: tools/docker/* and tools/helm/* of the reference repo; see this
+repo's tools/docker/README.md). This module is what those images run:
+
+    python -m mmlspark_tpu.io.serving_main worker \
+        --model /models/pipeline --registry /mnt/registry --port 8900
+    python -m mmlspark_tpu.io.serving_main gateway \
+        --registry /mnt/registry --port 8898
+
+Workers load a saved PipelineModel (or a LightGBM native-model file), serve
+it with micro-batching, and register into the shared file-backed
+ServiceRegistry; any number of gateways load-balance over whatever the
+registry holds. ``tools/docker`` and ``tools/helm`` wire these into
+docker-compose and Kubernetes deployments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+import uuid
+
+
+def _load_transform(model_path: str, input_col: str, output_col: str):
+    import numpy as np
+
+    from ..core.dataset import Dataset
+    from .http import to_jsonable
+    from .serving import make_reply
+
+    if model_path.endswith(".txt"):       # LightGBM native model string
+        from ..models.gbdt.booster import Booster
+        with open(model_path) as f:
+            booster = Booster.from_string(f.read())
+
+        def transform(ds):
+            rows = np.asarray([v[input_col] for v in ds["value"]], np.float32)
+            preds = booster.predict(rows)
+            return ds.with_column("reply", [
+                make_reply({output_col: to_jsonable(p)}) for p in preds])
+
+        return transform
+
+    from ..core.pipeline import load_stage
+    model = load_stage(model_path)
+
+    def transform(ds):
+        rows = [v[input_col] for v in ds["value"]]
+        out = model.transform(Dataset({input_col: rows}))
+        return ds.with_column("reply", [
+            make_reply({output_col: to_jsonable(v)}) for v in out[output_col]])
+
+    return transform
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="mmlspark_tpu.io.serving_main")
+    sub = p.add_subparsers(dest="role", required=True)
+
+    w = sub.add_parser("worker", help="serve a model + register")
+    w.add_argument("--model", required=True,
+                   help="saved pipeline dir or LightGBM .txt model")
+    w.add_argument("--registry", required=True,
+                   help="shared registry directory")
+    w.add_argument("--host", default="0.0.0.0")
+    w.add_argument("--advertise-host", default=None,
+                   help="address other hosts reach this worker at "
+                        "(default: --host)")
+    w.add_argument("--port", type=int, default=0)
+    w.add_argument("--api-name", default="serving")
+    w.add_argument("--input-col", default="features")
+    w.add_argument("--output-col", default="prediction")
+    w.add_argument("--max-batch", type=int, default=32)
+    w.add_argument("--max-latency-ms", type=float, default=5.0)
+
+    g = sub.add_parser("gateway", help="load-balance over registry workers")
+    g.add_argument("--registry", required=True)
+    g.add_argument("--host", default="0.0.0.0")
+    g.add_argument("--port", type=int, default=8898)
+    g.add_argument("--api-name", default="serving")
+
+    args = p.parse_args(argv)
+
+    from .distributed_serving import (GatewayServer, ServiceRegistry,
+                                      WorkerInfo)
+    from .serving import ServingQuery, ServingServer
+
+    registry = ServiceRegistry(args.registry)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *a: stop.set())
+
+    if args.role == "worker":
+        transform = _load_transform(args.model, args.input_col,
+                                    args.output_col)
+        server = ServingServer(args.host, args.port, args.api_name)
+        query = ServingQuery(server, transform, max_batch=args.max_batch,
+                             max_latency=args.max_latency_ms / 1000.0)
+        advertise = args.advertise_host or args.host
+        if advertise in ("0.0.0.0", "::"):
+            # a wildcard bind address is not reachable from other hosts:
+            # fall back to this container/host's name (docker service DNS)
+            import socket
+            advertise = socket.gethostname()
+        info = WorkerInfo(worker_id=uuid.uuid4().hex[:12],
+                          host=advertise,
+                          port=server.port, api_name=args.api_name)
+        query.start()
+        registry.register(info)
+        print(f"worker {info.worker_id} serving on "
+              f"{server.host}:{server.port}", flush=True)
+        try:
+            stop.wait()
+        finally:
+            registry.deregister(info.worker_id)
+            query.stop()
+        return 0
+
+    gateway = GatewayServer(registry, args.host, args.port, args.api_name)
+    gateway.start()
+    print(f"gateway on {gateway.host}:{gateway.port}", flush=True)
+    try:
+        stop.wait()
+    finally:
+        gateway.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

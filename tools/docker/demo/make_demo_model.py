@@ -1,0 +1,33 @@
+"""Train and export the demo model the compose workers serve.
+
+Run once before `docker compose up`:
+
+    python tools/docker/demo/make_demo_model.py
+
+Writes ./models/model.txt (LightGBM native text format) next to this file.
+"""
+
+import os
+
+import numpy as np
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.models.gbdt.api import LightGBMRegressor
+
+
+def main():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2000, 4)).astype(np.float32)
+    y = (X @ np.array([1.0, -2.0, 0.5, 0.0])).astype(np.float32)
+    model = LightGBMRegressor(numIterations=30, numLeaves=15).fit(
+        Dataset({"features": X, "label": y}))
+    out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "models")
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "model.txt")
+    model.save_native_model(path)
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+{{- define "mmlspark-tpu-serving.name" -}}
+{{- .Chart.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
