@@ -45,13 +45,20 @@ def _load_transform(model_path: str, input_col: str, output_col: str):
         return transform
 
     from ..core.pipeline import load_stage
+    from .serving import bucket_size
     model = load_stage(model_path)
 
     def transform(ds):
         rows = [v[input_col] for v in ds["value"]]
-        out = model.transform(Dataset({input_col: rows}))
+        n = len(rows)
+        # power-of-two bucket padding (ServingBuilder.pipeline semantics):
+        # a jitted model sees log2(max_batch) shapes, not one per batch size
+        b = bucket_size(n, max(64, n))
+        padded = rows + [rows[0]] * (b - n)
+        out = model.transform(Dataset({input_col: padded}))
+        vals = list(out[output_col])[:n]
         return ds.with_column("reply", [
-            make_reply({output_col: to_jsonable(v)}) for v in out[output_col]])
+            make_reply({output_col: to_jsonable(v)}) for v in vals])
 
     return transform
 

@@ -120,6 +120,10 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
 
     def _fit_weights(self, dataset: Dataset, cfg: SGDConfig):
         idx, val = self._features(dataset)
+        # VW semantics: the weight table masks hashes by 2^numBits (-b at
+        # access time), so a featurizer hashed wider than the learner folds
+        # by masking — never by index clamping
+        idx = idx & ((1 << cfg.num_bits) - 1)
         y = dataset.array(self.get_or_default("labelCol"), np.float32)
         wcol = self.get_or_default("weightCol")
         sw = dataset.array(wcol, np.float32) if wcol else None
@@ -165,6 +169,8 @@ class _VowpalWabbitModelBase(Model, _VowpalWabbitBaseParams):
 
     def _margin(self, dataset: Dataset) -> np.ndarray:
         idx, val = self._features(dataset)
+        # same 2^numBits weight-table mask as training
+        idx = idx & (len(self.weights) - 1)
         return predict_sgd(idx, val, self.weights)
 
     def get_performance_statistics(self) -> Dataset:

@@ -11,7 +11,12 @@ the replicas are psum-averaged at every pass end — the same
 sync-at-pass-boundary semantics as VW AllReduce, over ICI instead of sockets.
 
 Adaptive (AdaGrad) and normalized updates mirror VW's ``--adaptive``
-``--normalized`` flags; plain SGD when both off. ``--bfgs`` switches to a
+``--normalized`` flags; plain SGD when both off. L1 is VW's lazy truncated
+gradient (Langford et al.): each weight shrinks by ``lr * l1`` per elapsed
+step, applied at touch time from a per-weight last-touch clock (and caught
+up at pass ends), so predictions always see the shrunk weights — not a
+truncate-at-end approximation. The shrink rides the base learning rate
+(VW scales it by the adaptive rate; a documented approximation). ``--bfgs`` switches to a
 full-batch L-BFGS (two-loop recursion, Armijo backtracking) whose gradient
 is one psum over the mesh per iteration — the batch-mode counterpart the
 reference exposes through VW's own --bfgs passthrough
@@ -98,7 +103,9 @@ def train_bfgs(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
     [m, D] curvature history, and line-searches with Armijo backtracking —
     all inside a single jitted shard_map program (``num_passes`` iterations,
     matching VW where --passes bounds BFGS iterations). L2 regularizes the
-    objective; L1 applies as the same truncate-at-end used by the SGD path.
+    objective; L1 applies as a single truncate-at-end after the final
+    iteration (the batch solver has no per-step clock; the SGD path uses
+    true lazy truncated-gradient L1).
     """
     mesh = mesh or meshlib.get_default_mesh()
     D = 1 << cfg.num_bits
@@ -267,7 +274,7 @@ def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
     lr = cfg.learning_rate
     eps = 1e-6
 
-    def local_train(idx, val, y, sw, w, g2_0, t_0):
+    def local_train(idx, val, y, sw, w, g2_0, t_0, lt_0):
         n_local = idx.shape[0]
         nb = n_local // bs
         idx_b = idx.reshape(nb, bs, nnz)
@@ -275,16 +282,28 @@ def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
         y_b = y.reshape(nb, bs)
         sw_b = sw.reshape(nb, bs)
 
+        def _shrink(wv, pending):
+            return jnp.sign(wv) * jnp.maximum(
+                jnp.abs(wv) - lr * cfg.l1 * pending, 0.0)
+
         def one_pass(carry, _):
-            w, g2, t = carry
+            w, g2, t, lt = carry
 
             def batch_step(carry, xs):
-                w, g2, t = carry
+                w, g2, t, lt = carry
                 bi, bv, by, bw = xs
-                pred = jnp.sum(w[bi] * bv, axis=1)  # [bs]
+                flat_i = bi.reshape(-1)
+                if cfg.l1 > 0:
+                    # lazy truncated gradient: catch the touched weights up
+                    # on their skipped steps BEFORE predicting/updating
+                    wv = _shrink(w[flat_i], jnp.maximum(t - lt[flat_i], 0.0))
+                    w = w.at[flat_i].set(wv)
+                    lt = lt.at[flat_i].set(t)
+                    pred = jnp.sum(wv.reshape(bi.shape) * bv, axis=1)
+                else:
+                    pred = jnp.sum(w[bi] * bv, axis=1)  # [bs]
                 gp = _loss_grad(cfg.loss, pred, by, cfg.quantile_tau) * bw
                 gf = gp[:, None] * bv  # [bs, nnz] per-feature grads
-                flat_i = bi.reshape(-1)
                 flat_g = gf.reshape(-1)
                 if cfg.adaptive:
                     g2 = g2.at[flat_i].add(flat_g * flat_g)
@@ -294,22 +313,23 @@ def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
                 if cfg.l2 > 0:
                     w = w * (1.0 - lr * cfg.l2)
                 w = w.at[flat_i].add(-lr * flat_g * scale)
-                return (w, g2, t + 1.0), None
+                return (w, g2, t + 1.0, lt), None
 
-            (w, g2, t), _ = lax.scan(
-                batch_step, (w, g2, t), (idx_b, val_b, y_b, sw_b))
+            (w, g2, t, lt), _ = lax.scan(
+                batch_step, (w, g2, t, lt), (idx_b, val_b, y_b, sw_b))
+            if cfg.l1 > 0:
+                # pass-end catch-up so the pmean'd replicas agree exactly
+                w = _shrink(w, jnp.maximum(t - lt, 0.0))
+                lt = jnp.full_like(lt, t)
             # pass-end AllReduce average (VW spanning-tree parity)
             w = lax.pmean(w, "data")
             g2 = lax.pmean(g2, "data")
-            return (w, g2, t), None
+            return (w, g2, t, lt), None
 
-        (w, g2, t), _ = lax.scan(one_pass, (w, g2_0, t_0), None,
-                                 length=cfg.num_passes)
-        w_out = w
-        if cfg.l1 > 0:  # truncate-at-end approximation of lazy L1
-            w_out = jnp.sign(w) * jnp.maximum(jnp.abs(w) - cfg.l1, 0.0)
-        # raw (pre-L1) state continues across checkpointed calls
-        return w_out, w, g2, t
+        (w, g2, t, lt), _ = lax.scan(one_pass, (w, g2_0, t_0, lt_0), None,
+                                     length=cfg.num_passes)
+        # lazy L1 leaves every weight caught up at pass end: output == state
+        return w, w, g2, t, lt
 
     # compiled-step cache: pass-by-pass checkpointed training re-enters with
     # identical (cfg, shapes, mesh) and must reuse one XLA executable rather
@@ -321,7 +341,7 @@ def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
         fn = jax.jit(jax.shard_map(
             local_train, mesh=mesh,
             in_specs=(P("data", None), P("data", None), P("data"), P("data"),
-                      P(), P(), P()),
+                      P(), P(), P(), P()),
             out_specs=P(), check_vma=False))
         _SGD_FN_CACHE[cache_key] = fn
         while len(_SGD_FN_CACHE) > _SGD_FN_CACHE_MAX:
@@ -329,18 +349,24 @@ def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
     else:
         _SGD_FN_CACHE.move_to_end(cache_key)
     if initial_state is not None:
-        w_raw, g2_0, t_0 = initial_state
+        if len(initial_state) == 3:     # pre-lazy-L1 checkpoint format
+            w_raw, g2_0, t_0 = initial_state
+            lt_0 = jnp.full(D, float(t_0), jnp.float32)
+        else:
+            w_raw, g2_0, t_0, lt_0 = initial_state
+            lt_0 = jnp.asarray(lt_0)
         w0 = np.asarray(w_raw, np.float32)
         g2_0 = jnp.asarray(g2_0)
         t_0 = jnp.float32(t_0)
     else:
         g2_0 = jnp.zeros(D, jnp.float32)
         t_0 = jnp.float32(cfg.initial_t)
-    w_out, w_raw, g2, t = fn(idx_d, val_d, y_d, sw_d, jnp.asarray(w0),
-                             g2_0, t_0)
+        lt_0 = jnp.full(D, float(cfg.initial_t), jnp.float32)
+    w_out, w_raw, g2, t, lt = fn(idx_d, val_d, y_d, sw_d, jnp.asarray(w0),
+                                 g2_0, t_0, lt_0)
     if return_state:
         return np.asarray(w_out), (np.asarray(w_raw), np.asarray(g2),
-                                   float(t))
+                                   float(t), np.asarray(lt))
     return np.asarray(w_out)
 
 
@@ -353,10 +379,11 @@ def train_sgd_checkpointed(indices: np.ndarray, values: np.ndarray,
                            ) -> np.ndarray:
     """Multi-pass SGD with pass-level checkpoint/resume (SURVEY.md §5).
 
-    Each pass runs as one device call whose full optimizer state (raw
-    weights, adagrad accumulators, step counter) is checkpointed; resuming
-    reproduces the uninterrupted run exactly. L1 truncation (a train-end
-    post-pass in VW) applies only on the final pass."""
+    Each pass runs as one device call whose full optimizer state (weights,
+    adagrad accumulators, step counter, lazy-L1 last-touch clock) is
+    checkpointed; resuming reproduces the uninterrupted run exactly. Lazy
+    truncated-gradient L1 applies on every pass through the carried
+    clock — checkpointed weights are already regularized."""
     from ...utils.checkpoint import CheckpointManager, data_fingerprint
 
     fingerprint = data_fingerprint(
@@ -382,7 +409,9 @@ def train_sgd_checkpointed(indices: np.ndarray, values: np.ndarray,
     w = initial_weights
     for p in range(start_pass, cfg.num_passes):
         is_last = p == cfg.num_passes - 1
-        one = cfg._replace(num_passes=1, l1=cfg.l1 if is_last else 0.0)
+        # lazy L1 is stateful (per-weight last-touch clock in the carried
+        # state), so it applies on every pass — no end-only emulation
+        one = cfg._replace(num_passes=1)
         if prepped is None:
             # pad/shard/transfer once; identical for every pass (batch_size
             # is the only prep-relevant cfg field and it doesn't vary)

@@ -217,3 +217,88 @@ class TestBFGS:
         n_free = float(np.abs(w_free.weights).sum())
         n_reg = float(np.abs(w_reg.weights).sum())
         assert n_reg < n_free, (n_reg, n_free)
+
+
+class TestLazyL1:
+    """VW truncated-gradient L1 parity (lazy per-weight shrinkage, not
+    truncate-at-end)."""
+
+    def test_lazy_shrinkage_scales_with_elapsed_steps(self):
+        """Direct truncated-gradient semantics: a weight untouched for k
+        batch steps shrinks by lr*l1*k at catch-up (truncate-at-end would
+        subtract l1 once, independent of k)."""
+        import jax
+        from jax.sharding import Mesh
+        from mmlspark_tpu.models.vw.sgd import SGDConfig, train_sgd
+
+        one_dev = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        D_bits, bs = 8, 4
+        # feature 5 appears ONLY in the first batch; feature 7 in every
+        # batch; 8 batches per pass
+        n, nnz = 32, 1
+        idx = np.full((n, nnz), 7, np.int32)
+        idx[:bs, 0] = 5
+        val = np.ones((n, nnz), np.float32)
+        y = np.full(n, 1.0, np.float32)
+        lr, l1 = 0.5, 0.01
+        cfg = SGDConfig(num_bits=D_bits, num_passes=1, batch_size=bs,
+                        learning_rate=lr, l1=l1, adaptive=False,
+                        power_t=0.0, loss="squared")
+        w = train_sgd(idx, val, y, None, cfg, mesh=one_dev)
+        cfg0 = cfg._replace(l1=0.0)
+        w0 = train_sgd(idx, val, y, None, cfg0, mesh=one_dev)
+        # feature 5: touched at t=0 only; 8 batches total -> 8 elapsed
+        # steps of shrinkage at pass-end catch-up
+        expect5 = max(abs(w0[5]) - lr * l1 * 8, 0.0) * np.sign(w0[5])
+        np.testing.assert_allclose(w[5], expect5, rtol=1e-5, atol=1e-6)
+        # feature 7 is touched every step: it sees one step of shrinkage
+        # per batch but keeps being refreshed -> still clearly nonzero
+        assert abs(w[7]) > 0.1
+        # and more total shrinkage applies to 5 (8 idle steps) than would
+        # a single truncate-at-end subtraction of l1
+        assert abs(w0[5]) - abs(w[5]) > 2 * l1
+
+    def test_l1_prunes_more_as_strength_grows(self):
+        rng = np.random.default_rng(0)
+        n = 1200
+        # signal feature in every row; noise features each appear ~1% of rows
+        sig = rng.normal(size=n).astype(np.float32)
+        y = (2.0 * sig).astype(np.float32)
+        rows = []
+        for i in range(n):
+            d = {"sig": float(sig[i])}
+            d[f"noise_{rng.integers(0, 100)}"] = float(rng.normal())
+            rows.append(d)
+        ds = Dataset({"features": rows, "label": y})
+        dsf = VowpalWabbitFeaturizer(inputCols=["features"], numBits=14,
+                                     outputCol="features").transform(ds)
+        m_l1 = VowpalWabbitRegressor(numBits=14, numPasses=3,
+                                     l1=0.1).fit(dsf)
+        m_free = VowpalWabbitRegressor(numBits=14, numPasses=3).fit(dsf)
+        nz_l1 = int((m_l1.weights != 0).sum())
+        nz_free = int((m_free.weights != 0).sum())
+        assert nz_l1 < nz_free, (nz_l1, nz_free)
+        pred = m_l1.transform(dsf).array("prediction")
+        rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+        assert rmse < 1.0, rmse
+
+    def test_l1_checkpoint_resume_bitwise(self, tmp_path):
+        """The lazy-L1 clock rides the checkpoint state: resumed training
+        reproduces the uninterrupted run exactly."""
+        from mmlspark_tpu.models.vw.sgd import (SGDConfig, train_sgd,
+                                                train_sgd_checkpointed)
+
+        rng = np.random.default_rng(1)
+        n, nnz = 256, 4
+        idx = rng.integers(0, 1 << 10, size=(n, nnz)).astype(np.int32)
+        val = rng.normal(size=(n, nnz)).astype(np.float32)
+        y = rng.normal(size=n).astype(np.float32)
+        cfg = SGDConfig(num_bits=10, num_passes=4, l1=0.01, batch_size=32)
+
+        w_direct = train_sgd(idx, val, y, None, cfg)
+        # interrupted: two passes, "crash", resume from checkpoint
+        cfg2 = cfg._replace(num_passes=2)
+        d = str(tmp_path / "ck")
+        train_sgd_checkpointed(idx, val, y, None, cfg2, d)
+        w_resumed = train_sgd_checkpointed(idx, val, y, None, cfg, d)
+        np.testing.assert_array_equal(w_direct, w_resumed)
