@@ -259,6 +259,19 @@ def bucket_size(n: int, max_batch: int) -> int:
     return min(b, max_batch)
 
 
+def bucketed_model_transform(model, rows: list, input_col: str,
+                             output_col: str, max_batch: int) -> list:
+    """Pad ``rows`` to a power-of-two bucket (first row repeated), run the
+    model, slice back to ``len(rows)`` outputs. The single shared
+    implementation of jit-friendly bucket padding, used by both
+    ``ServingBuilder.pipeline`` and the ``serving_main`` worker entrypoint."""
+    n = len(rows)
+    b = bucket_size(n, max(max_batch, n))
+    padded = rows + [rows[0]] * (b - n)
+    out = model.transform(Dataset({input_col: padded}))
+    return list(out[output_col])[:n]
+
+
 class ServingQuery:
     """Continuous micro-batch loop: get_batch -> transform -> reply.
 
@@ -364,15 +377,12 @@ class ServingBuilder:
         shapes — no recompiles under varying load."""
 
         def fn(ds: Dataset) -> Dataset:
-            values = list(ds["value"])
-            n = len(values)
             # Read the builder's batch size at call time, so `.batch()` later
             # in the fluent chain still governs the bucketing.
-            b = bucket_size(n, max(self._max_batch, n))
-            padded = values + [values[0]] * (b - n)
-            out = model.transform(Dataset({input_col: padded}))
-            replies = [make_reply(to_jsonable(v))
-                       for v in list(out[output_col])[:n]]
+            vals = bucketed_model_transform(
+                model, list(ds["value"]), input_col, output_col,
+                self._max_batch)
+            replies = [make_reply(to_jsonable(v)) for v in vals]
             return ds.with_column(self._reply_col, replies)
 
         self._transform = fn

@@ -24,7 +24,8 @@ import threading
 import uuid
 
 
-def _load_transform(model_path: str, input_col: str, output_col: str):
+def _load_transform(model_path: str, input_col: str, output_col: str,
+                    max_batch: int = 64):
     import numpy as np
 
     from ..core.dataset import Dataset
@@ -45,18 +46,13 @@ def _load_transform(model_path: str, input_col: str, output_col: str):
         return transform
 
     from ..core.pipeline import load_stage
-    from .serving import bucket_size
+    from .serving import bucketed_model_transform
     model = load_stage(model_path)
 
     def transform(ds):
         rows = [v[input_col] for v in ds["value"]]
-        n = len(rows)
-        # power-of-two bucket padding (ServingBuilder.pipeline semantics):
-        # a jitted model sees log2(max_batch) shapes, not one per batch size
-        b = bucket_size(n, max(64, n))
-        padded = rows + [rows[0]] * (b - n)
-        out = model.transform(Dataset({input_col: padded}))
-        vals = list(out[output_col])[:n]
+        vals = bucketed_model_transform(model, rows, input_col, output_col,
+                                        max_batch)
         return ds.with_column("reply", [
             make_reply({output_col: to_jsonable(v)}) for v in vals])
 
@@ -102,7 +98,8 @@ def main(argv=None) -> int:
 
     if args.role == "worker":
         transform = _load_transform(args.model, args.input_col,
-                                    args.output_col)
+                                    args.output_col,
+                                    max_batch=args.max_batch)
         server = ServingServer(args.host, args.port, args.api_name)
         query = ServingQuery(server, transform, max_batch=args.max_batch,
                              max_latency=args.max_latency_ms / 1000.0)

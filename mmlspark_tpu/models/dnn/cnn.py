@@ -107,13 +107,20 @@ def _conv(x, w, stride=1):
     # weights imported via from_torch_resnet_state_dict (torch pads
     # symmetrically)
     ph, pw = (w.shape[0] - 1) // 2, (w.shape[1] - 1) // 2
+    # params are stored f32; cast at use so cfg.dtype=bfloat16 runs the whole
+    # conv stack on the MXU in bf16 instead of erroring (or silently promoting
+    # back to f32 through the folded-BN affine)
     return lax.conv_general_dilated(
-        x, w, (stride, stride), ((ph, ph), (pw, pw)),
+        x, w.astype(x.dtype), (stride, stride), ((ph, ph), (pw, pw)),
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
+def _bn_affine(x, p):
+    return x * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
 def _bn_relu(x, p):
-    return jax.nn.relu(x * p["scale"] + p["bias"])
+    return jax.nn.relu(_bn_affine(x, p))
 
 
 def apply_cnn(params: Dict[str, Any], x: jnp.ndarray, cfg: CNNConfig,
@@ -137,13 +144,11 @@ def apply_cnn(params: Dict[str, Any], x: jnp.ndarray, cfg: CNNConfig,
             stride = 2 if (b == 0 and s > 0) else 1
             if cfg.block == "basic":
                 h = _bn_relu(_conv(x, blk["conv1"]["w"], stride), blk["conv1"])
-                h = (_conv(h, blk["conv2"]["w"]) * blk["conv2"]["scale"]
-                     + blk["conv2"]["bias"])
+                h = _bn_affine(_conv(h, blk["conv2"]["w"]), blk["conv2"])
             else:
                 h = _bn_relu(_conv(x, blk["conv1"]["w"]), blk["conv1"])
                 h = _bn_relu(_conv(h, blk["conv2"]["w"], stride), blk["conv2"])
-                h = (_conv(h, blk["conv3"]["w"]) * blk["conv3"]["scale"]
-                     + blk["conv3"]["bias"])
+                h = _bn_affine(_conv(h, blk["conv3"]["w"]), blk["conv3"])
             shortcut = x
             if "proj" in blk:
                 shortcut = _conv(x, blk["proj"]["w"], stride)
@@ -155,7 +160,8 @@ def apply_cnn(params: Dict[str, Any], x: jnp.ndarray, cfg: CNNConfig,
     pooled = jnp.mean(x, axis=(1, 2))
     if "pool" in capture:
         acts["pool"] = pooled
-    logits = pooled @ params["head"]["w"] + params["head"]["b"]
+    logits = (pooled @ params["head"]["w"].astype(pooled.dtype)
+              + params["head"]["b"].astype(pooled.dtype))
     if "logits" in capture:
         acts["logits"] = logits
     return logits, acts

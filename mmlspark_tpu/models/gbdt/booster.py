@@ -15,6 +15,7 @@ arrays per iteration and are stacked into the Booster.
 from __future__ import annotations
 
 import json
+import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -51,6 +52,23 @@ def _cached_program(key, build):
     else:
         _STEP_CACHE.move_to_end(key)
     return prog
+
+
+class _PhaseTimer:
+    """Opt-in wall-time phase breakdown of a train_booster call
+    (MMLSPARK_TPU_TIMING=1) — the TPU analog of the reference's per-phase
+    TrainingStats diagnostics (vw/VowpalWabbitBase.scala:27-46)."""
+
+    def __init__(self):
+        import os
+        self.on = bool(os.environ.get("MMLSPARK_TPU_TIMING"))
+        self._t = time.perf_counter() if self.on else 0.0
+
+    def mark(self, name: str) -> None:
+        if self.on:
+            now = time.perf_counter()
+            print(f"[gbdt-timing] {name}: {now - self._t:.3f}s", flush=True)
+            self._t = now
 
 
 def _with_tree_defaults(fields: Dict) -> Dict:
@@ -558,8 +576,10 @@ def train_booster(
         raise ValueError(
             f"categorical_features indexes {bad_cats} out of range for "
             f"{F} features")
+    tw = _PhaseTimer()
     binner = QuantileBinner(max_bin, bin_sample_count, seed,
                             categorical_features).fit(X)
+    tw.mark("binner_fit")
     # categorical routing mask: None when absent so the purely-numeric path
     # compiles with zero bitset overhead
     is_cat_np = binner.is_cat_mask()
@@ -572,6 +592,9 @@ def train_booster(
     # the same byte count so the transfer is unchanged). Padding rows bin to
     # garbage but carry vmask 0, so they contribute nothing downstream.
     X_d, _ = meshlib.shard_rows(X, mesh)
+    if tw.on:
+        X_d.block_until_ready()
+        tw.mark("xfer_X")
     bin_fn = _cached_program(
         ("bin_cols", X_d.shape, max_bin, mesh),
         lambda: jax.jit(jax.shard_map(
@@ -583,6 +606,7 @@ def train_booster(
     # the raw copy served only to produce the binned matrix: free its HBM
     # now or both dataset-sized buffers stay live for the whole run
     Xbt_d.block_until_ready()
+    tw.mark("bin_device")
     X_d.delete()
     del X_d
     y_d, _ = meshlib.shard_rows(y, mesh)
@@ -606,6 +630,11 @@ def train_booster(
         base = np.zeros(K, dtype=np.float32)
         scores0 = np.zeros((n, K), dtype=np.float32)
     scores_d, _ = meshlib.shard_rows(scores0.astype(np.float32), mesh)
+    if tw.on:
+        # block before marking or the async transfers would complete during
+        # (and be misattributed to) whatever phase happens to wait next
+        jax.block_until_ready((y_d, w_d, vmask_d, scores_d))
+        tw.mark("aux_shards")
 
     has_valid = valid_set is not None
     if has_valid:
@@ -623,6 +652,9 @@ def train_booster(
         vscores0 = (init_booster.predict_raw(Xv) if init_booster is not None
                     else np.tile(base[None, :], (nv, 1)))
         vscores_d, _ = meshlib.shard_rows(vscores0.astype(np.float32), mesh)
+        if tw.on:
+            jax.block_until_ready((Xvb_d, yv_d, wv_d, vscores_d))
+            tw.mark("valid_prep")
     else:
         Xvb_d = yv_d = wv_d = vscores_d = None
 
@@ -816,8 +848,13 @@ def train_booster(
                 out_specs=P(), check_vma=False))
 
         multi = _cached_program(fuse_key, build_multi)
-        trees_seq = jax.tree_util.tree_map(
-            np.asarray, multi(Xbt_d, y_d, w_d, vmask_d, scores_d))
+        tw.mark("build_multi")
+        trees_dev = multi(Xbt_d, y_d, w_d, vmask_d, scores_d)
+        if tw.on:
+            jax.block_until_ready(trees_dev)
+            tw.mark("multi_exec")
+        trees_seq = jax.tree_util.tree_map(np.asarray, trees_dev)
+        tw.mark("trees_download")
         all_seq: List[Tree] = []
         for it in range(num_iterations):
             for k in range(K):

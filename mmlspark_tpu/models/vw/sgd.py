@@ -348,10 +348,15 @@ def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
             _SGD_FN_CACHE.popitem(last=False)
     else:
         _SGD_FN_CACHE.move_to_end(cache_key)
+    # the lazy-L1 last-touch clock is only read when l1 > 0; cfg keys both
+    # the jit cache and the checkpoint fingerprint, so the l1 == 0 default
+    # carries a 1-element dummy instead of a 2^num_bits array (which would
+    # otherwise be allocated, transferred, and checkpointed for nothing)
+    D_lt = D if cfg.l1 > 0 else 1
     if initial_state is not None:
         if len(initial_state) == 3:     # pre-lazy-L1 checkpoint format
             w_raw, g2_0, t_0 = initial_state
-            lt_0 = jnp.full(D, float(t_0), jnp.float32)
+            lt_0 = jnp.full(D_lt, float(t_0), jnp.float32)
         else:
             w_raw, g2_0, t_0, lt_0 = initial_state
             lt_0 = jnp.asarray(lt_0)
@@ -361,7 +366,7 @@ def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
     else:
         g2_0 = jnp.zeros(D, jnp.float32)
         t_0 = jnp.float32(cfg.initial_t)
-        lt_0 = jnp.full(D, float(cfg.initial_t), jnp.float32)
+        lt_0 = jnp.full(D_lt, float(cfg.initial_t), jnp.float32)
     w_out, w_raw, g2, t, lt = fn(idx_d, val_d, y_d, sw_d, jnp.asarray(w0),
                                  g2_0, t_0, lt_0)
     if return_state:

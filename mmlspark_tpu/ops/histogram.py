@@ -150,7 +150,8 @@ def node_histogram(binned_t: jnp.ndarray, row_pos: jnp.ndarray,
     W = int(num_nodes)
     B = int(num_bins)
     quantized = scales is not None
-    if _use_pallas() and _pick_row_block(n, F, 3 * W, B, fused_w=W) > 0:
+    if _use_pallas() and _pick_row_block(n, F, 3 * W, B, fused_w=W,
+                                         quantized=quantized) > 0:
         out = _node_hist_pallas(binned_t, row_pos, base_t, W, B,
                                 quantized=quantized)
     else:
@@ -273,7 +274,8 @@ def _bin_packing(B: int):
     return -(-B // 128) * 128, 1
 
 
-def _pick_row_block(n: int, F: int, S: int, B: int, fused_w: int = 0) -> int:
+def _pick_row_block(n: int, F: int, S: int, B: int, fused_w: int = 0,
+                    quantized: bool = False) -> int:
     """Largest row-block size whose resident VMEM fits the budget.
 
     VMEM model (matches the kernels): input blocks are double-buffered across
@@ -281,22 +283,26 @@ def _pick_row_block(n: int, F: int, S: int, B: int, fused_w: int = 0) -> int:
     [8, RB] f32 base + [1, RB] i32 positions); the [Fp, Sp, BP] f32
     accumulator stays resident; kernel scratch is the packed one-hot
     [RB, max(BP,128)] bf16 plus, fused, the rebuilt [W, 3, RB] + [Sp, RB]
-    masked stats.
+    masked stats. int8 (quantized) scratch is charged at 4 B/elem, not 1:
+    Mosaic widens narrow-sublane int8 tiles internally, and the measured
+    stack footprint tracks the 32-bit accounting (a 1 B model produced a
+    16.8 MB scoped allocation against the 16 MB limit at W=31, B=63).
     """
     BP, P = _bin_packing(B)
     Fp = -(-F // P) * P
     Sp = -(-max(S, 1) // 16) * 16
+    elt = 4 if quantized else 2
     for RB in (8192, 4096, 2048, 1024, 512):
         if RB > max(512, n):
             continue  # don't pad a small input up to a huge block
         binned_block = Fp * RB * 4
         if fused_w:
             in_blocks = binned_block + RB * 4 + 8 * RB * 4
-            scratch = (RB * max(BP, 128) * 2
-                       + 2 * (fused_w * 3 * RB * 2) + Sp * RB * 2)
+            scratch = (RB * max(BP, 128) * elt
+                       + 2 * (fused_w * 3 * RB * elt) + Sp * RB * elt)
         else:
             in_blocks = binned_block + Sp * RB * 2
-            scratch = RB * max(BP, 128) * 2
+            scratch = RB * max(BP, 128) * elt
         out_block = Fp * Sp * BP * 4
         if 2 * in_blocks + out_block + scratch <= _PALLAS_VMEM_BUDGET:
             return RB
@@ -432,7 +438,7 @@ def _node_hist_pallas(binned_t: jnp.ndarray, row_pos: jnp.ndarray,
     BP, P = _bin_packing(B)
     Fp = -(-F // P) * P
     Sp = -(-S // 16) * 16
-    RB = _pick_row_block(n, F, S, B, fused_w=W)
+    RB = _pick_row_block(n, F, S, B, fused_w=W, quantized=quantized)
     n_pad = -(-max(n, RB) // RB) * RB
     binned_t = _pad_features_to(_pad_rows_to(binned_t, n_pad), Fp)
     # padding rows: position -1 matches no frontier node -> contribute nothing
