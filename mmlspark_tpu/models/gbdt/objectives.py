@@ -47,12 +47,28 @@ def _regression_l2():
                      lambda y, w: jnp.sum(y * w) / jnp.sum(w))
 
 
+def weighted_quantile(y, w, q):
+    """Weighted q-quantile: smallest y with cumulative weight >= q * total.
+
+    Every init_score must honor zero weights: training feeds the padded,
+    sharded label array whose padding rows carry weight 0 (and row_valid /
+    sample weights flow through the same path). This is also LightGBM's own
+    BoostFromAverage semantics for l1/quantile — a weighted percentile
+    (PercentileFun), not an unweighted one.
+    """
+    order = jnp.argsort(y)
+    ys, ws = y[order], w[order]
+    cw = jnp.cumsum(ws)
+    target = q * cw[-1]
+    return ys[jnp.searchsorted(cw, target)]
+
+
 def _regression_l1():
     def grad_hess(score, y, w):
         return jnp.sign(score - y) * w, w  # constant-hessian approximation
 
     return Objective("regression_l1", grad_hess, lambda s: s, 1,
-                     lambda y, w: jnp.median(y))
+                     lambda y, w: weighted_quantile(y, w, 0.5))
 
 
 def _huber(alpha: float = 0.9):
@@ -83,7 +99,7 @@ def _quantile(alpha: float = 0.5):
         return g * w, w
 
     return Objective("quantile", grad_hess, lambda s: s, 1,
-                     lambda y, w: jnp.quantile(y, alpha))
+                     lambda y, w: weighted_quantile(y, w, alpha))
 
 
 def _poisson():

@@ -360,6 +360,13 @@ def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
         else:
             w_raw, g2_0, t_0, lt_0 = initial_state
             lt_0 = jnp.asarray(lt_0)
+            if lt_0.shape[0] != D_lt:
+                # state saved under a different l1 setting: a 1-element dummy
+                # clock resumed into an l1>0 run would silently clamp every
+                # per-feature gather/scatter to index 0 — rebuild the clock
+                # at the current step instead (weights are already caught up
+                # at every pass end, so "last touched now" is exact)
+                lt_0 = jnp.full(D_lt, float(t_0), jnp.float32)
         w0 = np.asarray(w_raw, np.float32)
         g2_0 = jnp.asarray(g2_0)
         t_0 = jnp.float32(t_0)

@@ -497,3 +497,110 @@ class TestBoostingTypes:
         with pytest.raises(ValueError, match="not supported"):
             LightGBMClassifier(numIterations=2, boostingType="plain").fit(
                 _to_ds(Xtr, ytr))
+
+
+class TestLightGBMDataset:
+    """Bin-once/train-many dataset (LightGBMDataset.scala:70-159 parity)."""
+
+    def test_dataset_training_matches_array_training(self):
+        from mmlspark_tpu.models.gbdt.booster import LightGBMDataset
+        Xtr, _, ytr, _ = _binary_data()
+        kw = dict(objective="binary", num_iterations=5,
+                  cfg=GrowConfig(num_leaves=7), max_bin=31)
+        b_arr = train_booster(Xtr, ytr, **kw)
+        ds = LightGBMDataset.construct(Xtr, ytr, max_bin=31)
+        b_ds = train_booster(dataset=ds, **kw)
+        np.testing.assert_allclose(b_arr.predict(Xtr), b_ds.predict(Xtr),
+                                   rtol=1e-6)
+        # train-many: a second, longer run against the same dataset
+        b2 = train_booster(dataset=ds, objective="binary", num_iterations=8,
+                           cfg=GrowConfig(num_leaves=7))
+        assert b2.num_trees == 8
+
+    def test_dataset_weighted_and_goss(self):
+        from mmlspark_tpu.models.gbdt.booster import LightGBMDataset
+        Xtr, _, ytr, _ = _binary_data()
+        w = np.where(ytr > 0, 2.0, 1.0).astype(np.float32)
+        kw = dict(objective="binary", num_iterations=4,
+                  cfg=GrowConfig(num_leaves=7), max_bin=31,
+                  boosting_type="goss")
+        b_arr = train_booster(Xtr, ytr, w, **kw)
+        ds = LightGBMDataset.construct(Xtr, ytr, w, max_bin=31)
+        b_ds = train_booster(dataset=ds, **kw)
+        np.testing.assert_allclose(b_arr.predict(Xtr), b_ds.predict(Xtr),
+                                   rtol=1e-6)
+
+    def test_dataset_rejects_checkpoint_and_blind_warm_start(self, tmp_path):
+        from mmlspark_tpu.models.gbdt.booster import LightGBMDataset
+        Xtr, _, ytr, _ = _binary_data()
+        ds = LightGBMDataset.construct(Xtr, ytr, max_bin=31)
+        with pytest.raises(ValueError, match="checkpointDir"):
+            train_booster(dataset=ds, objective="binary", num_iterations=2,
+                          checkpoint_dir=str(tmp_path / "ck"))
+        warm = train_booster(Xtr, ytr, objective="binary", num_iterations=2,
+                             cfg=GrowConfig(num_leaves=7), max_bin=31)
+        with pytest.raises(ValueError, match="pass X alongside"):
+            train_booster(dataset=ds, objective="binary", num_iterations=2,
+                          init_booster=warm)
+        with pytest.raises(ValueError, match="either X and y"):
+            train_booster(objective="binary", num_iterations=2)
+
+    def test_pack_unpack_roundtrip(self):
+        from mmlspark_tpu.models.gbdt.booster import (pack_trees,
+                                                      unpack_trees)
+        from mmlspark_tpu.models.gbdt.growth import Tree, bitset_words
+        rng = np.random.default_rng(0)
+        M, BW, lead = 9, bitset_words(63), (3, 2)
+        def arr(shape, dt):
+            if dt == np.bool_:
+                return rng.integers(0, 2, shape).astype(bool)
+            if dt in (np.int32, np.uint32):
+                return rng.integers(0, 100, shape).astype(dt)
+            return rng.normal(size=shape).astype(np.float32)
+        import jax.numpy as jnp
+        fields = {}
+        from mmlspark_tpu.models.gbdt.booster import _TREE_FIELD_DTYPES
+        for name in Tree._fields:
+            shape = lead + ((M, BW) if name == "cat_bitset"
+                            else () if name == "node_count" else (M,))
+            fields[name] = arr(shape, _TREE_FIELD_DTYPES[name])
+        t = Tree(**{k: jnp.asarray(v) for k, v in fields.items()})
+        flat = np.asarray(pack_trees(t))
+        out = unpack_trees(flat, lead, M, BW)
+        for name in Tree._fields:
+            got = getattr(out, name)
+            assert got.dtype == np.dtype(_TREE_FIELD_DTYPES[name]), name
+            np.testing.assert_array_equal(got, fields[name], err_msg=name)
+
+
+class TestInitScorePadding:
+    """init_score must honor zero weights: the device path feeds padded
+    sharded labels (padding rows carry weight 0). regression_l1/quantile
+    previously used unweighted median/quantile (code-review finding)."""
+
+    @pytest.mark.parametrize("objective", ["regression_l1", "quantile"])
+    def test_base_score_ignores_padding(self, objective):
+        rng = np.random.default_rng(3)
+        # n chosen so n % 8 != 0: the 8-device test mesh zero-pads labels
+        n = 1001
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        y = (rng.normal(size=n) + 50.0).astype(np.float32)  # far from 0
+        b = train_booster(X, y, objective=objective, num_iterations=1,
+                          cfg=GrowConfig(num_leaves=4), max_bin=15)
+        # an unweighted median over zero-padded labels would sit far below
+        # the data median; the weighted quantile must stay inside the data
+        assert 48.0 < float(b.base_score[0]) < 52.0
+
+    def test_weighted_quantile_matches_numpy(self):
+        from mmlspark_tpu.models.gbdt.objectives import weighted_quantile
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        y = rng.normal(size=501).astype(np.float32)
+        w = np.ones(501, np.float32)
+        got = float(weighted_quantile(jnp.asarray(y), jnp.asarray(w), 0.5))
+        assert abs(got - float(np.median(y))) < 1e-5
+        # zero-weight entries must not move the quantile
+        y2 = np.concatenate([y, np.full(100, -1e6, np.float32)])
+        w2 = np.concatenate([w, np.zeros(100, np.float32)])
+        got2 = float(weighted_quantile(jnp.asarray(y2), jnp.asarray(w2), 0.5))
+        assert abs(got2 - got) < 1e-5

@@ -302,3 +302,26 @@ class TestLazyL1:
         train_sgd_checkpointed(idx, val, y, None, cfg2, d)
         w_resumed = train_sgd_checkpointed(idx, val, y, None, cfg, d)
         np.testing.assert_array_equal(w_direct, w_resumed)
+
+    def test_state_resume_across_l1_change_rebuilds_clock(self):
+        """A state saved under l1=0 carries a 1-element dummy clock; resuming
+        with l1>0 must expand it to a full per-feature clock (a clamped
+        1-element gather would silently share one clock slot)."""
+        import jax
+        from jax.sharding import Mesh
+        from mmlspark_tpu.models.vw.sgd import SGDConfig, train_sgd
+
+        one_dev = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        rng = np.random.default_rng(0)
+        n, nnz = 64, 2
+        idx = rng.integers(0, 256, (n, nnz)).astype(np.int32)
+        val = rng.normal(size=(n, nnz)).astype(np.float32)
+        y = rng.normal(size=n).astype(np.float32)
+        cfg0 = SGDConfig(num_bits=8, num_passes=1, batch_size=8, l1=0.0)
+        _, state = train_sgd(idx, val, y, None, cfg0, mesh=one_dev,
+                             return_state=True)
+        assert state[3].shape == (1,)  # dummy clock under l1=0
+        cfg1 = cfg0._replace(l1=1e-4)
+        w = train_sgd(idx, val, y, None, cfg1, mesh=one_dev,
+                      initial_state=state, return_state=True)[1]
+        assert w[3].shape == (256,)   # full clock rebuilt under l1>0
