@@ -1,0 +1,58 @@
+"""VW vs. LightGBM vs. linear least squares on one regression task.
+
+Mirrors the reference's "Regression - Vowpal Wabbit vs. LightGBM vs. Linear
+Regressor" notebook: train all three families on the same table, compare
+RMSE with ComputeModelStatistics, and show the expected ordering — the GBDT
+captures the nonlinearity, the two linear models tie on the linear part.
+"""
+
+import numpy as np
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.models.gbdt.api import LightGBMRegressor
+from mmlspark_tpu.models.vw import (VowpalWabbitFeaturizer,
+                                    VowpalWabbitRegressor)
+from mmlspark_tpu.train.core import ComputeModelStatistics
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n = 3000
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (2 * X[:, 0] - X[:, 1] + 1.5 * np.sin(3 * X[:, 2])
+         + rng.normal(scale=0.2, size=n)).astype(np.float32)
+
+    def rmse_of(out):
+        stats = ComputeModelStatistics(
+            labelCol="label", scoresCol="prediction",
+            evaluationMetric="regression").transform(out)
+        return float(np.asarray(stats["root_mean_squared_error"])[0])
+
+    ds = Dataset({"features": X, "label": y})
+    lgbm = LightGBMRegressor(numIterations=60, numLeaves=31,
+                             minDataInLeaf=10).fit(ds)
+    rmse_lgbm = rmse_of(lgbm.transform(ds))
+
+    cols = {f"x{i}": X[:, i] for i in range(6)}
+    cols["label"] = y
+    vds = VowpalWabbitFeaturizer(
+        inputCols=[f"x{i}" for i in range(6)],
+        outputCol="features").transform(Dataset(cols))
+    vw = VowpalWabbitRegressor(numPasses=10).fit(vds)
+    rmse_vw = rmse_of(vw.transform(vds))
+
+    # VW with --bfgs is this framework's batch linear least-squares leg
+    lin = VowpalWabbitRegressor(passThroughArgs="--bfgs",
+                                numPasses=30).fit(vds)
+    rmse_lin = rmse_of(lin.transform(vds))
+
+    print(f"RMSE  LightGBM={rmse_lgbm:.3f}  VW-SGD={rmse_vw:.3f}  "
+          f"linear(BFGS)={rmse_lin:.3f}")
+    # the tree model must beat both linear models on the sin() component
+    assert rmse_lgbm < rmse_vw and rmse_lgbm < rmse_lin
+    # both linear fits land near the irreducible linear-model error
+    assert abs(rmse_vw - rmse_lin) < 0.3
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Learning to rank with LightGBMRanker (LambdaRank).
+
+The reference's ranker (lightgbm/LightGBMRanker.scala, group handling
+LightGBMRanker.scala:80-98): graded relevance labels inside query groups,
+pairwise LambdaRank gradients over fixed-size padded groups on TPU, and
+NDCG@k as the quality check.
+"""
+
+import numpy as np
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.models.gbdt.api import LightGBMRanker
+
+
+def ndcg_at_k(scores, rel, groups, k=5):
+    vals = []
+    for g in np.unique(groups):
+        m = groups == g
+        order = np.argsort(-scores[m])
+        gains = (2.0 ** rel[m][order][:k] - 1)
+        disc = 1.0 / np.log2(np.arange(2, len(gains) + 2))
+        ideal = (2.0 ** np.sort(rel[m])[::-1][:k] - 1)
+        denom = (ideal * disc[:len(ideal)]).sum()
+        vals.append((gains * disc).sum() / max(denom, 1e-9))
+    return float(np.mean(vals))
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n_q, per_q = 40, 12
+    X, rel, grp = [], [], []
+    for q in range(n_q):
+        docs = rng.normal(size=(per_q, 6)).astype(np.float32)
+        # relevance driven by two features, observed with noise
+        r = docs[:, 0] + 0.5 * docs[:, 1] + rng.normal(scale=0.3, size=per_q)
+        graded = np.digitize(r, np.quantile(r, [0.5, 0.75, 0.9]))
+        X.append(docs)
+        rel.append(graded.astype(np.float32))
+        grp.append(np.full(per_q, q, np.int32))
+    X = np.concatenate(X)
+    rel = np.concatenate(rel)
+    grp = np.concatenate(grp)
+    ds = Dataset({"features": X, "label": rel, "group": grp})
+
+    model = LightGBMRanker(numIterations=40, numLeaves=15, minDataInLeaf=5,
+                           groupCol="group").fit(ds)
+    scores = model.transform(ds).array("prediction")
+    ndcg = ndcg_at_k(scores, rel, grp, k=5)
+    rand = ndcg_at_k(rng.normal(size=len(rel)).astype(np.float32), rel, grp)
+    print(f"LambdaRank ndcg@5={ndcg:.3f} (random={rand:.3f})")
+    assert ndcg > rand + 0.15
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Composable image transformation pipeline (OpenCV-stage parity).
+
+Mirrors the reference's "OpenCV - Pipeline Image Transformations" notebook
+(opencv/ImageTransformer.scala:41-219): chain resize -> crop -> blur ->
+threshold -> flip on an image column with the fluent stage API; the ops run
+as vectorized numpy/jax on the host feeding device arrays, not JNI OpenCV.
+"""
+
+import numpy as np
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.image.ops import ImageTransformer
+
+
+def main():
+    rng = np.random.default_rng(0)
+    # synthetic "photos": bright square on dark background, random offsets
+    imgs = []
+    for _ in range(8):
+        img = np.zeros((64, 48, 3), np.uint8)
+        x0, y0 = rng.integers(5, 20, 2)
+        img[y0:y0 + 24, x0:x0 + 16] = rng.integers(160, 255, 3)
+        imgs.append(img)
+    ds = Dataset({"image": imgs})
+
+    t = (ImageTransformer(inputCol="image", outputCol="out")
+         .resize(height=32, width=32)
+         .crop(x=4, y=4, height=24, width=24)
+         .gaussian_blur(ksize=3, sigma=1.0)
+         .threshold(threshold=100.0, max_val=255.0)
+         .flip(flip_code=1))
+    out = t.transform(ds)
+
+    shapes = {o.shape for o in out["out"]}
+    print("output shapes:", shapes)
+    assert shapes == {(24, 24, 3)}
+    # threshold binarizes: only {0, 255} survive
+    vals = np.unique(np.concatenate([o.reshape(-1) for o in out["out"]]))
+    assert set(vals.tolist()) <= {0.0, 255.0}
+    print("pipeline ok: resize->crop->blur->threshold->flip")
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,11 @@ from ...core.pipeline import Estimator, Model
 from ...utils.stopwatch import StopWatch
 from .sgd import SGDConfig, predict_sgd, train_sgd
 
+# VW's hardcoded intercept ("constant") feature index — every example gets
+# it unless --noconstant (reference: the vw core's `constant` symbol; the
+# JNI learners inherit it from libvw)
+VW_CONSTANT_INDEX = 11650396
+
 
 class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
                               HasPredictionCol):
@@ -47,6 +52,8 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
                       "(1 = strict online order)", 128, TypeConverters.to_int)
     passThroughArgs = Param("passThroughArgs", "VW-style argument string", "",
                             TypeConverters.to_string)
+    noConstant = Param("noConstant", "Drop VW's implicit intercept feature "
+                       "(--noconstant)", False, TypeConverters.to_bool)
     initialModel = Param("initialModel", "Warm-start weights", None, is_complex=True)
     checkpointDir = Param("checkpointDir",
                           "Pass-level checkpoint directory: each finished "
@@ -115,8 +122,20 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
 
     def _features(self, dataset: Dataset):
         base = self.get_or_default("featuresCol")
-        return (dataset.array(f"{base}_indices", np.int32),
-                dataset.array(f"{base}_values", np.float32))
+        idx = dataset.array(f"{base}_indices", np.int32)
+        val = dataset.array(f"{base}_values", np.float32)
+        no_const = (self.get_or_default("noConstant")
+                    or "--noconstant" in self.get_or_default("passThroughArgs"))
+        if not no_const:
+            # VW adds an implicit intercept ("constant") feature to every
+            # example at its hardcoded index (vw's `constant = 11650396`),
+            # folded by the same 2^b weight-table mask as everything else.
+            # Shared by fit and transform so feature identity always agrees.
+            n = idx.shape[0]
+            idx = np.concatenate(
+                [idx, np.full((n, 1), VW_CONSTANT_INDEX, np.int32)], axis=1)
+            val = np.concatenate([val, np.ones((n, 1), np.float32)], axis=1)
+        return idx, val
 
     def _fit_weights(self, dataset: Dataset, cfg: SGDConfig):
         idx, val = self._features(dataset)
@@ -187,7 +206,11 @@ class _VowpalWabbitModelBase(Model, _VowpalWabbitBaseParams):
 
     def _save_extra(self, path: str) -> None:
         import os
+        # format marker v2: weights were trained WITH the implicit constant
+        # feature (unless noConstant); its absence on load identifies models
+        # saved before the constant feature existed
         np.savez_compressed(os.path.join(path, "weights"), w=self.weights,
+                            vw_format=np.asarray(2),
                             **{f"stat_{k}": np.asarray(v) for k, v in self.stats.items()})
 
     def _load_extra(self, path: str) -> None:
@@ -195,6 +218,11 @@ class _VowpalWabbitModelBase(Model, _VowpalWabbitBaseParams):
         z = np.load(os.path.join(path, "weights.npz"))
         self.weights = z["w"]
         self.stats = {k[5:]: z[k].item() for k in z.files if k.startswith("stat_")}
+        if "vw_format" not in z.files:
+            # pre-constant-feature model: scoring must not append a feature
+            # the training run never saw (its hash slot holds an unrelated
+            # colliding weight)
+            self.set(noConstant=True)
 
 
 class VowpalWabbitClassifier(Estimator, _VowpalWabbitBaseParams,

@@ -193,3 +193,29 @@ class TestQuantizedHistogram:
                               cfg=cfg, max_bin=63, bin_sample_count=3000)
             accs[quant] = ((b.predict(X) > 0.5) == y).mean()
         assert accs[True] >= accs[False] - 0.01, accs
+
+
+def test_wide_feature_fori_path_matches_xla(monkeypatch):
+    """Above _UNROLL_MAX feature groups the kernel keeps a dynamic loop;
+    pin the wide path against the XLA fallback through the interpreter."""
+    monkeypatch.setenv("MMLSPARK_TPU_PALLAS_INTERPRET", "1")
+    import importlib
+
+    from mmlspark_tpu.ops import histogram as H
+    importlib.reload(H)
+    try:
+        rng = np.random.default_rng(0)
+        F, n, B, W = 130, 512, 255, 3    # P=1: 130 groups > _UNROLL_MAX
+        assert F // H._bin_packing(B)[1] > H._UNROLL_MAX
+        bt = jnp.asarray(rng.integers(0, B, (F, n)), dtype=jnp.int32)
+        pos = jnp.asarray(rng.integers(-1, W, n), dtype=jnp.int32)
+        base = jnp.asarray(rng.normal(size=(3, n)).astype(np.float32))
+        got = np.asarray(H.node_histogram(bt, pos, base, W, B))
+        monkeypatch.setenv("MMLSPARK_TPU_DISABLE_PALLAS_HIST", "1")
+        importlib.reload(H)
+        want = np.asarray(H.node_histogram(bt, pos, base, W, B))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    finally:
+        monkeypatch.delenv("MMLSPARK_TPU_PALLAS_INTERPRET")
+        monkeypatch.delenv("MMLSPARK_TPU_DISABLE_PALLAS_HIST", raising=False)
+        importlib.reload(H)
