@@ -5,22 +5,25 @@ All services compose the io.http machinery; see base.CognitiveServicesBase.
 
 from .base import (CognitiveServicesBase, PollingCognitiveService,
                    ServiceParam)
-from .services import (OCR, NER, AnalyzeImage, AzureSearchWriter,
-                       BingImageSearch, DescribeImage, DetectAnomalies,
-                       DetectFace, DetectLastAnomaly, EntityDetector,
-                       FindSimilarFace, GenerateThumbnails, GroupFaces,
-                       IdentifyFaces, KeyPhraseExtractor, LanguageDetector,
+from .services import (OCR, NER, AddDocuments, AnalyzeImage,
+                       AzureSearchWriter, BingImageSearch, DescribeImage,
+                       DetectAnomalies, DetectFace, DetectLastAnomaly,
+                       EntityDetector, EntityDetectorV2, FindSimilarFace,
+                       GenerateThumbnails, GroupFaces, IdentifyFaces,
+                       KeyPhraseExtractor, KeyPhraseExtractorV2,
+                       LanguageDetector, LanguageDetectorV2, NERV2,
                        RecognizeDomainSpecificContent, RecognizeText,
                        SimpleDetectAnomalies, SpeechToText, TagImage,
-                       TextSentiment, VerifyFaces)
+                       TextSentiment, TextSentimentV2, VerifyFaces)
 
 __all__ = [
-    "AnalyzeImage", "AzureSearchWriter", "BingImageSearch",
+    "AddDocuments", "AnalyzeImage", "AzureSearchWriter", "BingImageSearch",
     "CognitiveServicesBase", "DescribeImage", "DetectAnomalies", "DetectFace",
-    "DetectLastAnomaly", "EntityDetector", "FindSimilarFace",
+    "DetectLastAnomaly", "EntityDetector", "EntityDetectorV2", "FindSimilarFace",
     "GenerateThumbnails", "GroupFaces", "IdentifyFaces", "KeyPhraseExtractor",
-    "LanguageDetector", "NER", "OCR", "PollingCognitiveService",
+    "KeyPhraseExtractorV2", "LanguageDetector", "LanguageDetectorV2", "NER",
+    "NERV2", "OCR", "PollingCognitiveService",
     "RecognizeDomainSpecificContent", "RecognizeText", "ServiceParam",
     "SimpleDetectAnomalies", "SpeechToText", "TagImage", "TextSentiment",
-    "VerifyFaces",
+    "TextSentimentV2", "VerifyFaces",
 ]

@@ -12,6 +12,8 @@ retries, error column, polling — is inherited.
 from __future__ import annotations
 
 import json
+
+import numpy as np
 from typing import Any, Dict, List, Optional
 
 from ..core.dataset import Dataset
@@ -133,10 +135,22 @@ class RecognizeDomainSpecificContent(_VisionBase):
 
 
 class _TextAnalyticsBase(CognitiveServicesBase):
-    """Documents-array request shape shared by all text services."""
+    """Documents-array request shape shared by all text services.
+
+    ``_ta_version``/``_ta_path`` drive the region-shortcut URL the same way
+    the reference's per-class setUrl templates do
+    (cognitive/TextAnalytics.scala:177-325): unversioned classes target
+    v3.0, the *V2 variants keep the v2.0-era endpoints.
+    """
 
     text = ServiceParam("text", "document text", is_required=True)
     language = ServiceParam("language", "document language")
+    _ta_version = "v3.0"
+    _ta_path = ""
+
+    def _uri_from_location(self, loc: str) -> str:
+        return (f"https://{loc}.api.cognitive.microsoft.com/text/analytics/"
+                f"{self._ta_version}/{self._ta_path}")
 
     def build_request(self, rp: Dict[str, Any]) -> HTTPRequestData:
         texts = rp["text"]
@@ -154,18 +168,20 @@ class _TextAnalyticsBase(CognitiveServicesBase):
 
 
 class TextSentiment(_TextAnalyticsBase):
-    pass
+    _ta_path = "sentiment"
 
 
 class KeyPhraseExtractor(_TextAnalyticsBase):
-    pass
+    _ta_path = "keyPhrases"
 
 
 class NER(_TextAnalyticsBase):
-    pass
+    _ta_path = "entities/recognition/general"
 
 
 class LanguageDetector(_TextAnalyticsBase):
+    _ta_path = "languages"
+
     def build_request(self, rp):
         texts = rp["text"]
         if isinstance(texts, str):
@@ -178,7 +194,29 @@ class LanguageDetector(_TextAnalyticsBase):
 
 
 class EntityDetector(_TextAnalyticsBase):
-    pass
+    _ta_path = "entities/linking"
+
+
+class TextSentimentV2(TextSentiment):
+    _ta_version = "v2.0"
+
+
+class KeyPhraseExtractorV2(KeyPhraseExtractor):
+    _ta_version = "v2.0"
+
+
+class NERV2(NER):
+    _ta_version = "v2.1"
+    _ta_path = "entities"
+
+
+class LanguageDetectorV2(LanguageDetector):
+    _ta_version = "v2.0"
+
+
+class EntityDetectorV2(EntityDetector):
+    _ta_version = "v2.0"
+    _ta_path = "entities"
 
 
 # ---------------------------------------------------------------------------
@@ -361,6 +399,94 @@ class BingImageSearch(CognitiveServicesBase):
         return Dataset({url_col: urls, "sourceRow": src})
 
 
+def _search_upload_batch(url: str, headers: Dict[str, str],
+                         docs: List[Dict[str, Any]], timeout: float,
+                         what: str) -> int:
+    """POST one document batch to a search index; shared by AddDocuments and
+    AzureSearchWriter so the wire contract lives in exactly one place."""
+    resp = advanced_handling(
+        HTTPRequestData(url=url, method="POST", headers=headers,
+                        entity=json.dumps({"value": docs}).encode()),
+        timeout=timeout)
+    if not (200 <= resp.status_code < 300):
+        raise IOError(f"{what} failed: {resp.status_code} {resp.text}")
+    return resp.status_code
+
+
+class AddDocuments(CognitiveServicesBase):
+    """Batched document upload to an Azure Search index as a pipeline stage
+    (reference: cognitive/AzureSearch.scala:84-120 — batch rows, rename the
+    action column to @search.action, POST to /docs/index with the api-key
+    header). The fluent AzureSearchWriter below wraps this flow for whole
+    datasets; this stage form composes inside pipelines.
+
+    Batches upload sequentially and in order (the inherited ``concurrency``
+    param does not apply: interleaved index actions would reorder
+    upload/merge/delete semantics). With ``errorCol`` set, a failed batch
+    records the error on its rows and later batches still upload; without
+    it the first failure raises."""
+
+    serviceName = Param("serviceName", "search service name", None,
+                        TypeConverters.to_string)
+    indexName = Param("indexName", "target index", None,
+                      TypeConverters.to_string)
+    actionCol = Param("actionCol", "per-row action column",
+                      "@search.action", TypeConverters.to_string)
+    batchSize = Param("batchSize", "documents per request", 100,
+                      TypeConverters.to_int)
+
+    subscription_key_header = "api-key"
+
+    def _uri_from_location(self, loc: str) -> str:  # serviceName, not region
+        index = self.get_or_default("indexName")
+        if not index:
+            raise ValueError("AddDocuments needs indexName= before the url "
+                             "can be derived from serviceName")
+        return (f"https://{loc}.search.windows.net/indexes/{index}"
+                "/docs/index?api-version=2019-05-06")
+
+    def auth_headers(self):
+        key = self.get_or_default("subscriptionKey")
+        h = {"Content-Type": "application/json"}
+        if key:
+            h[self.subscription_key_header] = key
+        return h
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        url = self.get_or_default("url")
+        if not url:
+            svc = self.get_or_default("serviceName")
+            if not svc:
+                raise ValueError("set url= or serviceName= + indexName=")
+            url = self._uri_from_location(svc)
+        action_col = self.get_or_default("actionCol")
+        err_col = self.get_if_set("errorCol")
+        statuses, errors = [], []
+        for batch in dataset.batches(self.get_or_default("batchSize")):
+            docs = []
+            for row in batch.to_rows():
+                doc = {k: to_jsonable(v) for k, v in row.items()
+                       if k != action_col}
+                doc["@search.action"] = row.get(action_col, "upload") \
+                    if action_col in batch.columns else "upload"
+                docs.append(doc)
+            try:
+                code = _search_upload_batch(
+                    url, self.auth_headers(), docs,
+                    self.get_or_default("timeout"), "AddDocuments")
+                statuses.extend([code] * len(docs))
+                errors.extend([None] * len(docs))
+            except IOError as e:
+                if err_col is None:
+                    raise
+                statuses.extend([-1] * len(docs))
+                errors.extend([str(e)] * len(docs))
+        out = dataset.with_column("status", np.asarray(statuses, np.int64))
+        if err_col is not None:
+            out = out.with_column(err_col, errors)
+        return out
+
+
 class AzureSearchWriter:
     """Push a Dataset into a search index in batches
     (AzureSearch.scala AzureSearchWriter + AzureSearchAPI index mgmt)."""
@@ -399,13 +525,7 @@ class AzureSearchWriter:
         for batch in dataset.batches(self.batch_size):
             docs = [{**{k: to_jsonable(v) for k, v in row.items()},
                      "@search.action": action} for row in batch.to_rows()]
-            body = json.dumps({"value": docs}).encode()
-            resp = advanced_handling(
-                HTTPRequestData(url=url, method="POST",
-                                headers=self._headers(), entity=body),
-                timeout=self.timeout)
-            if not (200 <= resp.status_code < 300):
-                raise IOError(
-                    f"search write failed: {resp.status_code} {resp.text}")
+            _search_upload_batch(url, self._headers(), docs, self.timeout,
+                                 "search write")
             written += len(docs)
         return written

@@ -125,7 +125,8 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
         idx = dataset.array(f"{base}_indices", np.int32)
         val = dataset.array(f"{base}_values", np.float32)
         no_const = (self.get_or_default("noConstant")
-                    or "--noconstant" in self.get_or_default("passThroughArgs"))
+                    or "--noconstant" in shlex.split(
+                        self.get_or_default("passThroughArgs")))
         if not no_const:
             # VW adds an implicit intercept ("constant") feature to every
             # example at its hardcoded index (vw's `constant = 11650396`),

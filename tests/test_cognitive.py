@@ -283,3 +283,44 @@ def test_cognitive_persistence_roundtrip(tmp_path, base):
     t2 = load_stage(str(tmp_path / "s"))
     out = t2.transform(Dataset({"txt": ["good stuff"]}))
     assert out["s"][0]["documents"][0]["score"] == 0.9
+
+
+def test_text_analytics_url_templates():
+    """set_location fills the per-class endpoint exactly as the reference's
+    setUrl templates (TextAnalytics.scala:177-325): v3.0 for the current
+    classes, v2.0/v2.1 for the *V2 variants."""
+    from mmlspark_tpu.cognitive import (NER, NERV2, EntityDetector,
+                                        EntityDetectorV2, TextSentiment,
+                                        TextSentimentV2)
+    base = "https://eastus.api.cognitive.microsoft.com/text/analytics"
+    cases = [
+        (TextSentiment, f"{base}/v3.0/sentiment"),
+        (TextSentimentV2, f"{base}/v2.0/sentiment"),
+        (NER, f"{base}/v3.0/entities/recognition/general"),
+        (NERV2, f"{base}/v2.1/entities"),
+        (EntityDetector, f"{base}/v3.0/entities/linking"),
+        (EntityDetectorV2, f"{base}/v2.0/entities"),
+    ]
+    for cls, want in cases:
+        t = cls().set_location("eastus")
+        assert t.get_or_default("url") == want, cls.__name__
+
+
+def test_add_documents_stage(base):
+    from mmlspark_tpu.cognitive import AddDocuments
+    _Mock.uploaded.clear()
+    ds = Dataset({"id": ["1", "2", "3"], "score": [0.1, 0.2, 0.3]})
+    stage = (AddDocuments(indexName="idx", batchSize=2)
+             .set_subscription_key("secret")
+             .set_url(f"{base}/search/indexes/idx/docs/index"
+                      "?api-version=2019-05-06"))
+    out = stage.transform(ds)
+    assert list(out["status"]) == [200, 200, 200]
+    assert len(_Mock.uploaded) == 3
+    assert all(d["@search.action"] == "upload" for d in _Mock.uploaded)
+
+    # explicit per-row actions ride the action column
+    _Mock.uploaded.clear()
+    ds2 = Dataset({"id": ["9"], "@search.action": ["merge"]})
+    stage.transform(ds2)
+    assert _Mock.uploaded[0]["@search.action"] == "merge"

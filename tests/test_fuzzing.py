@@ -400,12 +400,14 @@ def build_registry():
 # callables: save/load param round-trip only (the reference likewise keys its
 # live cognitive tests off env secrets and exempts them from generic fuzzing).
 PARAM_ONLY = {
-    "AnalyzeImage", "BingImageSearch", "DescribeImage", "DetectAnomalies",
-    "DetectFace", "DetectLastAnomaly", "EntityDetector", "FindSimilarFace",
-    "GenerateThumbnails", "GroupFaces", "IdentifyFaces", "KeyPhraseExtractor",
-    "LanguageDetector", "NER", "OCR", "RecognizeDomainSpecificContent",
-    "RecognizeText", "SimpleDetectAnomalies", "SpeechToText", "TagImage",
-    "TextSentiment", "VerifyFaces",
+    "AddDocuments", "AnalyzeImage", "BingImageSearch", "DescribeImage",
+    "DetectAnomalies", "DetectFace", "DetectLastAnomaly", "EntityDetector",
+    "EntityDetectorV2", "FindSimilarFace", "GenerateThumbnails", "GroupFaces",
+    "IdentifyFaces", "KeyPhraseExtractor", "KeyPhraseExtractorV2",
+    "LanguageDetector", "LanguageDetectorV2", "NER", "NERV2", "OCR",
+    "RecognizeDomainSpecificContent", "RecognizeText", "SimpleDetectAnomalies",
+    "SpeechToText", "TagImage", "TextSentiment", "TextSentimentV2",
+    "VerifyFaces",
 }
 
 EXEMPT = {
