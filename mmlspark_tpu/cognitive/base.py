@@ -120,11 +120,15 @@ class CognitiveServicesBase(Transformer, _HasServiceParams, HasOutputCol,
         raise NotImplementedError(f"{type(self).__name__} has no uri template")
 
     # -- request construction ------------------------------------------------
+    # services with a non-Azure-cognitive auth header (e.g. search's api-key)
+    # override the attribute, not the method
+    subscription_key_header = "Ocp-Apim-Subscription-Key"
+
     def auth_headers(self) -> Dict[str, str]:
         key = self.get_or_default("subscriptionKey")
         h = {"Content-Type": "application/json"}
         if key:
-            h["Ocp-Apim-Subscription-Key"] = key
+            h[self.subscription_key_header] = key
         return h
 
     def build_request(self, row_params: Dict[str, Any]) -> HTTPRequestData:

@@ -422,9 +422,9 @@ class AddDocuments(CognitiveServicesBase):
 
     Batches upload sequentially and in order (the inherited ``concurrency``
     param does not apply: interleaved index actions would reorder
-    upload/merge/delete semantics). With ``errorCol`` set, a failed batch
-    records the error on its rows and later batches still upload; without
-    it the first failure raises."""
+    upload/merge/delete semantics). A failed batch records the error on its
+    rows in ``errorCol`` (default "errors", like every cognitive stage) and
+    later batches still upload; set errorCol=None to fail fast instead."""
 
     serviceName = Param("serviceName", "search service name", None,
                         TypeConverters.to_string)
@@ -445,13 +445,6 @@ class AddDocuments(CognitiveServicesBase):
         return (f"https://{loc}.search.windows.net/indexes/{index}"
                 "/docs/index?api-version=2019-05-06")
 
-    def auth_headers(self):
-        key = self.get_or_default("subscriptionKey")
-        h = {"Content-Type": "application/json"}
-        if key:
-            h[self.subscription_key_header] = key
-        return h
-
     def transform(self, dataset: Dataset) -> Dataset:
         url = self.get_or_default("url")
         if not url:
@@ -460,15 +453,16 @@ class AddDocuments(CognitiveServicesBase):
                 raise ValueError("set url= or serviceName= + indexName=")
             url = self._uri_from_location(svc)
         action_col = self.get_or_default("actionCol")
-        err_col = self.get_if_set("errorCol")
+        # default errorCol ("errors", inherited) records failures like every
+        # other cognitive stage; explicitly unset it to fail fast instead
+        err_col = self.get_or_default("errorCol")
         statuses, errors = [], []
         for batch in dataset.batches(self.get_or_default("batchSize")):
             docs = []
             for row in batch.to_rows():
                 doc = {k: to_jsonable(v) for k, v in row.items()
                        if k != action_col}
-                doc["@search.action"] = row.get(action_col, "upload") \
-                    if action_col in batch.columns else "upload"
+                doc["@search.action"] = row.get(action_col, "upload")
                 docs.append(doc)
             try:
                 code = _search_upload_batch(

@@ -143,15 +143,20 @@ class TrainedRegressorModel(Model, HasLabelCol):
 # ---------------------------------------------------------------------------
 
 
-def _roc_curve(y: np.ndarray, score: np.ndarray):
+def _ranked_counts(y: np.ndarray, score: np.ndarray):
+    """Cumulative true-positive counts at each DISTINCT threshold — tied
+    scores must move together, else curve areas become order-dependent and
+    biased. Shared spine of the ROC and PR curves. Returns (idx, tps,
+    thresholds) with idx the 0-based rank of each threshold's last row."""
     order = np.argsort(-score, kind="stable")
-    y = y[order]
-    s = score[order]
-    # one ROC point per DISTINCT threshold — tied scores must move together,
-    # else AUC becomes order-dependent and biased
-    boundary = np.nonzero(np.diff(s))[0]
-    idx = np.concatenate([boundary, [len(y) - 1]])
-    tps = np.cumsum(y)[idx]
+    ys, ss = y[order], score[order]
+    boundary = np.nonzero(np.diff(ss))[0]
+    idx = np.concatenate([boundary, [len(ys) - 1]])
+    return idx, np.cumsum(ys)[idx], ss[idx]
+
+
+def _roc_curve(y: np.ndarray, score: np.ndarray):
+    idx, tps, _ = _ranked_counts(y, score)
     fps = (idx + 1) - tps
     P, N = max(tps[-1], 1e-12), max(fps[-1], 1e-12)
     tpr = np.concatenate([[0.0], tps / P])
@@ -161,6 +166,17 @@ def _roc_curve(y: np.ndarray, score: np.ndarray):
 
 def _auc(fpr: np.ndarray, tpr: np.ndarray) -> float:
     return float(np.trapezoid(tpr, fpr))
+
+
+def _pr_curve(y: np.ndarray, score: np.ndarray):
+    """(precision, recall, thresholds) — one point per distinct threshold,
+    prepended with the (recall=0, precision=1) anchor Spark's
+    BinaryClassificationMetrics.pr() uses."""
+    idx, tps, thresholds = _ranked_counts(y, score)
+    P = max(tps[-1], 1e-12)
+    return (np.concatenate([[1.0], tps / (idx + 1)]),
+            np.concatenate([[0.0], tps / P]),
+            thresholds)
 
 
 class ComputeModelStatistics(Transformer):
@@ -175,9 +191,12 @@ class ComputeModelStatistics(Transformer):
                       TypeConverters.to_string)
     scoredLabelsCol = Param("scoredLabelsCol", "prediction column", "prediction",
                             TypeConverters.to_string)
-    # confusion matrix made available after transform (reference exposes it too)
+    # curves/tables made available after transform (reference exposes its
+    # confusion matrix and the Spark metric objects' curves the same way)
     confusion_matrix: Optional[np.ndarray] = None
     roc_curve: Optional[Dataset] = None
+    pr_curve: Optional[Dataset] = None
+    threshold_metrics: Optional[Dataset] = None
 
     def _is_classification(self, y: np.ndarray) -> bool:
         metric = self.get_or_default("evaluationMetric")
@@ -197,14 +216,22 @@ class ComputeModelStatistics(Transformer):
                 cm[t, p] += 1
             self.confusion_matrix = cm
             acc = float((y == pred).mean())
-            # macro precision/recall (reference reports weighted variants too)
+            # macro + class-frequency-weighted precision/recall (parity with
+            # the MulticlassMetrics the reference delegates to —
+            # ComputeModelStatistics.scala:56-466 reports weightedPrecision/
+            # weightedRecall alongside the unweighted variants)
             with np.errstate(invalid="ignore", divide="ignore"):
                 prec_k = np.diag(cm) / np.maximum(cm.sum(axis=0), 1)
                 rec_k = np.diag(cm) / np.maximum(cm.sum(axis=1), 1)
+            freq = cm.sum(axis=1) / max(cm.sum(), 1)
             out = {
                 "accuracy": np.asarray([acc]),
                 "precision": np.asarray([float(np.nanmean(prec_k))]),
                 "recall": np.asarray([float(np.nanmean(rec_k))]),
+                "weighted_precision": np.asarray(
+                    [float(np.nansum(prec_k * freq))]),
+                "weighted_recall": np.asarray(
+                    [float(np.nansum(rec_k * freq))]),
             }
             scol = self.get_or_default("scoresCol")
             if k == 2 and scol in dataset:
@@ -214,6 +241,16 @@ class ComputeModelStatistics(Transformer):
                 out["AUC"] = np.asarray([_auc(fpr, tpr)])
                 self.roc_curve = Dataset({"false_positive_rate": fpr,
                                           "true_positive_rate": tpr})
+                # precision-recall curve + per-threshold table
+                # (BinaryClassificationMetrics parity: pr(), thresholds())
+                prec_c, rec_c, thr_c = _pr_curve(y, p1)
+                out["AUPR"] = np.asarray([float(np.trapezoid(prec_c, rec_c))])
+                self.pr_curve = Dataset({"recall": rec_c,
+                                         "precision": prec_c})
+                self.threshold_metrics = Dataset({
+                    "threshold": thr_c,
+                    "precision": prec_c[1:],
+                    "recall": rec_c[1:]})
             return Dataset(out)
         # regression
         err = pred - y
