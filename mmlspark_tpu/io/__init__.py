@@ -10,6 +10,7 @@ from .http import (AsyncHTTPClient, CustomInputParser, CustomOutputParser,
                    SharedVariable, SimpleHTTPTransformer,
                    SingleThreadedHTTPClient, StringOutputParser,
                    advanced_handling, send_request)
+from .port_forwarding import PortForwarder, ssh_forward
 from .powerbi import PowerBIWriter, write_to_powerbi
 from .serving import (ServedRequest, ServingBuilder, ServingQuery,
                       ServingServer, make_reply, requests_to_dataset, serve)
@@ -18,9 +19,10 @@ __all__ = [
     "AsyncHTTPClient", "CustomInputParser", "CustomOutputParser",
     "HTTPRequestData", "HTTPResponseData", "HTTPTransformer",
     "JSONInputParser", "JSONOutputParser", "PartitionConsolidator",
-    "PowerBIWriter", "ServedRequest", "ServingBuilder", "ServingQuery",
+    "PortForwarder", "PowerBIWriter", "ServedRequest", "ServingBuilder", "ServingQuery",
     "ServingServer", "SharedVariable", "SimpleHTTPTransformer",
     "SingleThreadedHTTPClient", "StringOutputParser", "advanced_handling",
     "make_reply", "read_binary_file", "read_binary_files",
-    "requests_to_dataset", "send_request", "serve", "write_to_powerbi",
+    "requests_to_dataset", "send_request", "serve", "ssh_forward",
+    "write_to_powerbi",
 ]
