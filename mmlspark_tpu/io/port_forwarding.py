@@ -66,6 +66,15 @@ class PortForwarder:
                 client.close()
                 continue
             with self._lock:
+                # a connection accepted in the closing window must not
+                # outlive stop(): the stop flag and the registry are checked
+                # and updated under one lock, so either stop() sees this
+                # pair in _conns and severs it, or we see the flag and drop
+                # the pair before any pump starts
+                if self._stop.is_set():
+                    client.close()
+                    upstream.close()
+                    return
                 self._conns |= {client, upstream}
             for a, b in ((client, upstream), (upstream, client)):
                 threading.Thread(target=self._pump, args=(a, b),

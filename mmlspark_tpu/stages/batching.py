@@ -135,3 +135,156 @@ class PadBatch(Transformer):
             masks.append(mask)
         new_cols[self.get_or_default("maskCol")] = masks
         return Dataset(new_cols)
+
+
+# ---------------------------------------------------------------------------
+# Iterator-level batchers (reference: stages/Batchers.scala:12-131) — the
+# machinery under the transformers above, exposed for streaming/serving
+# consumers that pull from live iterators rather than materialized Datasets.
+# ---------------------------------------------------------------------------
+
+
+def fixed_batches(it, batch_size: int):
+    """Plain chunking (FixedBatcher): yield lists of up to ``batch_size``."""
+    batch = []
+    for x in it:
+        batch.append(x)
+        if len(batch) >= batch_size:
+            yield batch
+            batch = []
+    if batch:
+        yield batch
+
+
+class _QueueFeeder:
+    """Background producer draining an iterator into a bounded queue.
+
+    One scaffold shared by every buffered batcher, carrying the three
+    lifecycle guarantees the naive thread-plus-sentinel pattern lacks:
+    a source-iterator exception is re-raised in the CONSUMER (not lost with
+    the producer thread, which would hang the consumer forever); a consumer
+    that abandons the generator unblocks the producer (no thread pinned on
+    a full queue for the life of the process); and the queue is always
+    bounded, so a slow consumer exerts backpressure instead of buffering
+    the whole source.
+    """
+
+    END = object()
+
+    def __init__(self, it, maxsize: int):
+        import queue
+        import threading
+        self.q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self._abandoned = threading.Event()
+        self._error = None
+        threading.Thread(target=self._run, args=(it,), daemon=True).start()
+
+    def _put(self, x) -> bool:
+        import queue
+        while not self._abandoned.is_set():
+            try:
+                self.q.put(x, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self, it) -> None:
+        try:
+            for x in it:
+                if not self._put(x):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+            self._error = e
+        self._put(self.END)
+
+    def close(self) -> None:
+        self._abandoned.set()
+
+    def finish(self) -> None:
+        """Call on END: re-raise the producer's exception, if any."""
+        if self._error is not None:
+            raise self._error
+
+
+def fixed_buffered_batches(it, batch_size: int, max_buffer: int = 8):
+    """FixedBufferedBatcher: a background thread keeps building fixed-size
+    batches into a bounded queue while the consumer processes the previous
+    one — producer-side latency overlaps consumer-side compute."""
+    feeder = _QueueFeeder(fixed_batches(it, batch_size), max_buffer)
+    try:
+        while True:
+            batch = feeder.q.get()
+            if batch is feeder.END:
+                feeder.finish()
+                return
+            yield batch
+    finally:
+        feeder.close()
+
+
+def dynamic_buffered_batches(it, max_buffer: int = 1024):
+    """DynamicBufferedBatcher: a background thread drains the iterator into
+    a buffer; each yielded batch is everything buffered since the consumer
+    last asked (>= 1 element). Fast consumers get small batches (low
+    latency), slow consumers get big ones (high throughput) — the dynamic
+    micro-batching policy the serving path uses."""
+    import queue
+
+    feeder = _QueueFeeder(it, max_buffer)
+    try:
+        while True:
+            first = feeder.q.get()
+            if first is feeder.END:
+                feeder.finish()
+                return
+            batch = [first]
+            while True:
+                try:
+                    x = feeder.q.get_nowait()
+                except queue.Empty:
+                    break
+                if x is feeder.END:
+                    yield batch
+                    feeder.finish()
+                    return
+                batch.append(x)
+            yield batch
+    finally:
+        feeder.close()
+
+
+def time_interval_batches(it, interval_ms: float, max_batch_size: int = 0,
+                          max_buffer: int = 1024):
+    """TimeIntervalBatcher: group everything arriving within each
+    ``interval_ms`` window (optionally capped at ``max_batch_size``)."""
+    import queue
+    import time as _time
+
+    feeder = _QueueFeeder(it, max_buffer)
+    batch: list = []
+    deadline = None
+    try:
+        while True:
+            timeout = (None if deadline is None
+                       else max(deadline - _time.monotonic(), 0))
+            try:
+                x = feeder.q.get(timeout=timeout)
+            except queue.Empty:
+                if batch:
+                    yield batch
+                batch, deadline = [], None
+                continue
+            if x is feeder.END:
+                if batch:
+                    yield batch
+                feeder.finish()
+                return
+            batch.append(x)
+            if deadline is None:
+                deadline = _time.monotonic() + interval_ms / 1000.0
+            if max_batch_size and len(batch) >= max_batch_size:
+                yield batch
+                batch, deadline = [], None
+    finally:
+        feeder.close()
