@@ -205,19 +205,6 @@ class TestIteratorBatchers:
         assert all(len(b) <= 4 for b in got)
 
 
-class TestUdfHelpers:
-    """udfs.scala parity: get_value_at / to_vector."""
-
-    def test_get_value_at_and_to_vector(self):
-        from mmlspark_tpu.core.dataset import Dataset
-        from mmlspark_tpu.stages.udfs import get_value_at, to_vector
-        ds = Dataset({"v": [[1.0, 2.0], [3.0, 4.0]]})
-        out = get_value_at(ds, "v", 1, "second")
-        np.testing.assert_array_equal(out["second"], [2.0, 4.0])
-        out2 = to_vector(ds, "v", "vec")
-        assert out2["vec"][0].dtype == np.float32
-        np.testing.assert_array_equal(out2["vec"][1], [3.0, 4.0])
-
     def test_buffered_batcher_propagates_producer_error(self):
         from mmlspark_tpu.stages.batching import (dynamic_buffered_batches,
                                                   fixed_buffered_batches)
@@ -253,3 +240,18 @@ class TestUdfHelpers:
         next(gen)
         gen.close()   # abandon early; feeder must unblock and drop source
         assert released.wait(timeout=5.0), "producer thread stayed blocked"
+
+
+class TestUdfHelpers:
+    """udfs.scala parity: get_value_at / to_vector."""
+
+    def test_get_value_at_and_to_vector(self):
+        from mmlspark_tpu.core.dataset import Dataset
+        from mmlspark_tpu.stages.udfs import get_value_at, to_vector
+        ds = Dataset({"v": [[1.0, 2.0], [3.0, 4.0]]})
+        out = get_value_at(ds, "v", 1, "second")
+        np.testing.assert_array_equal(out["second"], [2.0, 4.0])
+        out2 = to_vector(ds, "v", "vec")
+        assert out2["vec"][0].dtype == np.float32
+        np.testing.assert_array_equal(out2["vec"][1], [3.0, 4.0])
+
