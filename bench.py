@@ -138,9 +138,12 @@ def main() -> None:
             if dset is None:
                 raise RuntimeError("dataset construction failed")
             train_booster(dataset=dset, num_iterations=sec_iters, **kw)
-            t = time.perf_counter()
-            train_booster(dataset=dset, num_iterations=sec_iters, **kw)
-            return round(sec_iters / (time.perf_counter() - t), 3)
+            best = float("inf")
+            for _ in range(2):     # best-of-2: relay jitter (see above)
+                t = time.perf_counter()
+                train_booster(dataset=dset, num_iterations=sec_iters, **kw)
+                best = min(best, time.perf_counter() - t)
+            return round(sec_iters / best, 3)
 
         # secondaries must never kill the primary metric: report -1 on error
         return _guard(run, -1.0)
