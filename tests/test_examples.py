@@ -1,6 +1,9 @@
 """Every example script must run end-to-end (the reference's notebook-test
 leg: nbtest/NotebookTests.scala executes all sample notebooks)."""
 
+import pytest
+pytestmark = pytest.mark.examples
+
 import importlib.util
 import os
 import sys
