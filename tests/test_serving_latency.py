@@ -82,6 +82,15 @@ def serving_latency_stats(n_seq=200, n_conc=8, conc_each=50):
 
 
 def test_sequential_latency_does_not_pay_batch_deadline():
+    from conftest import flaky
+
+    @flaky(retries=3)
+    def check():
+        _check_latency()
+    check()
+
+
+def _check_latency():
     stats = serving_latency_stats(n_seq=150, n_conc=4, conc_each=25)
     # reference regime is ~1 ms; allow a loose CI multiple but a lone request
     # must clearly undercut request-rate * deadline behavior (5 ms deadline
