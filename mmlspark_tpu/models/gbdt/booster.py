@@ -1203,7 +1203,8 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
                  for k in range(K)], axis=1)
             vcontribs = lax.dynamic_update_slice(
                 vcontribs, vc[None], (it_i, 0, 0))
-        return contribs, vcontribs, trees_stacked
+        # one flat download buffer instead of 13 per-field transfers
+        return contribs, vcontribs, pack_trees(trees_stacked)
 
     def dart_eval_local(vcontribs, scales, vy, vw):
         sc2 = base_j[None, :] + jnp.einsum("t,tnk->nk", scales, vcontribs)
@@ -1277,14 +1278,16 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
         key = jax.random.fold_in(base_key, it)
         bag_step = it // max(bagging_freq, 1) if use_bagging else 0
         bag_key = jax.random.fold_in(base_key, 1_000_003 + bag_step)
-        contribs_d, vcontribs_new, trees_stacked = dstep(
+        contribs_d, vcontribs_new, trees_packed = dstep(
             Xbt_d, y_d, w_d, vmask_d, contribs_d, jnp.asarray(eff),
             Xvb_d if has_valid else dummy,
             vcontribs_d if has_valid else dummy,
             key, bag_key, np.int32(it))
         if has_valid:
             vcontribs_d = vcontribs_new
-        trees_host = jax.tree_util.tree_map(np.asarray, trees_stacked)
+        trees_host = unpack_trees(np.asarray(trees_packed), (K,),
+                                  2 * cfg.num_leaves - 1,
+                                  bitset_words(cfg.num_bins))
         for k in range(K):
             all_trees.append(jax.tree_util.tree_map(lambda a: a[k],
                                                     trees_host))

@@ -56,26 +56,3 @@ def mesh8():
 def rng():
     return np.random.default_rng(42)
 
-
-def flaky(retries: int = 3):
-    """Retry decorator for timing-sensitive tests (reference: the Flaky /
-    TimeLimitedFlaky traits, core/test/base/TestBase.scala:43-72 — whole-test
-    auto-retry rather than loosened assertions)."""
-    import functools
-    import time
-
-    def deco(fn):
-        @functools.wraps(fn)
-        def run(*args, **kwargs):
-            last = None
-            for attempt in range(retries):
-                try:
-                    return fn(*args, **kwargs)
-                except AssertionError as e:
-                    last = e
-                    time.sleep(0.5 * (attempt + 1))
-            raise last
-
-        return run
-
-    return deco

@@ -16,6 +16,7 @@ bench.py records the tight numbers on the bench host.
 
 import http.client
 import threading
+
 import time
 
 import numpy as np
@@ -81,16 +82,32 @@ def serving_latency_stats(n_seq=200, n_conc=8, conc_each=50):
         q.stop()
 
 
+def flaky(retries: int = 3):
+    """Retry decorator for timing-sensitive tests (reference: the Flaky /
+    TimeLimitedFlaky traits, core/test/base/TestBase.scala:43-72 — whole-test
+    auto-retry rather than loosened assertions). Lives here, not conftest:
+    bench.py imports this module outside pytest, where conftest isn't
+    importable."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def run(*args, **kwargs):
+            for attempt in range(retries):
+                try:
+                    return fn(*args, **kwargs)
+                except AssertionError:
+                    if attempt == retries - 1:
+                        raise
+                    time.sleep(0.5 * (attempt + 1))
+
+        return run
+
+    return deco
+
+
+@flaky(retries=3)
 def test_sequential_latency_does_not_pay_batch_deadline():
-    from conftest import flaky
-
-    @flaky(retries=3)
-    def check():
-        _check_latency()
-    check()
-
-
-def _check_latency():
     stats = serving_latency_stats(n_seq=150, n_conc=4, conc_each=25)
     # reference regime is ~1 ms; allow a loose CI multiple but a lone request
     # must clearly undercut request-rate * deadline behavior (5 ms deadline
