@@ -326,7 +326,7 @@ def _hist_dot_accumulate(o_ref, b_ref, sb, Fp: int, BP: int, P: int):
     acc = jnp.int32 if sb.dtype == jnp.int8 else jnp.float32
 
     groups = Fp // P
-    if groups > _UNROLL_MAX:
+    if groups > _unroll_max():
         def body(g, _):
             _hist_group_dot(o_ref, b_ref, sb, g, BP, P, acc)
             return 0
@@ -338,6 +338,15 @@ def _hist_dot_accumulate(o_ref, b_ref, sb, Fp: int, BP: int, P: int):
 
 
 _UNROLL_MAX = 128
+
+
+def _unroll_max() -> int:
+    """Unroll cap, overridable via MMLSPARK_TPU_HIST_UNROLL_MAX (0 keeps the
+    dynamic fori_loop everywhere — the escape hatch if a Mosaic version
+    compiles large unrolled kernels pathologically)."""
+    import os
+    v = os.environ.get("MMLSPARK_TPU_HIST_UNROLL_MAX")
+    return int(v) if v else _UNROLL_MAX
 
 
 def _hist_group_dot(o_ref, b_ref, sb, g, BP: int, P: int, acc):

@@ -206,7 +206,7 @@ def test_wide_feature_fori_path_matches_xla(monkeypatch):
     try:
         rng = np.random.default_rng(0)
         F, n, B, W = 130, 512, 255, 3    # P=1: 130 groups > _UNROLL_MAX
-        assert F // H._bin_packing(B)[1] > H._UNROLL_MAX
+        assert F // H._bin_packing(B)[1] > H._unroll_max()
         bt = jnp.asarray(rng.integers(0, B, (F, n)), dtype=jnp.int32)
         pos = jnp.asarray(rng.integers(-1, W, n), dtype=jnp.int32)
         base = jnp.asarray(rng.normal(size=(3, n)).astype(np.float32))
