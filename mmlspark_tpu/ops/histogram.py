@@ -345,8 +345,15 @@ def _unroll_max() -> int:
     dynamic fori_loop everywhere — the escape hatch if a Mosaic version
     compiles large unrolled kernels pathologically)."""
     import os
-    v = os.environ.get("MMLSPARK_TPU_HIST_UNROLL_MAX")
-    return int(v) if v else _UNROLL_MAX
+    v = os.environ.get("MMLSPARK_TPU_HIST_UNROLL_MAX", "").strip()
+    if not v:
+        return _UNROLL_MAX
+    try:
+        return int(v)
+    except ValueError:
+        raise ValueError(
+            f"MMLSPARK_TPU_HIST_UNROLL_MAX must be an integer, got {v!r}"
+        ) from None
 
 
 def _hist_group_dot(o_ref, b_ref, sb, g, BP: int, P: int, acc):
