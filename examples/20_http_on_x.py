@@ -1,0 +1,69 @@
+"""Embed an arbitrary web API as a pipeline stage (HTTP-on-X).
+
+Mirrors the reference's "HttpOnSpark - Working with Arbitrary Web APIs"
+notebook (io/http/SimpleHTTPTransformer.scala:64, HTTPClients.scala:20-163):
+a column of payloads flows through a bounded-concurrency HTTP client with
+retry/backoff, responses parse back into a column, and failures land in the
+error column instead of aborting the batch. A local stdlib server stands in
+for the external service, so the example runs hermetically in CI.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.io.http import SimpleHTTPTransformer
+
+
+class _WordAPI(BaseHTTPRequestHandler):
+    """Toy sentiment service: counts 'good'/'bad' words in the payload."""
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        body = json.loads(self.rfile.read(n))
+        text = body.get("text", "")
+        if not isinstance(text, str):          # exercise the error column
+            self.send_response(400)
+            self.end_headers()
+            return
+        score = text.count("good") - text.count("bad")
+        payload = json.dumps({"sentiment": score}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *a):
+        pass
+
+
+def main():
+    httpd = ThreadingHTTPServer(("localhost", 0), _WordAPI)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://localhost:{httpd.server_address[1]}/analyze"
+
+    ds = Dataset({"payload": [
+        {"text": "good good bad"},
+        {"text": "bad day"},
+        {"text": 42},                         # service rejects -> error col
+        {"text": "all good here"},
+    ]})
+    t = (SimpleHTTPTransformer()
+         .set(inputCol="payload", outputCol="out", errorCol="err",
+              url=url, concurrency=4))
+    out = t.transform(ds)
+
+    sentiments = [None if v is None else v["sentiment"] for v in out["out"]]
+    errors = list(out["err"])
+    print("sentiments:", sentiments)
+    assert sentiments[0] == 1 and sentiments[1] == -1 and sentiments[3] == 1
+    assert sentiments[2] is None and errors[2] is not None  # row-level error
+    assert errors[0] is None
+    httpd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
