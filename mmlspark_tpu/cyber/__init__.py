@@ -12,8 +12,10 @@ from .feature import (IdIndexer, IdIndexerModel, LinearScalarScaler,
                       StandardScalarScaler, StandardScalarScalerModel)
 from .complement import ComplementAccessTransformer
 from .anomaly import AccessAnomaly, AccessAnomalyConfig, AccessAnomalyModel
+from .dataset import DataFactory
 
 __all__ = [
+    "DataFactory",
     "AccessAnomaly", "AccessAnomalyConfig", "AccessAnomalyModel",
     "ComplementAccessTransformer", "IdIndexer", "IdIndexerModel",
     "LinearScalarScaler", "LinearScalarScalerModel", "MultiIndexer",
