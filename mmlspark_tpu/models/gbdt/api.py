@@ -129,11 +129,18 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
                                TypeConverters.to_int)
     verbosity = Param("verbosity", "Log verbosity", -1, TypeConverters.to_int)
     growthPolicy = Param("growthPolicy",
-                         "leafwise (LightGBM-parity best-first, one histogram "
-                         "pass per split) or depthwise (TPU-throughput mode: "
-                         "one batched histogram pass per level, num_leaves "
-                         "budget enforced best-gain-first)", "leafwise",
+                         "leafwise (LightGBM-parity best-first, batched: top "
+                         "leafBatch pending leaves split per histogram pass) "
+                         "or depthwise (TPU-throughput mode: one batched "
+                         "histogram pass per level, num_leaves budget "
+                         "enforced best-gain-first)", "leafwise",
                          TypeConverters.to_string)
+    leafBatch = Param("leafBatch",
+                      "Leafwise growth: pending leaves split per fused "
+                      "histogram pass. Leaves' row sets are disjoint, so "
+                      "batching only reorders splits near num_leaves "
+                      "exhaustion; 1 = strict sequential best-first "
+                      "(LightGBM's exact order)", 8, TypeConverters.to_int)
     # cluster-compat params: topology comes from the device mesh on TPU
     parallelism = Param("parallelism", "data_parallel or voting_parallel "
                         "(mesh collectives implement both)", "data_parallel",
@@ -197,6 +204,7 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
             voting=self.get_or_default("parallelism") == "voting_parallel",
             top_k=self.get_or_default("topK"),
             growth_policy=self.get_or_default("growthPolicy"),
+            leaf_batch=self.get_or_default("leafBatch"),
             quantized_grad=self.get_or_default("useQuantizedGrad"),
         )
 

@@ -241,6 +241,48 @@ class TestBoosterInternals:
                            cfg=GrowConfig(num_leaves=7), max_bin=31, seed=1)
         assert np.allclose(b1.predict(X), b2.predict(X))
 
+    def test_leaf_batch_matches_sequential(self):
+        # Splits of distinct leaves are independent, so batched best-first
+        # takes exactly the sequential splits whenever the num_leaves budget
+        # is not the binding constraint — predictions must match bitwise-ish.
+        X, y = load_diabetes(return_X_y=True)
+        common = dict(objective="regression", num_iterations=5, max_bin=63,
+                      seed=3)
+        b1 = train_booster(X, y, cfg=GrowConfig(
+            num_leaves=63, min_data_in_leaf=40, leaf_batch=1), **common)
+        b8 = train_booster(X, y, cfg=GrowConfig(
+            num_leaves=63, min_data_in_leaf=40, leaf_batch=8), **common)
+        assert np.allclose(b1.predict(X), b8.predict(X), atol=1e-5)
+
+    def test_leaf_batch_budget_quality(self):
+        # With a binding leaf budget the batched order may differ from
+        # sequential near exhaustion — quality must stay equivalent.
+        X, y = load_breast_cancer(return_X_y=True)
+        aucs = []
+        for lb in (1, 8):
+            b = train_booster(X, y, objective="binary", num_iterations=15,
+                              cfg=GrowConfig(num_leaves=15, leaf_batch=lb),
+                              max_bin=63, seed=0)
+            aucs.append(roc_auc_score(y, b.predict(X)))
+        assert min(aucs) > 0.99
+        assert abs(aucs[0] - aucs[1]) < 5e-3
+
+    def test_leaf_batch_voting_quality(self):
+        # Under voting_parallel the top-2k ballot spans the whole batch's
+        # children (documented batch-wide approximation, like depthwise's
+        # frontier-wide vote) — quality must stay on par with the exact
+        # per-split ballot of leaf_batch=1.
+        X, y = load_breast_cancer(return_X_y=True)
+        aucs = []
+        for lb in (1, 8):
+            b = train_booster(X, y, objective="binary", num_iterations=10,
+                              cfg=GrowConfig(num_leaves=15, leaf_batch=lb,
+                                             voting=True, top_k=5),
+                              max_bin=63, seed=0)
+            aucs.append(roc_auc_score(y, b.predict(X)))
+        assert min(aucs) > 0.99
+        assert abs(aucs[0] - aucs[1]) < 5e-3
+
     def test_min_data_in_leaf(self):
         X, y = load_diabetes(return_X_y=True)
         b = train_booster(X, y, objective="regression", num_iterations=3,
