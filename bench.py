@@ -45,10 +45,32 @@ def _tpu_reachable(timeout_s: int = 90) -> bool:
         return False
 
 
+def _tpu_reachable_with_wait() -> bool:
+    """Probe the relay; if it's down, retry for GRAFT_BENCH_TPU_WAIT_SECS
+    (default 30 min) before conceding to the CPU fallback. A wedged relay is
+    usually transient, and a TPU number half an hour late beats publishing a
+    CPU fallback as the round's headline (round-2 lesson)."""
+    if _tpu_reachable():
+        return True
+    budget = float(os.environ.get("GRAFT_BENCH_TPU_WAIT_SECS", "1800"))
+    deadline = time.monotonic() + budget
+    attempt = 0
+    while time.monotonic() < deadline:
+        attempt += 1
+        wait = max(1.0, min(120.0, deadline - time.monotonic()))
+        print(f"[bench] TPU relay down; retry {attempt} in {wait:.0f}s "
+              f"({deadline - time.monotonic():.0f}s left before CPU "
+              "fallback)", file=sys.stderr)
+        time.sleep(wait)
+        if _tpu_reachable():
+            return True
+    return False
+
+
 def main() -> None:
     on_tpu = (os.environ.get("GRAFT_BENCH_FORCE_CPU") != "1"
               and os.environ.get("GRAFT_BENCH_CPU_REEXEC") != "1"
-              and _tpu_reachable())
+              and _tpu_reachable_with_wait())
     if not on_tpu and os.environ.get("GRAFT_BENCH_CPU_REEXEC") != "1":
         # The TPU PJRT plugin registers at interpreter start (sitecustomize,
         # keyed on PALLAS_AXON_POOL_IPS); once registered, backend discovery
@@ -158,7 +180,7 @@ def main() -> None:
     acc = ((booster.predict(X[:100_000]) > 0.5) == y[:100_000]).mean()
     metric = "gbdt_trees_per_sec_1M_rows_28f" if on_tpu else \
         "gbdt_trees_per_sec_50k_rows_28f_CPU_FALLBACK"
-    print(json.dumps({
+    out = {
         "metric": metric,
         "value": round(trees_per_sec, 3),
         "unit": "trees/sec",
@@ -169,21 +191,79 @@ def main() -> None:
         "platform": "tpu" if on_tpu else "cpu-fallback",
         "measures": "train phase on pre-constructed LightGBMDataset "
                     "(lgb.Dataset convention); ingest reported separately",
+        # round-over-round note: value/vs_baseline use this train-phase
+        # convention since round 2; earlier rounds timed end-to-end fits, so
+        # compare end_to_end_trees_per_sec against pre-r2 history.
+        "cross_round_comparable": "end_to_end_trees_per_sec",
         "ingest_sec": round(ingest_s, 3),
         "end_to_end_trees_per_sec": round(bench_iters / (dt + ingest_s), 3),
         "leafwise_trees_per_sec": leafwise_tps,
         "maxbin63_trees_per_sec": maxbin63_tps,
         "quantized_trees_per_sec": quant_tps,
         "quantized_maxbin63_trees_per_sec": quant63_tps,
-        # secondary headline (BASELINE.json config 3): ResNet-50 featurizer
-        # throughput; no absolute reference anchor is published, so the raw
-        # number is reported without a vs_ ratio
-        "resnet50_imgs_per_sec_chip": _guard(
-            lambda: _resnet50_imgs_per_sec(on_tpu), -1.0),
         # serving latency vs the reference's ~1 ms continuous-mode claim
-        # (docs/mmlspark-serving.md:10-11)
+        # (docs/mmlspark-serving.md:10-11). Host-only loop: no device in the
+        # transform path (see docs/performance.md for the tunnel caveat).
         **_guard(_serving_latency, {}),
-    }))
+    }
+    # roofline estimates: judge "fast" against hardware peak, not only the
+    # 15/s anchor (assumptions documented in the helpers)
+    out.update(_guard(lambda: _gbdt_roofline(
+        n_rows, n_feat, max_bin, trees_per_sec, on_tpu), {}))
+    imgs_per_sec = _guard(lambda: _resnet50_imgs_per_sec(on_tpu), -1.0)
+    if on_tpu:
+        # BASELINE.json config 3: ResNet-50 featurizer throughput; no
+        # absolute reference anchor is published, so raw rate + MFU only
+        out["resnet50_imgs_per_sec_chip"] = imgs_per_sec
+        if imgs_per_sec > 0:
+            peak = float(os.environ.get("GRAFT_TPU_PEAK_TFLOPS", "197"))
+            # 3.86e9 MACs/img (He et al. 2015) x2 to match FMA-counted peak
+            out["resnet50_mfu_est"] = round(
+                imgs_per_sec * 2 * 3.86e9 / (peak * 1e12), 4)
+    else:
+        # CPU fallback substitutes a toy CNN (width 8, 64x64) as a smoke
+        # signal only — never reported under an accelerator-keyed name
+        out["toy_cnn_smoke_imgs_per_sec_CPU_FALLBACK"] = imgs_per_sec
+    print(json.dumps(out))
+
+
+def _gbdt_roofline(n_rows: int, n_feat: int, max_bin: int,
+                   trees_per_sec: float, on_tpu: bool) -> dict:
+    """MXU streaming-time roofline for the one-hot histogram formulation.
+
+    Model: each feature's [RB, BP] one-hot streams through ceil(BP/128)
+    MXU tile-columns at 128x128 MACs/cycle regardless of the stat-axis
+    occupancy (the systolic array cannot skip padding lanes), so the
+    minimum per-pass time is cols/mxu_cols_per_sec with
+    cols = n_rows * (n_feat / pack) * ceil(BP/128) and
+    mxu_cols_per_sec = peak_flops / (2 * 128 * 128). A depthwise tree at
+    num_leaves=31 takes ~6 level passes. This is the bf16 path; the int8
+    quantized path streams 2x. Estimates only — reported so trees/sec can
+    be judged against what the formulation could possibly sustain on this
+    chip (GRAFT_TPU_PEAK_TFLOPS, default v5e bf16 peak).
+    """
+    if not on_tpu:
+        return {}
+    import math
+
+    peak = float(os.environ.get("GRAFT_TPU_PEAK_TFLOPS", "197"))
+    if max_bin <= 64:
+        bp = 1 << max(int(max_bin - 1).bit_length(), 3)
+        pack = 128 // bp
+        tile_cols = 1
+    else:
+        bp = -(-max_bin // 128) * 128
+        pack = 1
+        tile_cols = bp // 128
+    cols_per_pass = n_rows * (n_feat / pack) * tile_cols
+    mxu_cols_per_sec = peak * 1e12 / (2 * 128 * 128)
+    passes_per_tree = 1 + math.ceil(math.log2(31))
+    roofline_tps = mxu_cols_per_sec / (cols_per_pass * passes_per_tree)
+    return {"gbdt_roofline_tps_est": round(roofline_tps, 2),
+            "gbdt_roofline_frac": round(trees_per_sec / roofline_tps, 3),
+            "gbdt_roofline_assumes": "bf16 one-hot streaming, "
+                                     f"{passes_per_tree} passes/tree, "
+                                     f"peak {peak} TFLOPs"}
 
 
 def _guard(fn, fallback):
@@ -197,18 +277,53 @@ def _guard(fn, fallback):
 def _serving_latency() -> dict:
     import sys
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from tests.test_serving_latency import serving_latency_stats
+    from tests.test_serving_latency import (serving_latency_stats,
+                                            serving_model_latency_stats)
     s = serving_latency_stats(n_seq=200, n_conc=8, conc_each=50)
-    return {"serving_p50_ms": round(s["p50_ms"], 3),
-            "serving_p99_ms": round(s["p99_ms"], 3),
-            "serving_concurrent_rps": round(s["concurrent_rps"], 1),
-            "serving_vs_1ms_claim": round(1.0 / max(s["p50_ms"], 1e-9), 2)}
+    out = {"serving_p50_ms": round(s["p50_ms"], 3),
+           "serving_p99_ms": round(s["p99_ms"], 3),
+           "serving_concurrent_rps": round(s["concurrent_rps"], 1),
+           "serving_vs_1ms_claim": round(1.0 / max(s["p50_ms"], 1e-9), 2)}
+    # model-in-loop: compiled GBDT scoring each micro-batch. On TPU through
+    # the tunnel this carries the ~67 ms round-trip floor per batch — the
+    # honest accelerator-inclusive number (docs/performance.md caveat).
+    m = _guard(lambda: serving_model_latency_stats(), None)
+    if m:
+        out["serving_model_in_loop_p50_ms"] = round(m["p50_ms"], 3)
+        out["serving_model_in_loop_p99_ms"] = round(m["p99_ms"], 3)
+        out["serving_model_in_loop_rps"] = round(m["concurrent_rps"], 1)
+    return out
+
+
+def _roundtrip_floor_s() -> float:
+    """Median host<->device round-trip for a tiny scalar download. Under the
+    axon tunnel this floor is ~67 ms and block_until_ready() returns without
+    waiting (docs/developer.md "TPU-tunnel performance notes") — all device
+    timings here sync by downloading a scalar and subtracting this floor."""
+    import jax.numpy as jnp
+
+    x = jnp.ones(8)
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(jnp.sum(x))
+        samples.append(time.perf_counter() - t0)
+    return sorted(samples)[1]
 
 
 def _resnet50_imgs_per_sec(on_tpu: bool) -> float:
     """ImageFeaturizer throughput on ResNet-50 (bottleneck, bf16 activations),
     224x224 inputs, pool-layer capture — the transfer-learning workload of
-    the reference's notebook example 9 (CNTKModel ResNet-50 featurizer)."""
+    the reference's notebook example 9 (CNTKModel ResNet-50 featurizer).
+
+    On CPU fallback a toy CNN runs instead purely as a smoke signal; the
+    caller reports it under a fallback-named key, never as a chip number.
+
+    Sync discipline: block_until_ready() lies under the TPU tunnel, so the
+    timed region ends with a scalar download of the last output (which
+    executes after all queued dispatches in program order) and subtracts the
+    measured round-trip floor.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -234,12 +349,14 @@ def _resnet50_imgs_per_sec(on_tpu: bool) -> float:
 
     x = jnp.asarray(np.random.default_rng(0).normal(
         size=(batch, *cfg.input_hw, 3)).astype(np.float32))
-    featurize(params, x).block_until_ready()       # compile
+    float(jnp.sum(featurize(params, x)))           # compile + materialize
+    floor = _roundtrip_floor_s()
     t0 = time.perf_counter()
+    out = None
     for _ in range(reps):
         out = featurize(params, x)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
+    float(jnp.sum(out))                            # forces the whole queue
+    dt = max(time.perf_counter() - t0 - floor, 1e-9)
     return round(batch * reps / dt, 1)
 
 

@@ -46,8 +46,20 @@ def clear_binned_dataset_cache() -> None:
     _BINNED_CACHE.clear()
 
 
+def _cache_enabled() -> bool:
+    import os
+    return os.environ.get("MMLSPARK_TPU_BINNED_CACHE", "1") != "0"
+
+
 def _cached_binned_dataset(X, y, w, *, max_bin, bin_sample_count, seed,
                            categorical_features) -> LightGBMDataset:
+    if not _cache_enabled():
+        # skip fingerprinting entirely: hashing a 1M-row matrix per fit is
+        # pure waste when the result will never be cached
+        return LightGBMDataset.construct(
+            _densify(X), y, w, max_bin=max_bin,
+            bin_sample_count=bin_sample_count, seed=seed,
+            categorical_features=categorical_features)
     from ...parallel import mesh as meshlib
     from ...utils.checkpoint import data_fingerprint
 
@@ -70,9 +82,10 @@ def _cached_binned_dataset(X, y, w, *, max_bin, bin_sample_count, seed,
             _densify(X), y, w, max_bin=max_bin,
             bin_sample_count=bin_sample_count, seed=seed,
             categorical_features=categorical_features)
-        _BINNED_CACHE[key] = ds
-        while len(_BINNED_CACHE) > _BINNED_CACHE_MAX:
-            _BINNED_CACHE.popitem(last=False)
+        if _cache_enabled():
+            _BINNED_CACHE[key] = ds
+            while len(_BINNED_CACHE) > _BINNED_CACHE_MAX:
+                _BINNED_CACHE.popitem(last=False)
     else:
         _BINNED_CACHE.move_to_end(key)
     return ds
@@ -361,7 +374,14 @@ class _LightGBMModelBase(Model, _LightGBMParams):
 
 class LightGBMClassifier(Estimator, _LightGBMParams, HasRawPredictionCol,
                          HasProbabilityCol):
-    """Distributed GBDT classifier (reference: lightgbm/LightGBMClassifier.scala:24-66)."""
+    """Distributed GBDT classifier (reference: lightgbm/LightGBMClassifier.scala:24-66).
+
+    HBM note: ``fit`` caches the binned device dataset (two fits, LRU) so
+    sweeps skip re-ingest; the cache pins up to two [F, n] int32 matrices in
+    device memory after training ends. Call
+    :func:`clear_binned_dataset_cache` to release them, or set
+    ``MMLSPARK_TPU_BINNED_CACHE=0`` to disable the cache entirely.
+    """
 
     objective = Param("objective", "binary or multiclass (auto from label arity)",
                       None, TypeConverters.to_string)
@@ -437,7 +457,11 @@ class LightGBMClassificationModel(_LightGBMModelBase, HasRawPredictionCol,
 
 class LightGBMRegressor(Estimator, _LightGBMParams):
     """Distributed GBDT regressor (reference: lightgbm/LightGBMRegressor.scala;
-    objectives per TrainParams.scala:86-104)."""
+    objectives per TrainParams.scala:86-104).
+
+    HBM note: ``fit`` caches binned device datasets — see
+    :class:`LightGBMClassifier` for the retention/release story.
+    """
 
     objective = Param("objective", "regression|regression_l1|huber|fair|poisson|"
                       "quantile|mape|tweedie", "regression", TypeConverters.to_string)
@@ -519,6 +543,9 @@ def _pad_groups(X: np.ndarray, y: np.ndarray, w: Optional[np.ndarray],
 
 class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
     """Distributed LambdaRank (reference: lightgbm/LightGBMRanker.scala).
+
+    HBM note: ``fit`` caches binned device datasets — see
+    :class:`LightGBMClassifier` for the retention/release story.
 
     Groups are padded to ``maxGroupSize`` static blocks so the pairwise
     lambda computation is one dense MXU batch; each shard holds whole groups

@@ -119,6 +119,9 @@ def unpack_trees(flat: np.ndarray, lead: Tuple[int, ...], M: int,
         elif dt != np.int32:
             seg = seg.view(dt)
         fields[name] = seg.reshape(shape)
+    assert off == flat.size, (
+        f"unpack_trees: buffer has {flat.size} elements, layout expects "
+        f"{off} — num_leaves/num_bins mismatch between pack and unpack")
     return Tree(**fields)
 
 

@@ -54,7 +54,12 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
                             TypeConverters.to_string)
     noConstant = Param("noConstant", "Drop VW's implicit intercept feature "
                        "(--noconstant)", False, TypeConverters.to_bool)
-    initialModel = Param("initialModel", "Warm-start weights", None, is_complex=True)
+    initialModel = Param("initialModel",
+                         "Warm-start weights: a raw weight array, or a "
+                         "fitted VW model (preferred — its constant-feature "
+                         "format marker is then checked against this "
+                         "estimator's noConstant; raw pre-v2 arrays require "
+                         "noConstant=True by hand)", None, is_complex=True)
     checkpointDir = Param("checkpointDir",
                           "Pass-level checkpoint directory: each finished "
                           "pass saves full optimizer state and training "
@@ -148,6 +153,24 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
         wcol = self.get_or_default("weightCol")
         sw = dataset.array(wcol, np.float32) if wcol else None
         init = self.get_or_default("initialModel")
+        if init is not None and hasattr(init, "weights"):
+            # fitted-model warm start: the model carries its constant-feature
+            # format (pre-v2 loads set noConstant=True in _load_extra); its
+            # weight table only matches an estimator with the same setting
+            m_nc = bool(init.get_or_default("noConstant"))
+            e_nc = bool(self.get_or_default("noConstant"))
+            if m_nc != e_nc:
+                raise ValueError(
+                    f"initialModel was trained with noConstant={m_nc} but "
+                    f"this estimator has noConstant={e_nc}; set them equal "
+                    "(models saved before the implicit constant feature "
+                    "existed load with noConstant=True)")
+            init = init.weights
+        if init is not None and len(init) != (1 << cfg.num_bits):
+            raise ValueError(
+                f"initialModel weight table has {len(init)} entries but "
+                f"numBits={cfg.num_bits} implies {1 << cfg.num_bits}; set "
+                "numBits to match the warm-start model's")
         ckpt_dir = self.get_or_default("checkpointDir")
         sw_time = StopWatch()
         with sw_time:

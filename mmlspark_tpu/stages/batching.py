@@ -266,6 +266,14 @@ def time_interval_batches(it, interval_ms: float, max_batch_size: int = 0,
     deadline = None
     try:
         while True:
+            # yield at the window boundary even when the producer saturates
+            # the queue: get(timeout=0) below still returns items whenever
+            # the queue is non-empty, so without this check an uncapped
+            # batch would grow past the interval instead of closing on time
+            if deadline is not None and _time.monotonic() >= deadline:
+                if batch:
+                    yield batch
+                batch, deadline = [], None
             timeout = (None if deadline is None
                        else max(deadline - _time.monotonic(), 0))
             try:

@@ -82,6 +82,66 @@ def serving_latency_stats(n_seq=200, n_conc=8, conc_each=50):
         q.stop()
 
 
+def serving_model_latency_stats(n_seq=100, n_conc=4, conc_each=25):
+    """Latency with a compiled GBDT booster scoring every micro-batch — the
+    accelerator-in-loop number the host-only proof cannot give. On TPU this
+    includes the host->device->host hop (through the axon tunnel that hop
+    alone is ~67 ms — docs/performance.md states the caveat); on CPU it
+    measures the serving stack + jitted predict. Batches are padded to the
+    fixed max_batch shape so the compiled program never re-specializes."""
+    from mmlspark_tpu.models.gbdt.booster import train_booster
+    from mmlspark_tpu.models.gbdt.growth import GrowConfig
+
+    rng = np.random.default_rng(0)
+    F, max_batch = 8, 64
+    Xtr = rng.normal(size=(2000, F)).astype(np.float32)
+    ytr = (Xtr[:, 0] + Xtr[:, 1] > 0).astype(np.float32)
+    booster = train_booster(Xtr, ytr, objective="binary", num_iterations=10,
+                            cfg=GrowConfig(num_leaves=15), max_bin=63)
+    pad = np.zeros((max_batch, F), np.float32)
+
+    def transform(ds):
+        vals = ds["value"]
+        X = pad.copy()
+        for i, v in enumerate(vals[:max_batch]):
+            X[i] = np.asarray((v or {}).get("x", [0.0] * F), np.float32)
+        preds = booster.predict(X)[:len(vals)]
+        return ds.with_column(
+            "reply", [{"entity": {"y": float(p)}, "statusCode": 200}
+                      for p in preds])
+
+    q = (serve().address("localhost", 0, "bench_model")
+         .batch(max_batch=max_batch, max_latency_ms=5)
+         .transform(transform).start())
+    host, port = q.server.host, q.server.port
+    path = "/bench_model"
+    payload = (b'{"x": [' + b", ".join(b"0.5" for _ in range(F)) + b']}')
+    try:
+        _measure(host, port, path, 20, payload=payload)      # warm/compile
+        seq = _measure(host, port, path, n_seq, payload=payload)
+        results = []
+
+        def worker():
+            results.append(_measure(host, port, path, conc_each,
+                                    payload=payload))
+        threads = [threading.Thread(target=worker) for _ in range(n_conc)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return {
+            "p50_ms": float(np.percentile(seq, 50)),
+            "p99_ms": float(np.percentile(seq, 99)),
+            "concurrent_rps": float(n_conc * conc_each / wall),
+            "batches_served": q.batches_served,
+            "requests_served": q.requests_served,
+        }
+    finally:
+        q.stop()
+
+
 def flaky(retries: int = 3):
     """Retry decorator for timing-sensitive tests (reference: the Flaky /
     TimeLimitedFlaky traits, core/test/base/TestBase.scala:43-72 — whole-test
@@ -118,5 +178,15 @@ def test_sequential_latency_does_not_pay_batch_deadline():
     assert stats["batches_served"] < stats["requests_served"], stats
 
 
+@flaky(retries=3)
+def test_model_in_loop_serving():
+    stats = serving_model_latency_stats(n_seq=40, n_conc=2, conc_each=10)
+    # CI box: just prove the compiled-predict path serves correctly and
+    # batches form; tight numbers come from the bench host
+    assert stats["p99_ms"] < 500.0, stats
+    assert stats["batches_served"] <= stats["requests_served"], stats
+
+
 if __name__ == "__main__":
     print(serving_latency_stats())
+    print(serving_model_latency_stats())

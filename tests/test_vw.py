@@ -151,6 +151,23 @@ class TestVWLearners:
         acc = (np.asarray(out["prediction"]) == ds.array("label")).mean()
         assert acc > 0.9
 
+    def test_initial_model_object_checks_format(self):
+        # passing a fitted model (not raw weights) carries the
+        # constant-feature format marker: mismatched noConstant must raise
+        import pytest
+
+        ds = _text_data(100)
+        ds = VowpalWabbitFeaturizer(inputCols=["text"],
+                                    stringSplitInputCols=["text"]).transform(ds)
+        m1 = VowpalWabbitClassifier(numPasses=1).fit(ds)
+        m2 = VowpalWabbitClassifier(numPasses=1, initialModel=m1).fit(ds)
+        acc = (np.asarray(m2.transform(ds)["prediction"])
+               == ds.array("label")).mean()
+        assert acc > 0.9
+        m1.set(noConstant=True)  # simulate a pre-v2 loaded model
+        with pytest.raises(ValueError, match="noConstant"):
+            VowpalWabbitClassifier(numPasses=1, initialModel=m1).fit(ds)
+
     def test_persistence(self, tmp_path):
         ds = _text_data(100)
         ds = VowpalWabbitFeaturizer(inputCols=["text"],
