@@ -82,10 +82,9 @@ def _cached_binned_dataset(X, y, w, *, max_bin, bin_sample_count, seed,
             _densify(X), y, w, max_bin=max_bin,
             bin_sample_count=bin_sample_count, seed=seed,
             categorical_features=categorical_features)
-        if _cache_enabled():
-            _BINNED_CACHE[key] = ds
-            while len(_BINNED_CACHE) > _BINNED_CACHE_MAX:
-                _BINNED_CACHE.popitem(last=False)
+        _BINNED_CACHE[key] = ds
+        while len(_BINNED_CACHE) > _BINNED_CACHE_MAX:
+            _BINNED_CACHE.popitem(last=False)
     else:
         _BINNED_CACHE.move_to_end(key)
     return ds

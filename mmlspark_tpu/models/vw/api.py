@@ -125,13 +125,19 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
         overrides = self._parse_args()
         return cfg._replace(**overrides) if overrides else cfg
 
+    def _effective_no_constant(self) -> bool:
+        """The constant feature is dropped by EITHER the noConstant Param or
+        a --noconstant token in passThroughArgs (_features honors both);
+        format-compatibility checks must compare this effective flag."""
+        return bool(self.get_or_default("noConstant")
+                    or "--noconstant" in shlex.split(
+                        self.get_or_default("passThroughArgs")))
+
     def _features(self, dataset: Dataset):
         base = self.get_or_default("featuresCol")
         idx = dataset.array(f"{base}_indices", np.int32)
         val = dataset.array(f"{base}_values", np.float32)
-        no_const = (self.get_or_default("noConstant")
-                    or "--noconstant" in shlex.split(
-                        self.get_or_default("passThroughArgs")))
+        no_const = self._effective_no_constant()
         if not no_const:
             # VW adds an implicit intercept ("constant") feature to every
             # example at its hardcoded index (vw's `constant = 11650396`),
@@ -156,9 +162,12 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
         if init is not None and hasattr(init, "weights"):
             # fitted-model warm start: the model carries its constant-feature
             # format (pre-v2 loads set noConstant=True in _load_extra); its
-            # weight table only matches an estimator with the same setting
-            m_nc = bool(init.get_or_default("noConstant"))
-            e_nc = bool(self.get_or_default("noConstant"))
+            # weight table only matches an estimator with the same EFFECTIVE
+            # setting (Param or --noconstant passthrough, like _features)
+            m_nc = (init._effective_no_constant()
+                    if hasattr(init, "_effective_no_constant")
+                    else bool(init.get_or_default("noConstant")))
+            e_nc = self._effective_no_constant()
             if m_nc != e_nc:
                 raise ValueError(
                     f"initialModel was trained with noConstant={m_nc} but "

@@ -241,6 +241,50 @@ class TestBoosterInternals:
                            cfg=GrowConfig(num_leaves=7), max_bin=31, seed=1)
         assert np.allclose(b1.predict(X), b2.predict(X))
 
+    def test_distributed_equivalence_8_vs_1_shard(self):
+        # The strongest multi-chip correctness signal available without
+        # hardware: data_parallel GBDT must produce the SAME model on an
+        # 8-way data mesh as on a single shard — the histogram psum is a
+        # plain sum, so shard topology must not leak into split decisions.
+        # Ragged row count (569 % 8 != 0) exercises the padded-shard path.
+        import jax
+        from mmlspark_tpu.parallel import mesh as meshlib
+
+        X, y = load_breast_cancer(return_X_y=True)
+        cfg = GrowConfig(num_leaves=15)
+        common = dict(objective="binary", num_iterations=10, cfg=cfg,
+                      max_bin=63, seed=0)
+        b8 = train_booster(X, y, **common)  # default mesh: 8 virtual devices
+        with meshlib.default_mesh(
+                meshlib.make_mesh({"data": 1}, devices=jax.devices()[:1])):
+            b1 = train_booster(X, y, **common)
+        # identical structure: same split features and bins in every tree
+        assert np.array_equal(np.asarray(b8.trees.feat),
+                              np.asarray(b1.trees.feat))
+        assert np.array_equal(np.asarray(b8.trees.thr_bin),
+                              np.asarray(b1.trees.thr_bin))
+        np.testing.assert_allclose(b8.predict(X), b1.predict(X),
+                                   rtol=0, atol=1e-5)
+
+    def test_distributed_equivalence_voting_quality(self):
+        # voting_parallel's ballot is shard-topology-dependent BY DESIGN
+        # (each shard votes its local top-k, like LightGBM's approximate
+        # voting learner) — so only quality equivalence is asserted.
+        import jax
+        from mmlspark_tpu.parallel import mesh as meshlib
+
+        X, y = load_breast_cancer(return_X_y=True)
+        common = dict(objective="binary", num_iterations=10,
+                      cfg=GrowConfig(num_leaves=15, voting=True, top_k=5),
+                      max_bin=63, seed=0)
+        b8 = train_booster(X, y, **common)
+        with meshlib.default_mesh(
+                meshlib.make_mesh({"data": 1}, devices=jax.devices()[:1])):
+            b1 = train_booster(X, y, **common)
+        a8 = roc_auc_score(y, b8.predict(X))
+        a1 = roc_auc_score(y, b1.predict(X))
+        assert min(a8, a1) > 0.99 and abs(a8 - a1) < 5e-3, (a8, a1)
+
     def test_leaf_batch_matches_sequential(self):
         # Splits of distinct leaves are independent, so batched best-first
         # takes exactly the sequential splits whenever the num_leaves budget

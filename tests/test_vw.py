@@ -167,6 +167,58 @@ class TestVWLearners:
         m1.set(noConstant=True)  # simulate a pre-v2 loaded model
         with pytest.raises(ValueError, match="noConstant"):
             VowpalWabbitClassifier(numPasses=1, initialModel=m1).fit(ds)
+        # the EFFECTIVE flag is what matters: --noconstant via passthrough
+        # on the estimator matches a noConstant=True model (no raise) ...
+        m3 = VowpalWabbitClassifier(
+            numPasses=1, initialModel=m1,
+            passThroughArgs="--noconstant").fit(ds)
+        assert m3 is not None
+        # ... and a model trained with the passthrough flag must NOT warm
+        # start a default estimator that would add the constant feature
+        m4 = VowpalWabbitClassifier(numPasses=1,
+                                    passThroughArgs="--noconstant").fit(ds)
+        with pytest.raises(ValueError, match="noConstant"):
+            VowpalWabbitClassifier(numPasses=1, initialModel=m4).fit(ds)
+
+    def test_distributed_equivalence_8_vs_1_shard(self):
+        # bfgs computes its full-batch gradient with one psum, so the model
+        # must be shard-topology-invariant (tight tolerance covers float
+        # association order). The pass-end-averaging SGD path is
+        # shard-DEPENDENT by design (each replica trains on its local rows
+        # then averages — the reference's VW AllReduce has the same
+        # property), so it only gets a quality assertion.
+        import jax
+        from mmlspark_tpu.parallel import mesh as meshlib
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(500, 4)).astype(np.float32)
+        # label noise keeps the logistic optimum finite: on separable data
+        # the weights diverge and tiny float-association differences in the
+        # psum'd gradient compound through the line search
+        y = (X[:, 0] - X[:, 1] + rng.normal(scale=0.8, size=500) > 0
+             ).astype(np.float32)
+        ds = Dataset({"features": [row for row in X], "label": y})
+        dsf = VowpalWabbitFeaturizer(inputCols=["features"],
+                                     outputCol="features").transform(ds)
+
+        def fit_pair(**kw):
+            m8 = VowpalWabbitClassifier(numBits=12, **kw).fit(dsf)
+            with meshlib.default_mesh(
+                    meshlib.make_mesh({"data": 1},
+                                      devices=jax.devices()[:1])):
+                m1 = VowpalWabbitClassifier(numBits=12, **kw).fit(dsf)
+            return m8, m1
+
+        m8, m1 = fit_pair(
+            passThroughArgs="--bfgs --passes 20 --loss_function logistic")
+        np.testing.assert_allclose(m8.weights, m1.weights, rtol=1e-3,
+                                   atol=1e-4)
+
+        s8, s1 = fit_pair(numPasses=3)
+        a8 = (s8.transform(dsf).array("prediction") == y).mean()
+        a1 = (s1.transform(dsf).array("prediction") == y).mean()
+        # the noise floor caps attainable accuracy near ~0.85 (Bayes rate)
+        assert min(a8, a1) > 0.8 and abs(a8 - a1) < 0.05, (a8, a1)
 
     def test_persistence(self, tmp_path):
         ds = _text_data(100)
