@@ -185,8 +185,15 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
         "leafPredictionCol", "If set, output per-tree leaf indices here "
         "(reference: LightGBMModelMethods predLeaf)", None, TypeConverters.to_string)
     featuresShapCol = Param(
-        "featuresShapCol", "If set, output per-feature SHAP-style contributions "
-        "here (reference: LightGBMBooster.scala:250-269)", None,
+        "featuresShapCol", "If set, output per-feature contributions here "
+        "(reference: LightGBMBooster.scala:250-269). Computed per "
+        "shapMethod: exact TreeSHAP by default", None,
+        TypeConverters.to_string)
+    shapMethod = Param(
+        "shapMethod", "featuresShapCol algorithm: 'treeshap' (exact Shapley "
+        "values, LightGBM native-TreeSHAP parity, host) or 'saabas' (fast "
+        "on-device path attribution — sums to the prediction but deviates "
+        "from Shapley on correlated features)", "treeshap",
         TypeConverters.to_string)
     categoricalSlotIndexes = Param(
         "categoricalSlotIndexes", "Feature-vector slots to treat as "
@@ -345,7 +352,9 @@ class _LightGBMModelBase(Model, _LightGBMParams):
         shap_col = self.get_or_default("featuresShapCol")
         if shap_col:
             dataset = dataset.with_column(
-                shap_col, self.booster.predict_contrib(X).astype(np.float64))
+                shap_col, self.booster.predict_contrib(
+                    X, method=self.get_or_default("shapMethod")
+                ).astype(np.float64))
         return dataset
 
     def get_feature_importances(self, importance_type: str = "split"):

@@ -407,15 +407,29 @@ class Booster:
             return np.asarray(jax.nn.softmax(raw, axis=-1))
         return np.asarray(obj.transform(jnp.asarray(raw[:, 0])))
 
-    def predict_contrib(self, X: np.ndarray) -> np.ndarray:
-        """Per-feature contributions (SHAP-style, Saabas path attribution).
+    def predict_contrib(self, X: np.ndarray,
+                        method: str = "treeshap") -> np.ndarray:
+        """Per-feature contributions ([n, (F+1) * num_class]; the last slot
+        of each class block is the bias/expected value).
 
-        Parity with predict(predictContrib) of the reference
-        (lightgbm/LightGBMBooster.scala:250-269 ``featuresShapCol``): for each
-        tree, walking root->leaf attributes the change in expected node value
-        to the split feature. Returns [n, (F+1) * num_class]; the last slot of
-        each class block is the bias (base score + root expectations).
+        ``method="treeshap"`` (default — parity with the reference's
+        ``featuresShapCol``, lightgbm/LightGBMBooster.scala:250-269, which
+        rides LightGBM's native TreeSHAP): exact Shapley values of the
+        cover-conditional value function, computed by the polynomial
+        TreeSHAP algorithm on host (see :mod:`.treeshap`).
+
+        ``method="saabas"``: fast on-device path attribution — walking
+        root->leaf attributes the change in expected node value to the
+        split feature. Sums to the same prediction but is NOT Shapley on
+        correlated features; kept as the throughput option.
         """
+        if method == "treeshap":
+            from .treeshap import shap_values
+            return shap_values(self, X)
+        if method != "saabas":
+            raise ValueError(
+                f"unknown contribution method {method!r}: use 'treeshap' "
+                "(exact, host) or 'saabas' (approximate, device)")
         X = np.asarray(X, dtype=np.float32)
         Xd = jnp.asarray(X)
         trees = jax.tree_util.tree_map(jnp.asarray, self.trees)
