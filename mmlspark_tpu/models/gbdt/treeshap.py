@@ -110,13 +110,21 @@ def tree_shap_single(feat, left, right, is_leaf, cover, values,
     n = go_left.shape[1]
     phi = np.zeros((n, n_features + 1), dtype=np.float64)
 
-    def recurse(j, d, z, o, w, pz, po, pi):
+    # explicit-stack DFS: leafwise trees can be chain-shaped with depth
+    # ~num_leaves, which would blow Python's recursion limit
+    d0 = np.empty(0, dtype=np.int64)
+    z0 = np.empty(0, dtype=np.float64)
+    o0 = np.empty((0, n), dtype=np.float64)
+    w0 = np.empty((0, n), dtype=np.float64)
+    stack = [(0, d0, z0, o0, w0, 1.0, np.ones(n, dtype=np.float64), -1)]
+    while stack:
+        j, d, z, o, w, pz, po, pi = stack.pop()
         d, z, o, w = _extend(d, z, o, w, pz, po, pi)
         if is_leaf[j]:
             for i in range(1, len(d)):
                 s = _unwound_sum(d, z, o, w, i)
                 phi[:, d[i]] += s * (o[i] - z[i]) * float(values[j])
-            return
+            continue
         f = int(feat[j])
         lo, hi = int(left[j]), int(right[j])
         iz, io = 1.0, np.ones(n, dtype=np.float64)
@@ -129,15 +137,10 @@ def tree_shap_single(feat, left, right, is_leaf, cover, values,
                 break
         cj = max(float(cover[j]), 1e-12)
         gl = go_left[j].astype(np.float64)
-        recurse(lo, d, z, o, w, float(cover[lo]) / cj * iz, io * gl, f)
-        recurse(hi, d, z, o, w, float(cover[hi]) / cj * iz, io * (1.0 - gl),
-                f)
-
-    d0 = np.empty(0, dtype=np.int64)
-    z0 = np.empty(0, dtype=np.float64)
-    o0 = np.empty((0, n), dtype=np.float64)
-    w0 = np.empty((0, n), dtype=np.float64)
-    recurse(0, d0, z0, o0, w0, 1.0, np.ones(n, dtype=np.float64), -1)
+        stack.append((lo, d, z, o, w, float(cover[lo]) / cj * iz, io * gl,
+                      f))
+        stack.append((hi, d, z, o, w, float(cover[hi]) / cj * iz,
+                      io * (1.0 - gl), f))
 
     # expected value: cover-weighted mean of leaf values (the value the
     # contributions sum from: sum(phi) + E[f] == f(x))
@@ -170,6 +173,19 @@ def shap_values(booster, X: np.ndarray) -> np.ndarray:
         out[:, k * (F + 1) + F] = booster.base_score[k]
     is_cat = booster._is_cat()
     is_cat_np = None if is_cat is None else np.asarray(is_cat)
+
+    # TreeSHAP's value function conditions on training covers; a model
+    # imported from a LightGBM text dump without the optional
+    # leaf_count/internal_count fields has node_cnt == 0 everywhere and
+    # would silently produce garbage (zero fractions all zero)
+    root_covers = np.asarray(trees.node_cnt)[:, 0]
+    if booster.num_trees and not np.all(root_covers > 0):
+        raise ValueError(
+            "exact TreeSHAP needs per-node training counts, but this "
+            "booster has trees with zero root cover (typically a model "
+            "imported from a LightGBM text dump without "
+            "internal_count/leaf_count fields) — use "
+            "predict_contrib(method='saabas') for cover-free attribution")
 
     for t in range(booster.num_trees):
         k = t % K

@@ -108,6 +108,55 @@ def _epsilon_greedy(scores, mask, epsilon):
     return base + (1.0 - epsilon) * one_hot
 
 
+def _softmax_policy(scores, mask, lam):
+    """VW --softmax: p(a) proportional to exp(-lambda * cost_score(a)) over the
+    valid actions (scores predict COST, so lower score -> higher probability;
+    lambda -> inf recovers greedy, 0 uniform)."""
+    import jax.numpy as jnp
+
+    z = jnp.where(mask > 0, -lam * scores, -jnp.inf)
+    z = z - jnp.max(jnp.where(mask > 0, z, -jnp.inf), axis=-1, keepdims=True)
+    e = jnp.where(mask > 0, jnp.exp(z), 0.0)
+    denom = jnp.maximum(jnp.sum(e, axis=-1, keepdims=True), 1e-38)
+    return e / denom
+
+
+def _greedy_policy(scores, mask):
+    """Pure exploit: probability 1 on the lowest-cost valid action (the
+    post-tau regime of VW --first)."""
+    import jax.numpy as jnp
+
+    masked = jnp.where(mask > 0, scores, jnp.inf)
+    best = jnp.argmin(masked, axis=-1)
+    return (jnp.arange(mask.shape[-1]) == best[..., None]).astype(
+        jnp.float32) * mask
+
+
+def _uniform_policy(mask):
+    import jax.numpy as jnp
+
+    k_valid = jnp.maximum(jnp.sum(mask, axis=-1, keepdims=True), 1.0)
+    return mask / k_valid
+
+
+def _vote_policy(greedy_choices, mask, n_policies, smooth=0.0):
+    """Ensemble vote distribution (VW --bag / --cover): each policy's greedy
+    choice casts one vote; probabilities are vote fractions over valid
+    actions, optionally mixed with ``smooth`` * uniform (cover's residual
+    uniform exploration)."""
+    import jax.numpy as jnp
+
+    K = mask.shape[-1]
+    votes = jnp.zeros(K).at[greedy_choices].add(1.0) / n_policies
+    votes = votes * mask
+    # smooth == 0.0 is the identity, so this stays unconditional (the cover
+    # path passes a traced decay that cannot drive Python control flow)
+    votes = (1.0 - smooth) * votes + smooth * _uniform_policy(mask)
+    # renormalize over valid actions (votes on masked rows are dropped)
+    denom = jnp.maximum(jnp.sum(votes, axis=-1, keepdims=True), 1e-38)
+    return jnp.where(jnp.sum(mask) > 0, votes / denom, votes)
+
+
 class _ContextualBanditParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
     sharedCol = Param("sharedCol", "column of shared-context vectors", "shared")
     chosenActionCol = Param("chosenActionCol",
@@ -116,8 +165,28 @@ class _ContextualBanditParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
     probabilityCol = Param("probabilityCol",
                            "logged probability of the chosen action",
                            "probability")
+    explorationPolicy = Param(
+        "explorationPolicy",
+        "cb_explore_adf exploration family (reference passes these through "
+        "VW's args, VowpalWabbitBase.scala:77-81): 'epsilon' "
+        "(epsilon-greedy), 'softmax' (p ~ exp(-lambda*score), "
+        "softmaxLambda), 'bag' (bagSize bootstrap policies vote), 'cover' "
+        "(coverSize diverse policies, online-cover cost adjustment with "
+        "psi, residual uniform smoothing), 'first' (uniform for the first "
+        "tau examples, then greedy)", "epsilon", TypeConverters.to_string)
     epsilon = Param("epsilon", "exploration epsilon", 0.05,
                     TypeConverters.to_float)
+    softmaxLambda = Param("softmaxLambda",
+                          "softmax temperature (VW --lambda)", 1.0,
+                          TypeConverters.to_float)
+    bagSize = Param("bagSize", "policies in the bag ensemble (VW --bag N)",
+                    5, TypeConverters.to_int)
+    coverSize = Param("coverSize", "policies in the cover ensemble "
+                      "(VW --cover N)", 5, TypeConverters.to_int)
+    psi = Param("psi", "cover diversity strength (VW --psi)", 1.0,
+                TypeConverters.to_float)
+    tau = Param("tau", "first-policy uniform-exploration horizon "
+                "(VW --first tau)", 100, TypeConverters.to_int)
     learningRate = Param("learningRate", "sgd learning rate", 0.5,
                          TypeConverters.to_float)
     numPasses = Param("numPasses", "passes over the data", 1,
@@ -177,15 +246,59 @@ class VowpalWabbitContextualBandit(Estimator, _ContextualBanditParams):
         lr = float(self.get_or_default("learningRate"))
         n_passes = int(self.get_or_default("numPasses"))
         interact = bool(self.get_or_default("useInteractions"))
+        policy = self.get_or_default("explorationPolicy")
+        lam = float(self.get_or_default("softmaxLambda"))
+        psi = float(self.get_or_default("psi"))
+        tau = int(self.get_or_default("tau"))
+        if policy in ("epsilon", "softmax", "first"):
+            N = 1
+        elif policy == "bag":
+            N = max(1, int(self.get_or_default("bagSize")))
+        elif policy == "cover":
+            N = max(1, int(self.get_or_default("coverSize")))
+        else:
+            raise ValueError(
+                f"unknown explorationPolicy {policy!r}: use epsilon, "
+                "softmax, bag, cover or first")
         d_s, d_a = shared.shape[1], actions.shape[2]
+        K = actions.shape[1]
+        n = shared.shape[0]
+
+        # bag: per-example per-policy Poisson(1) bootstrap weights (VW's
+        # online bootstrap), deterministic seed
+        if policy == "bag":
+            boot = np.asarray(
+                np.random.default_rng(0).poisson(1.0, size=(n, N)),
+                np.float32)
+        else:
+            boot = np.ones((n, N), np.float32)
+
+        def policy_probs(scores_all, amask, greedy_all, t):
+            """Exploration distribution of the CURRENT ensemble state —
+            feeds the IPS/SNIPS evaluation counters."""
+            if policy == "epsilon":
+                return _epsilon_greedy(scores_all[0], amask, eps)
+            if policy == "softmax":
+                return _softmax_policy(scores_all[0], amask, lam)
+            if policy == "first":
+                return jnp.where(t < tau, _uniform_policy(amask),
+                                 _greedy_policy(scores_all[0], amask))
+            smooth = (jnp.clip(psi * lax.rsqrt(t + 1.0), 0.0, 1.0)
+                      if policy == "cover" else 0.0)
+            return _vote_policy(greedy_all, amask, N, smooth)
 
         def example_step(carry, xs):
-            ws, wa, wq, g2s, g2a, g2q, m = carry
-            xs_shared, xa, amask, k_star, c, p_log = xs
-            scores = xa @ wa + jnp.dot(xs_shared, ws)      # [K]
+            ws, wa, wq, g2s, g2a, g2q, m, t = carry
+            xs_shared, xa, amask, k_star, c, p_log, bw = xs
+            # per-policy scores [N, K]
+            scores_all = (jnp.einsum("kd,nd->nk", xa, wa)
+                          + jnp.einsum("s,ns->n", xs_shared, ws)[:, None])
             if interact:
-                scores = scores + xa @ (wq.T @ xs_shared)  # xs' Wq xa_k
-            probs = _epsilon_greedy(scores, amask, eps)
+                scores_all = scores_all + jnp.einsum(
+                    "s,nsd,kd->nk", xs_shared, wq, xa)
+            masked = jnp.where(amask[None, :] > 0, scores_all, jnp.inf)
+            greedy_all = jnp.argmin(masked, axis=-1)        # [N]
+            probs = policy_probs(scores_all, amask, greedy_all, t)
             p_eval = probs[k_star]
 
             # IPS/SNIPS counters (reference addExample semantics)
@@ -197,39 +310,54 @@ class VowpalWabbitContextualBandit(Estimator, _ContextualBanditParams):
                  m[3] + live,                              # offline events
                  jnp.maximum(m[4], live * c * p_over_p))   # max ips term
 
-            # MTR update on the chosen action, importance 1/p_log
+            # MTR update on the chosen action, importance 1/p_log — one
+            # update per ensemble member (static unroll over small N)
             x_a = xa[k_star]
-            grad = (scores[k_star] - c) / p_log
-            gs, ga = grad * xs_shared, grad * x_a
-            g2s = g2s + gs * gs
-            g2a = g2a + ga * ga
-            ws = ws - lr * gs * lax.rsqrt(g2s + 1e-6)
-            wa = wa - lr * ga * lax.rsqrt(g2a + 1e-6)
-            if interact:
-                gq = grad * jnp.outer(xs_shared, x_a)
-                g2q = g2q + gq * gq
-                wq = wq - lr * gq * lax.rsqrt(g2q + 1e-6)
-            return (ws, wa, wq, g2s, g2a, g2q, m), None
+            for i in range(N):
+                ci = c
+                if policy == "cover" and i > 0:
+                    # online-cover diversity (Agarwal et al. 2014; VW
+                    # --cover --psi): discount the cost by how rarely the
+                    # PREVIOUS policies pick the logged action, pushing
+                    # policy i toward actions the mix neglects
+                    prev_votes = jnp.sum(
+                        (greedy_all[:i] == k_star).astype(jnp.float32))
+                    p_prev = jnp.maximum(prev_votes / i, 1.0 / K)
+                    ci = c - psi / (K * p_prev)
+                grad = bw[i] * (scores_all[i, k_star] - ci) / p_log
+                gs, ga = grad * xs_shared, grad * x_a
+                g2s = g2s.at[i].add(gs * gs)
+                g2a = g2a.at[i].add(ga * ga)
+                ws = ws.at[i].add(-lr * gs * lax.rsqrt(g2s[i] + 1e-6))
+                wa = wa.at[i].add(-lr * ga * lax.rsqrt(g2a[i] + 1e-6))
+                if interact:
+                    gq = grad * jnp.outer(xs_shared, x_a)
+                    g2q = g2q.at[i].add(gq * gq)
+                    wq = wq.at[i].add(-lr * gq * lax.rsqrt(g2q[i] + 1e-6))
+            return (ws, wa, wq, g2s, g2a, g2q, m, t + 1.0), None
 
         @jax.jit
-        def train(xs_shared, xa, amask, k_star, c, p_log):
-            carry = (jnp.zeros(d_s), jnp.zeros(d_a), jnp.zeros((d_s, d_a)),
-                     jnp.zeros(d_s), jnp.zeros(d_a), jnp.zeros((d_s, d_a)),
+        def train(xs_shared, xa, amask, k_star, c, p_log, bw):
+            carry = (jnp.zeros((N, d_s)), jnp.zeros((N, d_a)),
+                     jnp.zeros((N, d_s, d_a)),
+                     jnp.zeros((N, d_s)), jnp.zeros((N, d_a)),
+                     jnp.zeros((N, d_s, d_a)),
                      (jnp.float32(0), jnp.float32(0), jnp.float32(0),
-                      jnp.float32(0), jnp.float32(0)))
+                      jnp.float32(0), jnp.float32(0)), jnp.float32(0))
 
             def one_pass(carry, _):
                 carry, _ = lax.scan(
                     example_step, carry,
-                    (xs_shared, xa, amask, k_star, c, p_log))
+                    (xs_shared, xa, amask, k_star, c, p_log, bw))
                 return carry, None
 
             carry, _ = lax.scan(one_pass, carry, None, length=n_passes)
             return carry
 
-        ws, wa, wq, _, _, _, m = train(
+        ws, wa, wq, _, _, _, m, _ = train(
             jnp.asarray(shared), jnp.asarray(actions), jnp.asarray(mask),
-            jnp.asarray(chosen), jnp.asarray(cost), jnp.asarray(logged_p))
+            jnp.asarray(chosen), jnp.asarray(cost), jnp.asarray(logged_p),
+            jnp.asarray(boot))
         metrics = ContextualBanditMetrics(
             float(m[0]), float(m[1]), float(m[2]), float(m[3]), float(m[4]))
 
@@ -295,23 +423,62 @@ class VowpalWabbitContextualBanditModel(Model, _ContextualBanditParams):
         })
 
     def transform(self, dataset: Dataset) -> Dataset:
+        import jax.numpy as jnp
+
         ws = np.asarray(self.get_or_default("sharedWeights"))
         wa = np.asarray(self.get_or_default("actionWeights"))
+        if ws.ndim == 1:      # models saved before the ensemble layout
+            ws, wa = ws[None, :], wa[None, :]
         shared = np.asarray(dataset[self.get_or_default("sharedCol")],
                             dtype=np.float32)
         if shared.ndim == 1:
             shared = shared[:, None]
         actions, mask = _stack_actions(
             dataset[self.get_or_default("featuresCol")])
-        eps = float(self.get_or_default("epsilon"))
+        policy = self.get_or_default("explorationPolicy")
+        N = ws.shape[0]
 
-        scores = np.einsum("nkd,d->nk", actions, wa) + (shared @ ws)[:, None]
+        # per-policy scores [n, N, K]
+        scores = (np.einsum("nkd,pd->npk", actions, wa)
+                  + np.einsum("ns,ps->np", shared, ws)[:, :, None])
         wq = self.get_or_default("interactionWeights")
         if wq is not None:
-            scores = scores + np.einsum("ns,sd,nkd->nk", shared,
-                                        np.asarray(wq), actions)
+            wq = np.asarray(wq)
+            if wq.ndim == 2:
+                wq = wq[None, :, :]
+            scores = scores + np.einsum("ns,psd,nkd->npk", shared, wq,
+                                        actions)
         # one policy definition shared with training (no train/serve drift)
-        probs = np.asarray(_epsilon_greedy(scores, mask, eps))
+        t_seen = float(self.metrics.total_events)
+        if policy == "softmax":
+            probs = np.asarray(_softmax_policy(
+                jnp.asarray(scores[:, 0]), jnp.asarray(mask),
+                float(self.get_or_default("softmaxLambda"))))
+        elif policy == "first":
+            # exploit only once training consumed its tau uniform examples;
+            # a model fit on fewer is still in the uniform phase (VW --first)
+            if t_seen < int(self.get_or_default("tau")):
+                probs = np.asarray(_uniform_policy(jnp.asarray(mask)))
+            else:
+                probs = np.asarray(_greedy_policy(jnp.asarray(scores[:, 0]),
+                                                  jnp.asarray(mask)))
+        elif policy in ("bag", "cover"):
+            import jax
+
+            masked = np.where(mask[:, None, :] > 0, scores, np.inf)
+            greedy = masked.argmin(axis=-1)                # [n, N]
+            # same vote + smoothing definition as training (cover's decay
+            # evaluated at the end-of-training event count)
+            smooth = (float(np.clip(
+                float(self.get_or_default("psi")) / (t_seen + 1.0) ** 0.5,
+                0.0, 1.0)) if policy == "cover" else 0.0)
+            probs = np.asarray(jax.vmap(
+                _vote_policy, in_axes=(0, 0, None, None))(
+                jnp.asarray(greedy), jnp.asarray(mask), N, smooth))
+        else:
+            probs = np.asarray(_epsilon_greedy(
+                jnp.asarray(scores[:, 0]), jnp.asarray(mask),
+                float(self.get_or_default("epsilon"))))
         out = [probs[i, mask[i] > 0].tolist() for i in range(len(probs))]
         return dataset.with_column(
             self.get_or_default("predictionCol") or "prediction", out)

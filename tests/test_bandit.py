@@ -123,6 +123,105 @@ def test_contextual_bandit_metrics_match_reference_semantics():
     assert m.get_snips_estimate() == pytest.approx(0.5 / 1.5)
 
 
+@pytest.mark.parametrize("policy,extra", [
+    ("epsilon", {}),
+    ("softmax", {"softmaxLambda": 2.0}),
+    ("bag", {"bagSize": 4}),
+    ("cover", {"coverSize": 4, "psi": 0.5}),
+    ("first", {"tau": 50}),
+])
+def test_exploration_policy_learns_and_is_distribution(policy, extra):
+    """Every cb_explore_adf policy (reference:
+    VowpalWabbitContextualBandit.scala:28-359 passthrough of VW's
+    --epsilon/--softmax/--bag/--cover/--first) must learn the matching
+    action, emit a proper distribution over the offered actions, and
+    produce finite IPS/SNIPS counterfactual estimates."""
+    ds = _bandit_df(n=300)
+    est = VowpalWabbitContextualBandit(labelCol="label", numPasses=4,
+                                       learningRate=0.5,
+                                       explorationPolicy=policy, **extra)
+    model = est.fit(ds)
+    probs = model.transform(ds)["prediction"]
+    ctx = np.argmax(np.asarray(ds["shared"]), axis=1)
+    hits = sum(int(np.argmax(p) == c) for p, c in zip(probs, ctx))
+    assert hits / len(probs) > 0.85, (policy, hits / len(probs))
+    for p in probs[:20]:
+        assert abs(sum(p) - 1.0) < 1e-4, (policy, p)
+        assert min(p) >= 0.0
+    stats = model.get_performance_statistics().row(0)
+    assert np.isfinite(stats["ipsEstimate"]), policy
+    assert np.isfinite(stats["snipsEstimate"]), policy
+
+
+def test_softmax_distribution_shape():
+    # softmax spreads mass by score gap and sharpens with lambda
+    ds = _bandit_df(n=200)
+    soft = VowpalWabbitContextualBandit(
+        labelCol="label", numPasses=3, explorationPolicy="softmax",
+        softmaxLambda=1.0).fit(ds).transform(ds)["prediction"]
+    sharp = VowpalWabbitContextualBandit(
+        labelCol="label", numPasses=3, explorationPolicy="softmax",
+        softmaxLambda=20.0).fit(ds).transform(ds)["prediction"]
+    # larger lambda concentrates more mass on the argmax
+    assert (np.mean([max(p) for p in sharp])
+            > np.mean([max(p) for p in soft]))
+    # all actions keep non-zero probability under finite lambda
+    assert min(min(p) for p in soft) > 0.0
+
+
+def test_bag_votes_are_fractions():
+    ds = _bandit_df(n=200)
+    model = VowpalWabbitContextualBandit(
+        labelCol="label", numPasses=3, explorationPolicy="bag",
+        bagSize=4).fit(ds)
+    probs = model.transform(ds)["prediction"]
+    # vote fractions are multiples of 1/4 (bag emits the ensemble vote
+    # distribution; unanimity after convergence is legitimate)
+    for p in probs[:20]:
+        for v in p:
+            assert abs(v * 4 - round(v * 4)) < 1e-5, p
+
+
+def test_first_policy_greedy_after_tau():
+    ds = _bandit_df(n=200)
+    model = VowpalWabbitContextualBandit(
+        labelCol="label", numPasses=3, explorationPolicy="first",
+        tau=50).fit(ds)
+    probs = model.transform(ds)["prediction"]
+    # post-tau transform is pure exploitation: one-hot rows
+    for p in probs[:20]:
+        assert max(p) == 1.0 and abs(sum(p) - 1.0) < 1e-6
+
+
+def test_first_policy_uniform_before_tau():
+    # trained on fewer than tau examples, the policy is still in its
+    # uniform phase — transform must NOT serve greedy
+    ds = _bandit_df(n=30)
+    model = VowpalWabbitContextualBandit(
+        labelCol="label", numPasses=1, explorationPolicy="first",
+        tau=100).fit(ds)
+    probs = model.transform(ds)["prediction"]
+    for p in probs[:10]:
+        assert np.allclose(p, 1.0 / len(p)), p
+
+
+def test_cover_smoothing_keeps_support():
+    ds = _bandit_df(n=100)
+    model = VowpalWabbitContextualBandit(
+        labelCol="label", numPasses=2, explorationPolicy="cover",
+        coverSize=3, psi=1.0).fit(ds)
+    probs = model.transform(ds)["prediction"]
+    # the psi uniform residual keeps every valid action reachable
+    assert min(min(p) for p in probs) > 0.0
+
+
+def test_unknown_policy_rejected():
+    ds = _bandit_df(n=20)
+    with pytest.raises(ValueError, match="explorationPolicy"):
+        VowpalWabbitContextualBandit(
+            labelCol="label", explorationPolicy="ucb").fit(ds)
+
+
 def test_vector_zipper():
     ds = Dataset({"a": np.asarray([[1.0, 0.0], [0.0, 1.0]]),
                   "b": np.asarray([[2.0, 2.0], [3.0, 3.0]])})

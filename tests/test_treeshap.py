@@ -124,6 +124,41 @@ class TestExactTreeSHAP:
                 c[:, k * (F + 1):(k + 1) * (F + 1)].sum(axis=1), raw[:, k],
                 atol=2e-3)
 
+    def test_zero_cover_import_raises(self):
+        # a model whose trees lack training counts (e.g. imported from a
+        # LightGBM dump without internal_count fields) must fail loudly,
+        # not return garbage contributions
+        import pytest
+
+        X, y = load_breast_cancer(return_X_y=True)
+        b = train_booster(X, y, objective="binary", num_iterations=2,
+                          cfg=GrowConfig(num_leaves=7), max_bin=31)
+        b.trees = b.trees._replace(
+            node_cnt=np.zeros_like(np.asarray(b.trees.node_cnt)))
+        with pytest.raises(ValueError, match="saabas"):
+            b.predict_contrib(X[:5], method="treeshap")
+
+    def test_deep_chain_tree_no_recursion_limit(self):
+        # leafwise growth on monotone data makes chain-shaped trees with
+        # depth ~ num_leaves; the explicit-stack DFS must handle depth well
+        # past Python's default recursion limit territory
+        import sys
+        n = 3000
+        X = np.arange(n, dtype=np.float32)[:, None]
+        y = (np.arange(n) % 7).astype(np.float32)
+        b = train_booster(X, y, objective="regression", num_iterations=1,
+                          cfg=GrowConfig(num_leaves=64, min_data_in_leaf=2,
+                                         leaf_batch=1),
+                          max_bin=255)
+        old = sys.getrecursionlimit()
+        sys.setrecursionlimit(120)  # far below the tree depth ceiling
+        try:
+            c = b.predict_contrib(X[:8], method="treeshap")
+        finally:
+            sys.setrecursionlimit(old)
+        raw = b.predict_raw(X[:8])[:, 0]
+        np.testing.assert_allclose(c.sum(axis=1), raw, atol=1e-4)
+
     def test_categorical_sum_property(self):
         rng = np.random.default_rng(2)
         n = 400
