@@ -5,6 +5,8 @@ All services compose the io.http machinery; see base.CognitiveServicesBase.
 
 from .base import (CognitiveServicesBase, PollingCognitiveService,
                    ServiceParam)
+from .speech_sdk import (CompressedStream, SpeechToTextSDK, WavStream,
+                         open_audio_stream, stream_recognize)
 from .services import (OCR, NER, AddDocuments, AnalyzeImage,
                        AzureSearchWriter, BingImageSearch, DescribeImage,
                        DetectAnomalies, DetectFace, DetectLastAnomaly,
@@ -17,6 +19,8 @@ from .services import (OCR, NER, AddDocuments, AnalyzeImage,
                        TextSentiment, TextSentimentV2, VerifyFaces)
 
 __all__ = [
+    "CompressedStream", "SpeechToTextSDK", "WavStream",
+    "open_audio_stream", "stream_recognize",
     "AddDocuments", "AnalyzeImage", "AzureSearchWriter", "BingImageSearch",
     "CognitiveServicesBase", "DescribeImage", "DetectAnomalies", "DetectFace",
     "DetectLastAnomaly", "EntityDetector", "EntityDetectorV2", "FindSimilarFace",

@@ -1,0 +1,290 @@
+"""Streaming speech-to-text — SDK-path parity.
+
+The reference has TWO speech stages: the REST short-audio ``SpeechToText``
+(cognitive/SpeechToText.scala — already in services.py) and the native-SDK
+``SpeechToTextSDK`` (reference: cognitive/SpeechToTextSDK.scala:66), which
+pulls audio through ``PullAudioInputStreamCallback`` implementations
+(``WavStream`` parses/validates the RIFF header, ``CompressedStream`` feeds
+MP3/OGG as-is — cognitive/AudioStreams.scala:16-84) and emits one
+recognition event per utterance, optionally streaming intermediate results
+row-by-row (``streamIntermediateResults``).
+
+This build has no proprietary SDK and zero egress, so the parity layer
+keeps the same shape with open parts:
+
+* :class:`WavStream` / :class:`CompressedStream` — pull-stream abstraction
+  with the reference's exact WAV-header validation (RIFF/WAVE/fmt, PCM,
+  mono, 16 kHz, 16-bit — AudioStreams.scala:38-80) and fixed-size chunk
+  reads.
+* transport — HTTP **chunked transfer encoding**: the request body is
+  produced by the pull stream chunk-by-chunk (the service sees audio as it
+  arrives, like the SDK's websocket), and the response is newline-delimited
+  JSON recognition events consumed incrementally.
+* :class:`SpeechToTextSDK` — transformer over rows of audio bytes or file
+  URIs; per row it opens the stream, sends chunks, collects events, and
+  emits either the final-utterance list (default) or one output row per
+  event (``streamIntermediateResults``, SpeechToTextSDK.scala's flatMap
+  mode). ``recordAudioData``/``recordedFileNameCol`` tee the streamed
+  bytes to disk (m3u8-capture parity).
+
+Tests drive it against a hermetic local server (tests/test_speech_sdk.py),
+the same pattern as HTTP-on-X example 20.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from typing import Iterator, List, Optional
+
+from ..core.dataset import Dataset
+from ..core.params import HasOutputCol, Param, TypeConverters
+from ..core.pipeline import Transformer
+
+
+class AudioStreamFormatError(ValueError):
+    pass
+
+
+def _read_u32(b: io.BufferedIOBase) -> int:
+    raw = b.read(4)
+    if len(raw) != 4:
+        raise AudioStreamFormatError("truncated WAV header")
+    return struct.unpack("<I", raw)[0]
+
+
+def _read_u16(b: io.BufferedIOBase) -> int:
+    raw = b.read(2)
+    if len(raw) != 2:
+        raise AudioStreamFormatError("truncated WAV header")
+    return struct.unpack("<H", raw)[0]
+
+
+class PullAudioStream:
+    """Pull-audio callback contract (PullAudioInputStreamCallback parity):
+    ``read(n)`` returns up to n bytes (b"" at end), ``close()`` releases."""
+
+    def read(self, n: int) -> bytes:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def chunks(self, chunk_size: int) -> Iterator[bytes]:
+        while True:
+            b = self.read(chunk_size)
+            if not b:
+                return
+            yield b
+
+
+class WavStream(PullAudioStream):
+    """PCM WAV pull stream with the reference's header validation
+    (AudioStreams.scala:38-80): RIFF/WAVE tags, fmt chunk, PCM format tag,
+    mono, 16 kHz, 16-bit samples; reads then yield the raw sample data."""
+
+    def __init__(self, data: bytes):
+        s = io.BytesIO(data)
+        if s.read(4) != b"RIFF":
+            raise AudioStreamFormatError("RIFF tag missing")
+        _read_u32(s)                      # file length
+        if s.read(4) != b"WAVE":
+            raise AudioStreamFormatError("WAVE tag missing")
+        if s.read(4) != b"fmt ":
+            raise AudioStreamFormatError("fmt chunk missing")
+        fmt_size = _read_u32(s)
+        if fmt_size < 16:
+            raise AudioStreamFormatError("formatSize")
+        format_tag = _read_u16(s)
+        channels = _read_u16(s)
+        samples_per_sec = _read_u32(s)
+        _read_u32(s)                      # avg bytes/sec
+        _read_u16(s)                      # block align
+        bits_per_sample = _read_u16(s)
+        if format_tag != 1:
+            raise AudioStreamFormatError("PCM")
+        if channels != 1:
+            raise AudioStreamFormatError("single channel")
+        if samples_per_sec != 16000:
+            raise AudioStreamFormatError("samples per second")
+        if bits_per_sample != 16:
+            raise AudioStreamFormatError("bits per sample")
+        if fmt_size > 16:                 # skip extended format block
+            s.read(fmt_size - 16)
+        if s.read(4) != b"data":
+            raise AudioStreamFormatError("data chunk missing")
+        _read_u32(s)                      # data length
+        self._s = s
+        self.sample_rate = samples_per_sec
+
+    def read(self, n: int) -> bytes:
+        return self._s.read(n)
+
+    def close(self) -> None:
+        self._s.close()
+
+
+class CompressedStream(PullAudioStream):
+    """MP3/OGG pass-through pull stream (CompressedStream parity: the
+    compressed bytes go to the service as-is, format declared out-of-band)."""
+
+    def __init__(self, data: bytes):
+        self._s = io.BytesIO(data)
+
+    def read(self, n: int) -> bytes:
+        return self._s.read(n)
+
+    def close(self) -> None:
+        self._s.close()
+
+
+def open_audio_stream(data: bytes, file_type: str) -> PullAudioStream:
+    if file_type == "wav":
+        return WavStream(data)
+    if file_type in ("mp3", "ogg"):
+        return CompressedStream(data)
+    raise ValueError(f"unsupported fileType {file_type!r}: wav, mp3 or ogg")
+
+
+def stream_recognize(url: str, stream: PullAudioStream, *,
+                     headers: Optional[dict] = None, chunk_size: int = 4096,
+                     tee=None, timeout: float = 60.0) -> Iterator[dict]:
+    """Send audio through HTTP chunked transfer encoding, yielding each
+    newline-delimited JSON recognition event as it arrives — both legs
+    stream, mirroring the SDK's incremental recognition."""
+    import http.client
+    from urllib.parse import urlsplit
+
+    u = urlsplit(url)
+    conn_cls = (http.client.HTTPSConnection if u.scheme == "https"
+                else http.client.HTTPConnection)
+    conn = conn_cls(u.hostname, u.port, timeout=timeout)
+    path = u.path + (f"?{u.query}" if u.query else "")
+    try:
+        conn.putrequest("POST", path)
+        for k, v in (headers or {}).items():
+            conn.putheader(k, v)
+        conn.putheader("Transfer-Encoding", "chunked")
+        conn.endheaders()
+        for chunk in stream.chunks(chunk_size):
+            conn.send(b"%x\r\n" % len(chunk) + chunk + b"\r\n")
+            if tee is not None:
+                tee.write(chunk)
+        conn.send(b"0\r\n\r\n")
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RuntimeError(
+                f"speech service returned {resp.status}: "
+                f"{resp.read(200)!r}")
+        for line in resp:        # buffered incremental NDJSON consumption
+            if line.strip():
+                yield json.loads(line)
+    finally:
+        stream.close()
+        conn.close()
+
+
+class SpeechToTextSDK(Transformer, HasOutputCol):
+    """Streaming speech-to-text over chunked pull-audio streams.
+
+    Reference: cognitive/SpeechToTextSDK.scala:66.
+
+    Rows carry audio as raw bytes or as ``file://``/plain-path URIs
+    (``audioDataCol``, SpeechToTextSDK's dual contract). Each row's audio is
+    pulled through a :class:`WavStream`/:class:`CompressedStream` and
+    streamed to the service; events accumulate into a list column, or —
+    with ``streamIntermediateResults`` — the output explodes to one row per
+    recognition event (the reference's flatMap-with-iterator mode).
+    """
+
+    url = Param("url", "service endpoint URL", None, TypeConverters.to_string)
+    subscriptionKey = Param("subscriptionKey", "API subscription key", None,
+                            TypeConverters.to_string)
+    audioDataCol = Param("audioDataCol",
+                         "Audio column: bytes or file-URI strings", "audio")
+    fileType = Param("fileType", "wav, mp3 or ogg", "wav",
+                     TypeConverters.to_string)
+    language = Param("language", "Recognition language", "en-US",
+                     TypeConverters.to_string)
+    chunkSize = Param("chunkSize", "Pull-stream chunk bytes", 4096,
+                      TypeConverters.to_int)
+    timeout = Param("timeout", "Socket timeout seconds per row", 60.0,
+                    TypeConverters.to_float)
+    streamIntermediateResults = Param(
+        "streamIntermediateResults",
+        "Emit one output row per recognition event instead of one list "
+        "per input row", False, TypeConverters.to_bool)
+    recordAudioData = Param("recordAudioData",
+                            "Tee streamed audio to recordedFileNameCol "
+                            "paths (m3u8-capture parity)", False,
+                            TypeConverters.to_bool)
+    recordedFileNameCol = Param("recordedFileNameCol",
+                                "Per-row output file for recorded audio",
+                                None, TypeConverters.to_string)
+
+    def _load_audio(self, v) -> bytes:
+        if isinstance(v, (bytes, bytearray, memoryview)):
+            return bytes(v)
+        if isinstance(v, str):
+            path = v[7:] if v.startswith("file://") else v
+            with open(path, "rb") as f:
+                return f.read()
+        import numpy as np
+        if isinstance(v, np.ndarray):
+            return v.tobytes()
+        raise TypeError(f"audio must be bytes or a file URI, got {type(v)}")
+
+    def transform(self, dataset: Dataset) -> Dataset:
+        url = self.get_or_default("url")
+        if not url:
+            raise ValueError(
+                "SpeechToTextSDK needs an endpoint: construct with url=... "
+                "or call .set(url=...)")
+        key = self.get_or_default("subscriptionKey")
+        lang = self.get_or_default("language")
+        ftype = self.get_or_default("fileType")
+        csize = int(self.get_or_default("chunkSize"))
+        record = self.get_or_default("recordAudioData")
+        rec_col = self.get_or_default("recordedFileNameCol")
+        if record and not rec_col:
+            # reference parity: $(recordedFileNameCol) throws when unset —
+            # never silently skip the capture the user asked for
+            raise ValueError(
+                "recordAudioData=True requires recordedFileNameCol")
+        headers = {"Content-Type": f"audio/{ftype}",
+                   "X-Language": lang}
+        if key:
+            headers["Ocp-Apim-Subscription-Key"] = key
+
+        col = dataset[self.get_or_default("audioDataCol")]
+        rec_paths = dataset[rec_col] if record and rec_col else None
+        all_events: List[List[dict]] = []
+        for i, v in enumerate(col):
+            stream = open_audio_stream(self._load_audio(v), ftype)
+            tee = open(rec_paths[i], "wb") if rec_paths is not None else None
+            try:
+                events = list(stream_recognize(
+                    url, stream, headers=headers, chunk_size=csize,
+                    tee=tee, timeout=float(self.get_or_default("timeout"))))
+            finally:
+                if tee is not None:
+                    tee.close()
+            all_events.append(events)
+
+        out_col = self.get_or_default("outputCol") or "transcription"
+        if not self.get_or_default("streamIntermediateResults"):
+            return dataset.with_column(out_col, all_events)
+        # explode: one row per event, replicating the source row's columns
+        import numpy as np
+        idx = [i for i, evs in enumerate(all_events) for _ in evs]
+        flat = [e for evs in all_events for e in evs]
+        cols = {}
+        for name in dataset.columns:
+            src = dataset[name]
+            if isinstance(src, np.ndarray):
+                cols[name] = src[idx]
+            else:
+                cols[name] = [src[i] for i in idx]
+        cols[out_col] = flat
+        return Dataset(cols)
