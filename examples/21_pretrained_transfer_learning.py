@@ -1,0 +1,76 @@
+"""Example 21: genuinely-pretrained checkpoint + transfer learning.
+
+The reference's ModelDownloader fetches TRAINED CNTK checkpoints and
+ImageFeaturizer turns them into transfer-learning features (reference:
+downloader/ModelDownloader.scala:37-276, image/ImageFeaturizer.scala:40-191,
+notebook sample 9). This repo ships a genuinely trained checkpoint as a
+package fixture — DigitsConvNet, trained in-repo to ~0.97 held-out accuracy
+on sklearn digits by tools/train_digits_fixture.py — and this example shows
+the transfer-learning payoff: with only 100 labeled examples, a classifier
+on the pretrained CNN's pooled features beats the same classifier on raw
+pixels on a held-out set the pretraining never saw.
+"""
+
+import tempfile
+
+import numpy as np
+from sklearn.datasets import load_digits
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.models.dnn.digits_fixture import (digits_images,
+                                                    heldout_split)
+from mmlspark_tpu.models.dnn.downloader import ModelDownloader
+from mmlspark_tpu.models.dnn.scoring import DNNModel, ImageFeaturizer
+from mmlspark_tpu.models.gbdt.api import LightGBMClassifier
+
+N_LABELED = 100
+
+
+def fit_eval(train_ds, test_ds, feat_col, yte):
+    clf = LightGBMClassifier(numIterations=30, numLeaves=7, minDataInLeaf=3,
+                             featuresCol=feat_col).fit(train_ds)
+    pred = clf.transform(test_ds).array("prediction")
+    return float((pred == yte).mean())
+
+
+def main():
+    X, y = load_digits(return_X_y=True)
+    # the shared split helper: the held-out quarter was never seen by the
+    # pretrained checkpoint
+    Xtr, Xte, ytr, yte = heldout_split(X, y)
+    # low-label transfer regime: only N_LABELED examples carry labels
+    rng = np.random.default_rng(1)
+    lab = rng.choice(len(Xtr), size=N_LABELED, replace=False)
+
+    with tempfile.TemporaryDirectory() as repo:
+        dl = ModelDownloader(repo)
+        schema = dl.download_model("DigitsConvNet")
+        print("downloaded:", schema.name, "| dataset:", schema.dataset)
+        print("sha256:", schema.sha256[:16], "…  (hash-verified fixture)")
+        dnn = DNNModel.from_downloader(repo, schema.name)
+
+    featurizer = (ImageFeaturizer(dnn, input_hw=(32, 32))
+                  .set(inputCol="img", outputCol="cnn_features"))
+
+    train_ds = Dataset({"img": digits_images(Xtr[lab]),
+                        "pixels": Xtr[lab].astype(np.float32),
+                        "label": ytr[lab].astype(np.float64)})
+    test_ds = Dataset({"img": digits_images(Xte),
+                       "pixels": Xte.astype(np.float32),
+                       "label": yte.astype(np.float64)})
+
+    acc_raw = fit_eval(train_ds, test_ds, "pixels", yte)
+    acc_cnn = fit_eval(featurizer.transform(train_ds),
+                       featurizer.transform(test_ds), "cnn_features", yte)
+    print(f"{N_LABELED}-label held-out accuracy: raw pixels {acc_raw:.4f} "
+          f"vs pretrained CNN features {acc_cnn:.4f}")
+    # the transfer-learning payoff the reference's notebook 9 demonstrates:
+    # pretrained features beat raw pixels under the same downstream learner
+    # (deterministic seeds; measured gap ~0.10)
+    assert acc_cnn - acc_raw > 0.05, (acc_cnn, acc_raw)
+    assert acc_cnn > 0.75
+    return acc_cnn - acc_raw
+
+
+if __name__ == "__main__":
+    print("transfer-learning gain:", round(main(), 4))

@@ -98,6 +98,21 @@ _BUILTIN: Dict[str, Dict[str, Any]] = {
                          block="basic", num_classes=10, input_hw=(28, 28)),
 }
 
+# genuinely TRAINED checkpoints shipped as package fixtures (zero-egress
+# stand-in for the reference's Azure blob repo of trained CNTK models);
+# sha256 is pinned at training time (tools/train_digits_fixture.py), so a
+# corrupted or tampered fixture fails the same hash check a remote fetch
+# would (downloader/ModelDownloader.scala:37-276)
+_TRAINED_FIXTURES: Dict[str, Dict[str, Any]] = {
+    "DigitsConvNet": dict(
+        file="digits_convnet.npz", dataset="sklearn-digits (trained, "
+        "~0.97 held-out accuracy — tools/train_digits_fixture.py)",
+        sha256="6e812a1fb56bd4b603deec27abc49c8d7010bca5ce56909fc5bb0cb2"
+               "c7c5e5b4",
+        spec=dict(arch="resnet", stage_sizes=(1, 1), width=8, block="basic",
+                  num_classes=10, input_hw=(32, 32))),
+}
+
 
 def _layer_names(spec: Dict[str, Any]) -> List[str]:
     if spec["arch"] == "alexnet":
@@ -187,13 +202,22 @@ class ModelDownloader:
         return out
 
     def remote_models(self) -> List[ModelSchema]:
-        """The builtin catalog (the Azure-blob listing analog)."""
-        return [ModelSchema(name=n, modelType="image",
-                            uri=f"builtin://{n}",
-                            inputDims=[*spec["input_hw"], 3],
-                            numLayers=_num_layers(spec),
-                            layerNames=_layer_names(spec))
-                for n, spec in _BUILTIN.items()]
+        """The builtin catalog (the Azure-blob listing analog): trained
+        package fixtures first, then the deterministic-init architectures."""
+        trained = [ModelSchema(name=n, modelType="image",
+                               dataset=t["dataset"],
+                               uri=f"package://{t['file']}",
+                               sha256=t["sha256"],
+                               inputDims=[*t["spec"]["input_hw"], 3],
+                               numLayers=_num_layers(t["spec"]),
+                               layerNames=_layer_names(t["spec"]))
+                   for n, t in _TRAINED_FIXTURES.items()]
+        return trained + [ModelSchema(name=n, modelType="image",
+                                      uri=f"builtin://{n}",
+                                      inputDims=[*spec["input_hw"], 3],
+                                      numLayers=_num_layers(spec),
+                                      layerNames=_layer_names(spec))
+                          for n, spec in _BUILTIN.items()]
 
     # -- fetching -----------------------------------------------------------
     def download_model(self, schema_or_name) -> ModelSchema:
@@ -287,8 +311,9 @@ class ModelDownloader:
         for s in self.remote_models():
             if s.name == name:
                 return s
-        raise KeyError(f"unknown model {name!r}; "
-                       f"builtins: {sorted(_BUILTIN)}")
+        raise KeyError(
+            f"unknown model {name!r}; catalog: "
+            f"{sorted(_TRAINED_FIXTURES) + sorted(_BUILTIN)}")
 
     def _read_schema(self, name: str) -> ModelSchema:
         with open(os.path.join(self.repo_dir, name, "schema.json")) as f:
@@ -302,6 +327,11 @@ class ModelDownloader:
 
     def _fetch(self, schema: ModelSchema) -> bytes:
         uri = schema.uri
+        if uri.startswith("package://"):
+            path = os.path.join(os.path.dirname(__file__), "fixtures",
+                                uri[len("package://"):])
+            with open(path, "rb") as f:
+                return f.read()
         if uri.startswith("builtin://"):
             return self._materialize_builtin(uri[len("builtin://"):])
         if uri.startswith("file://"):

@@ -55,6 +55,8 @@ setup(
     package_data={
         "mmlspark_tpu.native": ["mmlspark_native.cpp",
                                 "mmlspark_native_prebuilt.so"],
+        # trained model fixtures served by ModelDownloader's package:// repo
+        "mmlspark_tpu.models.dnn": ["fixtures/*.npz"],
     },
     cmdclass={"build_py": build_py_with_native},
 )

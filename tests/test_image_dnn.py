@@ -242,3 +242,47 @@ def test_model_downloader_file_uri_and_hash_check(tmp_path):
     bad = ModelSchema(name="ext2", uri=f"file://{src}", sha256="0" * 64)
     with pytest.raises(IOError, match="hash mismatch"):
         d.download_model(bad)
+
+
+class TestTrainedFixture:
+    """DigitsConvNet: the genuinely-pretrained package checkpoint
+    (tools/train_digits_fixture.py; reference parity for the Azure repo of
+    trained models, downloader/ModelDownloader.scala:37-276)."""
+
+    def _digits_heldout(self):
+        from sklearn.datasets import load_digits
+
+        from mmlspark_tpu.models.dnn.digits_fixture import (heldout_split,
+                                                            prep_digits)
+
+        X, y = load_digits(return_X_y=True)
+        _, Xte, _, yte = heldout_split(X, y)  # unseen by the trainer
+        return prep_digits(Xte), yte
+
+    def test_catalog_lists_trained_model(self, tmp_path):
+        d = ModelDownloader(str(tmp_path))
+        cat = {m.name: m for m in d.remote_models()}
+        assert "DigitsConvNet" in cat
+        assert "trained" in cat["DigitsConvNet"].dataset
+        assert cat["DigitsConvNet"].sha256  # hash pinned in the catalog
+
+    def test_download_verifies_hash_and_model_is_trained(self, tmp_path):
+        import jax.numpy as jnp
+
+        d = ModelDownloader(str(tmp_path))
+        schema = d.download_model("DigitsConvNet")
+        assert schema.sha256
+        params, cfg, apply_fn = d.load_model("DigitsConvNet")
+        x, yte = self._digits_heldout()
+        logits, _ = apply_fn(params, jnp.asarray(x))
+        acc = float((np.argmax(np.asarray(logits), 1) == yte).mean())
+        # deterministic-init builtins score ~0.1 here; only genuine
+        # training reaches this
+        assert acc > 0.9, acc
+
+    def test_tampered_fixture_fails_hash(self, tmp_path):
+        d = ModelDownloader(str(tmp_path))
+        schema = d._builtin_schema("DigitsConvNet")
+        schema.sha256 = "0" * 64   # simulates fixture/catalog mismatch
+        with pytest.raises(IOError, match="hash mismatch"):
+            d.download_model(schema)

@@ -1,0 +1,95 @@
+"""Train the DigitsConvNet fixture — the repo's genuinely-pretrained model.
+
+The reference's ModelDownloader serves *trained* CNTK checkpoints from an
+Azure blob repo (reference: downloader/ModelDownloader.scala:37-276). This
+environment has zero egress, so the equivalent trained artifact is produced
+in-repo by this script and shipped as a package fixture
+(mmlspark_tpu/models/dnn/fixtures/digits_convnet.npz) that
+``ModelDownloader.download_model("DigitsConvNet")`` materializes with the
+same hash bookkeeping as a remote fetch.
+
+Model: ResNet-v1 basic-block CNN (stages (1,1), width 8) on sklearn digits
+(8x8 grayscale, nearest-upsampled to 32x32, channels replicated, pixels
+normalized to [-1, 1] — ImageFeaturizer's default mean/std of 127.5).
+Reaches ~0.97 held-out accuracy in ~60 epochs (~20 s on one CPU core).
+
+Run:  python tools/train_digits_fixture.py
+"""
+
+import hashlib
+import os
+import sys
+
+import numpy as np
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "..", "mmlspark_tpu",
+                       "models", "dnn", "fixtures", "digits_convnet.npz")
+
+
+def main(epochs: int = 60, seed: int = 0) -> str:
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from sklearn.datasets import load_digits
+
+    from mmlspark_tpu.models.dnn.cnn import (CNNConfig, apply_cnn,
+                                             init_cnn_params)
+    from mmlspark_tpu.models.dnn.digits_fixture import (heldout_split,
+                                                        prep_digits)
+    from mmlspark_tpu.models.dnn.downloader import serialize_payload
+
+    X, y = load_digits(return_X_y=True)
+    # the held-out quarter is NEVER seen in pretraining: downstream
+    # transfer-learning evaluations (example 21, tests) reuse the same
+    # shared split helper, so their test measurements are honest
+    Xtr, Xte, ytr, yte = heldout_split(X, y)
+    Xtr_i, Xte_i = prep_digits(Xtr), prep_digits(Xte)
+
+    cfg = CNNConfig(num_classes=10, stage_sizes=(1, 1), width=8,
+                    block="basic", input_hw=(32, 32))
+    params = init_cnn_params(cfg, jax.random.PRNGKey(seed))
+    sched = optax.cosine_decay_schedule(3e-3, epochs * 10)
+    opt = optax.adam(sched)
+    state = opt.init(params)
+
+    def loss_fn(p, xb, yb):
+        logits, _ = apply_cnn(p, xb, cfg)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb).mean()
+
+    @jax.jit
+    def step(p, s, xb, yb):
+        loss, g = jax.value_and_grad(loss_fn)(p, xb, yb)
+        updates, s = opt.update(g, s)
+        return optax.apply_updates(p, updates), s, loss
+
+    rng = np.random.default_rng(seed)
+    bs = 128
+    for epoch in range(epochs):
+        idx = rng.permutation(len(Xtr_i))
+        for i in range(0, len(idx) - bs + 1, bs):
+            b = idx[i:i + bs]
+            params, state, loss = step(params, state, jnp.asarray(Xtr_i[b]),
+                                       jnp.asarray(ytr[b]))
+    logits, _ = apply_cnn(params, jnp.asarray(Xte_i), cfg)
+    acc = float((np.argmax(np.asarray(logits), 1) == yte).mean())
+    print(f"held-out accuracy: {acc:.4f}")
+    assert acc > 0.9, "fixture must be genuinely trained"
+
+    config = dict(arch="resnet", num_classes=10, stage_sizes=(1, 1),
+                  width=8, block="basic", input_hw=(32, 32))
+    params_np = jax.tree_util.tree_map(np.asarray, params)
+    data = serialize_payload(params_np, config)
+    out = os.path.abspath(FIXTURE)
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "wb") as f:
+        f.write(data)
+    digest = hashlib.sha256(data).hexdigest()
+    print(f"wrote {out} ({len(data)} bytes)")
+    print(f"sha256: {digest}")
+    print("register this hash in downloader._TRAINED_FIXTURES")
+    return digest
+
+
+if __name__ == "__main__":
+    main(epochs=int(sys.argv[1]) if len(sys.argv) > 1 else 60)
