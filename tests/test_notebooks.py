@@ -1,0 +1,66 @@
+"""Notebook corpus integrity (the reference's notebooks/samples + nbtest leg).
+
+The .ipynb corpus is GENERATED from the pytest-executed example scripts by
+tools/make_notebooks.py; these tests pin (a) the corpus is in sync with the
+scripts (regeneration is a no-op), (b) every notebook is valid nbformat-4,
+and (c) the notebook form factor actually executes (one representative
+notebook's code cells run end-to-end — the full behavioral coverage lives
+in tests/test_examples.py, which runs every script).
+"""
+
+import glob
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+NB_DIR = os.path.join(ROOT, "notebooks", "samples")
+
+
+def test_corpus_in_sync_with_examples(tmp_path, monkeypatch):
+    import make_notebooks as mk
+
+    monkeypatch.setattr(mk, "NOTEBOOKS", str(tmp_path))
+    fresh = mk.generate()
+    checked_in = sorted(glob.glob(os.path.join(NB_DIR, "*.ipynb")))
+    assert [os.path.basename(p) for p in fresh] == \
+        [os.path.basename(p) for p in checked_in], \
+        "run tools/make_notebooks.py and commit the result"
+    for f, c in zip(fresh, checked_in):
+        assert (open(f, encoding="utf-8").read()
+                == open(c, encoding="utf-8").read()), (
+            f"{os.path.basename(c)} is stale: run tools/make_notebooks.py")
+
+
+def test_every_notebook_is_valid_nbformat4():
+    import warnings
+
+    nbformat = pytest.importorskip("nbformat")
+
+    nbs = sorted(glob.glob(os.path.join(NB_DIR, "*.ipynb")))
+    assert len(nbs) >= 21
+    for p in nbs:
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")   # missing ids etc. must not warn
+            nbformat.validate(nbformat.read(p, as_version=4))
+
+
+@pytest.mark.parametrize("name", ["01_classification.ipynb",
+                                  "11_pretrained_import.ipynb"])
+def test_notebook_executes(name):
+    # smoke-run the notebook FORM (cells in order): one plain example and
+    # the __file__-referencing one (exercises the generated compat cell);
+    # every script is behaviorally covered by tests/test_examples.py
+    p = os.path.join(NB_DIR, name)
+    nb = json.load(open(p, encoding="utf-8"))
+    code = "\n\n".join("".join(c["source"]) for c in nb["cells"]
+                       if c["cell_type"] == "code")
+    cwd = os.getcwd()
+    os.chdir(ROOT)                      # notebooks run from the repo root
+    try:
+        exec(compile(code, p, "exec"), {"__name__": "__main__"})  # noqa: S102
+    finally:
+        os.chdir(cwd)

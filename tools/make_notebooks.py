@@ -1,0 +1,164 @@
+"""Generate the notebook corpus from the tested example scripts.
+
+The reference ships 26 runnable sample notebooks (notebooks/samples/) and
+executes them as a CI leg (nbtest/NotebookTests.scala). This repo's examples
+live as pytest-executed .py scripts (tests/test_examples.py — strictly
+stronger CI), and this tool derives the notebook form factor from them so
+the corpus can never drift from tested code:
+
+* the module docstring becomes the title/markdown cell;
+* consecutive imports form one cell, each top-level def/class is its own
+  cell, and the ``__main__`` guard becomes a dedented invocation cell;
+* scripts that reference ``__file__`` get a compat cell pinning it to the
+  source script path (notebooks run from the repo root);
+* generation is deterministic (UTF-8, stable cell ids) and prunes orphaned
+  notebooks — tests/test_notebooks.py asserts the checked-in corpus matches
+  a fresh regeneration.
+
+Run:  python tools/make_notebooks.py
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import textwrap
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(ROOT, "examples")
+NOTEBOOKS = os.path.join(ROOT, "notebooks", "samples")
+
+
+def _is_main_guard(node) -> bool:
+    """True for ``if __name__ == "__main__":`` (either comparison order)."""
+    if not isinstance(node, ast.If) or not isinstance(node.test, ast.Compare):
+        return False
+    parts = [node.test.left] + list(node.test.comparators)
+    return any(isinstance(p, ast.Name) and p.id == "__name__"
+               for p in parts)
+
+
+def _cells_from_script(path: str):
+    src = open(path, encoding="utf-8").read()
+    tree = ast.parse(src)
+    lines = src.splitlines()
+    cells = []
+
+    # markdown cell from the module docstring
+    doc = ast.get_docstring(tree)
+    body = list(tree.body)
+    if doc:
+        title, _, rest = doc.partition("\n")
+        md = f"# {title.strip()}\n\n{rest.strip()}"
+        cells.append(("markdown", md))
+        body = body[1:]  # drop the docstring node
+
+    # group top-level nodes into cells: consecutive imports together, each
+    # def/class its own cell, other statements grouped until the next def
+    groups: list = []
+    current: list = []
+
+    def flush():
+        if current:
+            groups.append(list(current))
+            current.clear()
+
+    prev_import = None
+    for node in body:
+        is_def = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef))
+        is_import = isinstance(node, (ast.Import, ast.ImportFrom))
+        if is_def or (prev_import is not None and is_import != prev_import):
+            flush()
+        current.append(node)
+        if is_def:
+            flush()
+        prev_import = is_import
+    flush()
+
+    for g in groups:
+        # the __main__ guard becomes a dedented invocation cell (split it
+        # out even if grouped with preceding statements)
+        plain, guards = [n for n in g if not _is_main_guard(n)], \
+            [n for n in g if _is_main_guard(n)]
+        for sub in (plain, guards):
+            if not sub:
+                continue
+            start = sub[0].lineno - 1
+            deco = getattr(sub[0], "decorator_list", [])
+            if deco:
+                start = deco[0].lineno - 1
+            end = sub[-1].end_lineno
+            chunk = "\n".join(lines[start:end]).rstrip()
+            if not chunk:
+                continue
+            if sub is guards:
+                body = chunk.split("\n", 1)
+                chunk = (textwrap.dedent(body[1]).rstrip()
+                         if len(body) > 1 else "")
+                if not chunk:
+                    continue
+            cells.append(("code", chunk))
+
+    # scripts that locate resources via __file__ need it defined in the
+    # kernel; pin it to the source script (notebooks run from the repo root)
+    if any("__file__" in text for kind, text in cells if kind == "code"):
+        rel = os.path.relpath(path, ROOT)
+        insert_at = 1 if cells and cells[0][0] == "markdown" else 0
+        cells.insert(insert_at,
+                     ("code", f'__file__ = "{rel}"  # notebook compat'))
+    return cells
+
+
+def _source_lines(text: str) -> list:
+    lines = text.splitlines()
+    return [ln + "\n" for ln in lines[:-1]] + lines[-1:] if lines else []
+
+
+def _notebook_json(cells) -> str:
+    nb = {
+        "cells": [
+            {"cell_type": kind,
+             "id": f"cell-{i}",          # deterministic: corpus is diffable
+             "metadata": {},
+             **({"outputs": [], "execution_count": None}
+                if kind == "code" else {}),
+             "source": _source_lines(text)}
+            for i, (kind, text) in enumerate(cells)
+        ],
+        "metadata": {
+            "kernelspec": {"display_name": "Python 3",
+                           "language": "python", "name": "python3"},
+            "language_info": {"name": "python", "version": "3"},
+        },
+        "nbformat": 4,
+        "nbformat_minor": 5,
+    }
+    return json.dumps(nb, indent=1, sort_keys=True) + "\n"
+
+
+def generate() -> list:
+    os.makedirs(NOTEBOOKS, exist_ok=True)
+    written = []
+    for fname in sorted(os.listdir(EXAMPLES)):
+        if not fname.endswith(".py"):
+            continue
+        cells = _cells_from_script(os.path.join(EXAMPLES, fname))
+        out = os.path.join(NOTEBOOKS, fname[:-3] + ".ipynb")
+        with open(out, "w", encoding="utf-8") as f:
+            f.write(_notebook_json(cells))
+        written.append(out)
+    # prune notebooks whose source example was renamed/removed: a stale
+    # .ipynb would otherwise ship forever and fail the sync test with no
+    # regeneration able to fix it
+    keep = {os.path.basename(p) for p in written}
+    for fname in os.listdir(NOTEBOOKS):
+        if fname.endswith(".ipynb") and fname not in keep:
+            os.remove(os.path.join(NOTEBOOKS, fname))
+    return written
+
+
+if __name__ == "__main__":
+    for p in generate():
+        print("wrote", os.path.relpath(p, ROOT))
