@@ -1,0 +1,136 @@
+"""TPU histogram/train microbench — run the moment the relay is back.
+
+Times everything the round-3 perf plan needs, with the tunnel-safe sync
+discipline (scalar download minus the measured round-trip floor;
+block_until_ready lies under the axon tunnel — docs/developer.md):
+
+1. round-trip floor;
+2. node_histogram at the bench shape (1M x 28, B=255/63, W=1/2/16/31,
+   bf16 vs int8) with the static unroll on and off
+   (MMLSPARK_TPU_HIST_UNROLL_MAX) — validates the committed unroll win;
+3. one fused 10-iteration train_booster dispatch (depthwise + batched
+   leafwise), the quantity bench.py's primary metric is made of.
+
+Prints one JSON line per measurement. Usage:
+    python tools/tpu_microbench.py            # full sweep
+    python tools/tpu_microbench.py quick      # floor + headline configs
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure_floor(jnp, reps=5):
+    # one floor methodology for the whole repo: bench.py owns it
+    import bench
+    del jnp, reps
+    return bench._roundtrip_floor_s()
+
+
+def timed(fn, floor, reps=3):
+    """Best-of-reps wall time of fn() (fn must end in a scalar download)."""
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0 - floor)
+    return max(best, 1e-9)
+
+
+def main(quick=False):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mmlspark_tpu.ops.histogram import node_histogram, quantize_stats
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+    print(json.dumps({"platform": jax.devices()[0].platform,
+                      "device": str(jax.devices()[0])}))
+    floor = measure_floor(jnp)
+    print(json.dumps({"roundtrip_floor_ms": round(floor * 1e3, 2)}))
+
+    n, F = 1_000_000, 28
+    rng = np.random.default_rng(0)
+    base_np = rng.normal(size=(3, n)).astype(np.float32)
+    base_np[2] = 1.0
+
+    for B in ([255] if quick else [255, 63]):
+        binned_np = rng.integers(0, B, size=(F, n), dtype=np.int32)
+        binned = jnp.asarray(binned_np)
+        base = jnp.asarray(base_np)
+        for W in ([2, 16] if quick else [1, 2, 16, 31]):
+            pos_np = rng.integers(-1, W, size=n).astype(np.int32)
+            pos = jnp.asarray(pos_np)
+            for quant in (False, True):
+                if quant:
+                    bq, scales = quantize_stats(base)
+                    fn_in = (binned, pos, bq)
+                    kw = dict(scales=scales)
+                else:
+                    fn_in = (binned, pos, base)
+                    kw = {}
+
+                # unroll on vs off is THE comparison this tool exists for:
+                # the env var is read at trace time, so each setting gets
+                # its own freshly-traced jit closure
+                for unroll in ("default", "0"):
+                    if unroll == "0" and quick:
+                        continue
+                    if unroll == "0":
+                        os.environ["MMLSPARK_TPU_HIST_UNROLL_MAX"] = "0"
+                    else:
+                        os.environ.pop("MMLSPARK_TPU_HIST_UNROLL_MAX", None)
+
+                    @jax.jit
+                    def hist_sum(b, p, s, _u=unroll):
+                        return jnp.sum(node_histogram(b, p, s, W, B, **kw))
+
+                    float(hist_sum(*fn_in))      # compile + materialize
+                    dt = timed(lambda: float(hist_sum(*fn_in)), floor)
+                    print(json.dumps({
+                        "node_histogram_ms": round(dt * 1e3, 2),
+                        "B": B, "W": W, "int8": quant,
+                        "unroll": unroll}))
+                os.environ.pop("MMLSPARK_TPU_HIST_UNROLL_MAX", None)
+
+    # full fused train dispatch: the primary bench quantity
+    from mmlspark_tpu.models.gbdt.booster import (LightGBMDataset,
+                                                  train_booster)
+    from mmlspark_tpu.models.gbdt.growth import GrowConfig
+
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.5 * X[:, 2] ** 2 - X[:, 3] > 0
+         ).astype(np.float32)
+    t0 = time.perf_counter()
+    ds = LightGBMDataset.construct(X, y, max_bin=255)
+    # force the async device binning before closing the timed window
+    float(jnp.sum(ds.Xbt_d))
+    print(json.dumps({"ingest_sec": round(time.perf_counter() - t0 - floor,
+                                          2)}))
+    for policy in (["depthwise"] if quick else ["depthwise", "leafwise"]):
+        cfg = GrowConfig(num_leaves=31, growth_policy=policy)
+        train_booster(dataset=ds, objective="binary", num_iterations=10,
+                      cfg=cfg)     # warm/compile
+        # train_booster ends in the packed tree download (a real device
+        # sync); best-of-2 because identical runs jitter by seconds
+        # through the relay (docs/performance.md)
+        dt = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            b = train_booster(dataset=ds, objective="binary",
+                              num_iterations=10, cfg=cfg)
+            dt = min(dt, time.perf_counter() - t0)
+        acc = float(((b.predict(X[:50_000]) > 0.5) == y[:50_000]).mean())
+        print(json.dumps({"train10_sec": round(dt, 2),
+                          "trees_per_sec": round(10 / dt, 2),
+                          "policy": policy,
+                          "train_accuracy_50k": round(acc, 3)}))
+
+
+if __name__ == "__main__":
+    main(quick="quick" in sys.argv[1:])
