@@ -29,6 +29,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...parallel.ring_attention import ring_attention
+from ...parallel.ulysses import ulysses_attention
 
 
 class TransformerConfig(NamedTuple):
@@ -40,6 +41,11 @@ class TransformerConfig(NamedTuple):
     d_ff: int = 2048
     max_len: int = 2048
     dtype: Any = jnp.bfloat16
+    # sequence-parallel attention strategy over the 'seq' mesh axis:
+    # "ring" (neighbor ppermute, O(S_local) memory, no head constraint) or
+    # "ulysses" (two all-to-alls reshard heads<->sequence, plain local
+    # attention; needs per-TP-rank heads divisible by the seq shard count)
+    seq_attention: str = "ring"
 
 
 def init_params(cfg: TransformerConfig, key) -> Dict:
@@ -128,7 +134,16 @@ def forward_local(params, tokens, cfg: TransformerConfig,
         q = qkv[:, :, :, 0].transpose(0, 2, 1, 3)  # [B, Hl, S, Dh]
         k = qkv[:, :, :, 1].transpose(0, 2, 1, 3)
         v = qkv[:, :, :, 2].transpose(0, 2, 1, 3)
-        att = ring_attention(q, k, v, axis_name="seq", causal=causal)
+        if cfg.seq_attention == "ulysses":
+            att = ulysses_attention(q, k, v, axis_name="seq", causal=causal)
+        elif cfg.seq_attention == "ring":
+            att = ring_attention(q, k, v, axis_name="seq", causal=causal)
+        else:
+            # both strategies are exact, so a typo would silently measure
+            # the wrong one — fail loudly instead
+            raise ValueError(
+                f"unknown seq_attention {cfg.seq_attention!r}: "
+                "use 'ring' or 'ulysses'")
         att = att.transpose(0, 2, 1, 3).reshape(B, S, Hl * Dh)
         out = jnp.einsum("bsk,ke->bse", att, lp["wo"].astype(dt),
                          preferred_element_type=jnp.float32)

@@ -1,0 +1,56 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence resharding.
+
+The second of the framework's two long-context strategies (alongside
+parallel/ring_attention.py; the reference has neither — SURVEY.md §5). The
+DeepSpeed-Ulysses formulation (Jacobs et al. 2023, arXiv 2309.14509) trades
+the ring's n-step neighbor ppermute for TWO all-to-all collectives: with
+activations sequence-sharded, an all-to-all converts [B, H, S/n, D] into
+[B, H/n, S, D] — every device now holds the FULL sequence for a subset of
+heads — so plain (flash) attention runs locally with no inner loop, and a
+second all-to-all restores sequence sharding afterward.
+
+Trade-off vs ring: Ulysses moves 2x the activation volume per collective
+but in 2 large transfers instead of n small ones, and the attention itself
+needs no online-softmax loop — typically faster on all-to-all-friendly
+fabrics (ICI) when H is divisible by the shard count; ring has no head
+constraint and O(S_local) memory. Both are exact.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from .ring_attention import local_attention
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name: str, causal: bool = True,
+                      scale: Optional[float] = None) -> jnp.ndarray:
+    """Exact attention over sequence shards via head/sequence all-to-all.
+
+    q, k, v: [B, H, S_local, D] — the local sequence shard, inside
+    ``shard_map``. H must be divisible by the ``axis_name`` shard count.
+    Returns [B, H, S_local, D] in q's dtype.
+    """
+    n = lax.axis_size(axis_name)
+    H = q.shape[1]
+    if H % n:
+        raise ValueError(
+            f"ulysses_attention needs heads ({H}) divisible by the "
+            f"'{axis_name}' shard count ({n}); use ring_attention for "
+            "uneven head counts")
+
+    def to_heads(x):
+        # [B, H, S/n, D] -> [B, H/n, S, D]; tiled all_to_all concatenates
+        # in axis-index order, so contiguous sequence shards reassemble in
+        # global order and causal masking needs no position bookkeeping
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    out = local_attention(to_heads(q), to_heads(k), to_heads(v),
+                          causal=causal, scale=scale)
+    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True).astype(q.dtype)

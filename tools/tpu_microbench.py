@@ -77,26 +77,36 @@ def main(quick=False):
 
                 # unroll on vs off is THE comparison this tool exists for:
                 # the env var is read at trace time, so each setting gets
-                # its own freshly-traced jit closure
-                for unroll in ("default", "0"):
-                    if unroll == "0" and quick:
-                        continue
-                    if unroll == "0":
-                        os.environ["MMLSPARK_TPU_HIST_UNROLL_MAX"] = "0"
-                    else:
+                # its own freshly-traced jit closure. The operator's own
+                # setting (e.g. the =0 Mosaic escape hatch) is restored
+                # afterward so the train section honors it.
+                saved = os.environ.get("MMLSPARK_TPU_HIST_UNROLL_MAX")
+                try:
+                    for unroll in ("default", "0"):
+                        if unroll == "0" and quick:
+                            continue
+                        if unroll == "0":
+                            os.environ["MMLSPARK_TPU_HIST_UNROLL_MAX"] = "0"
+                        else:
+                            os.environ.pop("MMLSPARK_TPU_HIST_UNROLL_MAX",
+                                           None)
+
+                        @jax.jit
+                        def hist_sum(b, p, s, _u=unroll):
+                            return jnp.sum(
+                                node_histogram(b, p, s, W, B, **kw))
+
+                        float(hist_sum(*fn_in))  # compile + materialize
+                        dt = timed(lambda: float(hist_sum(*fn_in)), floor)
+                        print(json.dumps({
+                            "node_histogram_ms": round(dt * 1e3, 2),
+                            "B": B, "W": W, "int8": quant,
+                            "unroll": unroll}))
+                finally:
+                    if saved is None:
                         os.environ.pop("MMLSPARK_TPU_HIST_UNROLL_MAX", None)
-
-                    @jax.jit
-                    def hist_sum(b, p, s, _u=unroll):
-                        return jnp.sum(node_histogram(b, p, s, W, B, **kw))
-
-                    float(hist_sum(*fn_in))      # compile + materialize
-                    dt = timed(lambda: float(hist_sum(*fn_in)), floor)
-                    print(json.dumps({
-                        "node_histogram_ms": round(dt * 1e3, 2),
-                        "B": B, "W": W, "int8": quant,
-                        "unroll": unroll}))
-                os.environ.pop("MMLSPARK_TPU_HIST_UNROLL_MAX", None)
+                    else:
+                        os.environ["MMLSPARK_TPU_HIST_UNROLL_MAX"] = saved
 
     # full fused train dispatch: the primary bench quantity
     from mmlspark_tpu.models.gbdt.booster import (LightGBMDataset,
