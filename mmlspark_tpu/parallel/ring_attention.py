@@ -88,6 +88,50 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.astype(q.dtype)
 
 
+def blockwise_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        causal: bool = True, scale: Optional[float] = None,
+                        block_size: int = 512) -> jnp.ndarray:
+    """Exact flash-style attention on ONE device: online softmax over K/V
+    blocks, never materializing the [S, S] score matrix. Memory is
+    O(S * block_size) — the single-device analog of the ring loop (and the
+    local kernel Ulysses runs after its all-to-all reshard)."""
+    B, H, S, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    bs = min(int(block_size), S)
+    nb = -(-S // bs)
+    S_pad = nb * bs
+    k32 = k.astype(jnp.float32)
+    v32 = v.astype(jnp.float32)
+    if S_pad != S:
+        pad = ((0, 0), (0, 0), (0, S_pad - S), (0, 0))
+        k32, v32 = jnp.pad(k32, pad), jnp.pad(v32, pad)
+    k_blocks = k32.reshape(B, H, nb, bs, D).transpose(2, 0, 1, 3, 4)
+    v_blocks = v32.reshape(B, H, nb, bs, D).transpose(2, 0, 1, 3, 4)
+
+    q32 = q.astype(jnp.float32)
+    q_pos = jnp.arange(S)
+
+    def body(carry, xs):
+        acc, m, denom = carry
+        blk, k_blk, v_blk = xs
+        k_pos = blk * bs + jnp.arange(bs)
+        ok = k_pos[None, :] < S                      # mask padded keys
+        if causal:
+            ok = ok & (q_pos[:, None] >= k_pos[None, :])
+        bias = jnp.where(ok, 0.0, -jnp.inf)
+        acc, m, denom = _block_attend(q32, k_blk, v_blk, bias, acc, m,
+                                      denom, scale)
+        return (acc, m, denom), None
+
+    init = (jnp.zeros((B, H, S, D), jnp.float32),
+            jnp.full((B, H, S), -jnp.inf, jnp.float32),
+            jnp.zeros((B, H, S), jnp.float32))
+    (acc, m, denom), _ = lax.scan(
+        body, init, (jnp.arange(nb), k_blocks, v_blocks))
+    out = acc / jnp.maximum(denom[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
 def local_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = True,
                     scale: Optional[float] = None) -> jnp.ndarray:

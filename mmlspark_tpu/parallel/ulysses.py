@@ -6,8 +6,8 @@ DeepSpeed-Ulysses formulation (Jacobs et al. 2023, arXiv 2309.14509) trades
 the ring's n-step neighbor ppermute for TWO all-to-all collectives: with
 activations sequence-sharded, an all-to-all converts [B, H, S/n, D] into
 [B, H/n, S, D] — every device now holds the FULL sequence for a subset of
-heads — so plain (flash) attention runs locally with no inner loop, and a
-second all-to-all restores sequence sharding afterward.
+heads — so flash-style blockwise attention runs locally with no collective
+in its inner loop, and a second all-to-all restores sequence sharding.
 
 Trade-off vs ring: Ulysses moves 2x the activation volume per collective
 but in 2 large transfers instead of n small ones, and the attention itself
@@ -23,17 +23,21 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import lax
 
-from .ring_attention import local_attention
+from .ring_attention import blockwise_attention
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       axis_name: str, causal: bool = True,
-                      scale: Optional[float] = None) -> jnp.ndarray:
+                      scale: Optional[float] = None,
+                      block_size: int = 512) -> jnp.ndarray:
     """Exact attention over sequence shards via head/sequence all-to-all.
 
     q, k, v: [B, H, S_local, D] — the local sequence shard, inside
     ``shard_map``. H must be divisible by the ``axis_name`` shard count.
-    Returns [B, H, S_local, D] in q's dtype.
+    The post-reshard kernel is flash-style blockwise attention (online
+    softmax over ``block_size`` K/V blocks), so the full [S, S] score
+    matrix is never materialized even though each device sees the whole
+    sequence. Returns [B, H, S_local, D] in q's dtype.
     """
     n = lax.axis_size(axis_name)
     H = q.shape[1]
@@ -50,7 +54,8 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
-    out = local_attention(to_heads(q), to_heads(k), to_heads(v),
-                          causal=causal, scale=scale)
+    out = blockwise_attention(to_heads(q), to_heads(k), to_heads(v),
+                              causal=causal, scale=scale,
+                              block_size=block_size)
     return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
                           tiled=True).astype(q.dtype)

@@ -13,7 +13,17 @@ from jax.sharding import PartitionSpec as P
 
 from mmlspark_tpu.parallel.mesh import (make_mesh, num_shards, pad_rows,
                                         shard_rows, validity_mask)
-from mmlspark_tpu.parallel.ring_attention import local_attention, ring_attention
+from mmlspark_tpu.parallel.ring_attention import (blockwise_attention,
+                                                  local_attention,
+                                                  ring_attention)
+
+
+def run_seq_sharded(fn, mesh, q, k, v):
+    """Shared harness: run a seq-axis attention fn under shard_map with
+    [B, H, S, D] inputs sharded on the sequence axis."""
+    return np.asarray(jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(P(None, None, "seq", None),) * 3,
+        out_specs=P(None, None, "seq", None), check_vma=False))(q, k, v))
 
 
 class TestMesh:
@@ -52,11 +62,9 @@ class TestRingAttention:
         rng = np.random.default_rng(0)
         q, k, v = [rng.normal(size=(B, H, S, D)).astype(np.float32)
                    for _ in range(3)]
-        ring = jax.jit(jax.shard_map(
+        out_r = run_seq_sharded(
             lambda q, k, v: ring_attention(q, k, v, "seq", causal=causal),
-            mesh=mesh, in_specs=(P(None, None, "seq", None),) * 3,
-            out_specs=P(None, None, "seq", None), check_vma=False))
-        out_r = np.asarray(ring(q, k, v))
+            mesh, q, k, v)
         out_l = np.asarray(local_attention(
             jax.numpy.asarray(q), jax.numpy.asarray(k), jax.numpy.asarray(v),
             causal=causal))
@@ -68,13 +76,27 @@ class TestRingAttention:
         rng = np.random.default_rng(1)
         q, k, v = [rng.normal(size=(B, H, S, D)).astype(np.float32)
                    for _ in range(3)]
-        ring = jax.jit(jax.shard_map(
-            lambda q, k, v: ring_attention(q, k, v, "seq"),
-            mesh=mesh, in_specs=(P(None, None, "seq", None),) * 3,
-            out_specs=P(None, None, "seq", None), check_vma=False))
-        out = np.asarray(ring(q, k, v))
+        out = run_seq_sharded(lambda q, k, v: ring_attention(q, k, v, "seq"),
+                              mesh, q, k, v)
         ref = np.asarray(local_attention(*map(jax.numpy.asarray, (q, k, v))))
         assert np.allclose(out, ref, atol=1e-5)
+
+
+class TestBlockwiseAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("block_size", [8, 7, 64])
+    def test_matches_naive(self, causal, block_size):
+        # flash-style online softmax (the Ulysses local kernel) must equal
+        # the naive kernel, including ragged final blocks (S=33, bs=7/8)
+        rng = np.random.default_rng(2)
+        B, H, S, D = 2, 3, 33, 8
+        q, k, v = [jax.numpy.asarray(
+            rng.normal(size=(B, H, S, D)).astype(np.float32))
+            for _ in range(3)]
+        out_b = np.asarray(blockwise_attention(q, k, v, causal=causal,
+                                               block_size=block_size))
+        out_l = np.asarray(local_attention(q, k, v, causal=causal))
+        assert np.abs(out_b - out_l).max() < 1e-5
 
 
 class TestUlyssesAttention:
@@ -89,11 +111,9 @@ class TestUlyssesAttention:
                    for _ in range(3)]
 
         def run(fn):
-            return np.asarray(jax.jit(jax.shard_map(
+            return run_seq_sharded(
                 lambda q, k, v: fn(q, k, v, "seq", causal=causal),
-                mesh=mesh, in_specs=(P(None, None, "seq", None),) * 3,
-                out_specs=P(None, None, "seq", None),
-                check_vma=False))(q, k, v))
+                mesh, q, k, v)
 
         out_u = run(ulysses_attention)
         out_l = np.asarray(local_attention(
@@ -110,11 +130,9 @@ class TestUlyssesAttention:
         mesh = make_mesh({"seq": 4})
         q = np.zeros((1, 3, 32, 4), np.float32)   # 3 heads, 4 shards
         with pytest.raises(ValueError, match="divisible"):
-            jax.jit(jax.shard_map(
+            run_seq_sharded(
                 lambda q, k, v: ulysses_attention(q, k, v, "seq"),
-                mesh=mesh, in_specs=(P(None, None, "seq", None),) * 3,
-                out_specs=P(None, None, "seq", None),
-                check_vma=False))(q, q, q)
+                mesh, q, q, q)
 
 
 class TestTransformer:
