@@ -10,10 +10,11 @@ heads — so flash-style blockwise attention runs locally with no collective
 in its inner loop, and a second all-to-all restores sequence sharding.
 
 Trade-off vs ring: Ulysses moves 2x the activation volume per collective
-but in 2 large transfers instead of n small ones, and the attention itself
-needs no online-softmax loop — typically faster on all-to-all-friendly
-fabrics (ICI) when H is divisible by the shard count; ring has no head
-constraint and O(S_local) memory. Both are exact.
+but in 2 large transfers instead of n small ones, and its blockwise inner
+loop runs with no collective per step — typically faster on
+all-to-all-friendly fabrics (ICI) when H is divisible by the shard count;
+ring has no head constraint and O(S_local · block) memory vs Ulysses's
+O(S · block). Both are exact.
 """
 
 from __future__ import annotations
