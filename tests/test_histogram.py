@@ -113,7 +113,8 @@ class TestPallasInterpret:
         want = np.asarray(histogram_cols(binned_t, stats_t, B))
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
-    @pytest.mark.parametrize("B,W", [(255, 3), (63, 4), (31, 2)])
+    # (63, 16): the width batched leafwise emits (leaf_batch=8 -> 2*KB)
+    @pytest.mark.parametrize("B,W", [(255, 3), (63, 4), (31, 2), (63, 16)])
     def test_node_kernel_matches_xla_fallback(self, B, W, monkeypatch):
         rng = np.random.default_rng(1)
         n, F = 1100, 6
@@ -219,3 +220,18 @@ def test_wide_feature_fori_path_matches_xla(monkeypatch):
         monkeypatch.delenv("MMLSPARK_TPU_PALLAS_INTERPRET")
         monkeypatch.delenv("MMLSPARK_TPU_DISABLE_PALLAS_HIST", raising=False)
         importlib.reload(H)
+
+
+def test_vmem_picker_fits_bench_shapes_at_leafbatch_width():
+    """The TPU kernel must actually engage (row block > 0) at the bench
+    shape for every width the growth paths emit — a silent XLA fallback
+    would be ~10x slower and invisible on CPU."""
+    from mmlspark_tpu.ops.histogram import _pick_row_block
+
+    for B in (255, 63):
+        for W in (1, 2, 16, 31):
+            rb = _pick_row_block(1_000_000, 28, 3 * W, B, fused_w=W)
+            assert rb > 0, (B, W)
+            rbq = _pick_row_block(1_000_000, 28, 3 * W, B, fused_w=W,
+                                  quantized=True)
+            assert rbq > 0, ("quantized", B, W)
