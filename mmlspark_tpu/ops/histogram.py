@@ -248,16 +248,56 @@ def _hist_row_blocks(binned_t, stats_t, B, rows_per_block,
 # The XLA formulations above materialize the [n, B] one-hot (and the masked
 # stats) in HBM, so at 1M rows x 255 bins they run bandwidth-bound at ~55 ms.
 # The kernels below keep the one-hot entirely in VMEM: grid (n/RB,), each
-# step builds a [RB, B] one-hot in registers/VMEM per feature, feeds the MXU
-# with a [S, RB] x [RB, B] contraction, and accumulates the [S, B] block in
+# step builds a transposed [B, RB] one-hot in registers/VMEM per feature
+# (bins on sublanes, rows on lanes — no relayout of the lane-major bin row),
+# feeds the MXU with a lane-axis [S, RB] x [B, RB] contraction, and
+# accumulates the [S, B] block in
 # the output block that stays resident across the row-block axis (classic
 # matmul accumulation pattern). Measured ~1.5 ms for the same shape — ~35x.
 # ---------------------------------------------------------------------------
 
-_PALLAS_VMEM_BUDGET = 10 * 1024 * 1024   # headroom under the 16 MB scoped
-# vmem limit: the compiler's accounting adds dot outputs, copies and padding
-# beyond the blocks modeled below (a 12 MB budget was observed to produce a
-# 16.15 MB scoped allocation at S=96)
+# v5e has 128 MB of VMEM; the compiler's default scoped-vmem limit is only
+# 16 MB, which forces tiny row blocks (RB<=2048) once the unrolled feature
+# loop keeps ~8 one-hot temporaries live — and the resulting 500-1000-step
+# grids were measured 2x slower than roofline (per-step overhead). Both
+# pallas_calls therefore request a raised limit and the block picker budgets
+# against it (with headroom: the compiler's accounting adds dot outputs,
+# copies and padding beyond the blocks modeled below — a 12 MB budget was
+# observed to produce a 16.15 MB scoped allocation at S=96).
+_PALLAS_VMEM_LIMIT = 100 * 1024 * 1024
+_PALLAS_VMEM_BUDGET = 64 * 1024 * 1024
+# v2/v3 cores have only 16 MiB of physical VMEM — the raised limit would fail
+# Mosaic compilation outright there, so those generations keep the old
+# conservative budget and the compiler's default scoped limit.
+_SMALL_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _small_vmem_device() -> bool:
+    try:
+        kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    except Exception:
+        return True
+    return ("v2" in kind) or ("v3" in kind)
+
+
+def _vmem_budget() -> int:
+    if _interpret_mode():
+        return _PALLAS_VMEM_BUDGET  # interpreter: no physical limit
+    return _SMALL_VMEM_BUDGET if _small_vmem_device() else _PALLAS_VMEM_BUDGET
+
+
+def _compiler_kwargs() -> dict:
+    """Extra pallas_call kwargs: the raised scoped-vmem limit, where the
+    runtime supports it (CompilerParams was TPUCompilerParams before
+    jax 0.7; interpret mode and small-VMEM generations pass nothing)."""
+    if _interpret_mode() or _small_vmem_device():
+        return {}
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams",
+                  getattr(pltpu, "TPUCompilerParams", None))
+    if cls is None:
+        return {}
+    return dict(compiler_params=cls(vmem_limit_bytes=_PALLAS_VMEM_LIMIT))
 
 
 def _bin_packing(B: int):
@@ -281,30 +321,38 @@ def _pick_row_block(n: int, F: int, S: int, B: int, fused_w: int = 0,
     VMEM model (matches the kernels): input blocks are double-buffered across
     grid steps (binned [Fp, RB] int32 and stats [Sp, RB] bf16 — or, fused,
     [8, RB] f32 base + [1, RB] i32 positions); the [Fp, Sp, BP] f32
-    accumulator stays resident; kernel scratch is the packed one-hot
-    [RB, max(BP,128)] bf16 plus, fused, the rebuilt [W, 3, RB] + [Sp, RB]
+    accumulator stays resident; kernel scratch is the packed transposed one-hot
+    [max(BP,128), RB] bf16 plus, fused, the rebuilt [W, 3, RB] + [Sp, RB]
     masked stats. int8 (quantized) scratch is charged at 4 B/elem, not 1:
     Mosaic widens narrow-sublane int8 tiles internally, and the measured
     stack footprint tracks the 32-bit accounting (a 1 B model produced a
     16.8 MB scoped allocation against the 16 MB limit at W=31, B=63).
+
+    When the feature loop is statically unrolled (groups <= the unroll cap),
+    Mosaic software-pipelines the unrolled iterations and keeps ~8 one-hot
+    temporaries live on the kernel stack at once — measured on v5e: 38.0 MB
+    scoped at RB=8192 and 19.2 MB at RB=4096 for B=255/W<=16, i.e. ~8x the
+    single-buffer model. Charge 8 one-hot buffers in that case so the chosen
+    RB actually compiles on hardware.
     """
     BP, P = _bin_packing(B)
     Fp = -(-F // P) * P
     Sp = -(-max(S, 1) // 16) * 16
     elt = 4 if quantized else 2
+    onehot_bufs = 8 if (Fp // P) <= _unroll_max() else 1
     for RB in (8192, 4096, 2048, 1024, 512):
         if RB > max(512, n):
             continue  # don't pad a small input up to a huge block
         binned_block = Fp * RB * 4
         if fused_w:
             in_blocks = binned_block + RB * 4 + 8 * RB * 4
-            scratch = (RB * max(BP, 128) * elt
+            scratch = (onehot_bufs * RB * max(BP, 128) * elt
                        + 2 * (fused_w * 3 * RB * elt) + Sp * RB * elt)
         else:
             in_blocks = binned_block + Sp * RB * 2
-            scratch = RB * max(BP, 128) * elt
+            scratch = onehot_bufs * RB * max(BP, 128) * elt
         out_block = Fp * Sp * BP * 4
-        if 2 * in_blocks + out_block + scratch <= _PALLAS_VMEM_BUDGET:
+        if 2 * in_blocks + out_block + scratch <= _vmem_budget():
             return RB
     return 0
 
@@ -357,22 +405,32 @@ def _unroll_max() -> int:
 
 
 def _hist_group_dot(o_ref, b_ref, sb, g, BP: int, P: int, acc):
-    """One feature group: build P features' one-hots, dot, accumulate."""
+    """One feature group: build P features' one-hots, dot, accumulate.
+
+    The one-hot is built TRANSPOSED — bins on sublanes, rows staying on
+    lanes — and contracted on the lane axis of both operands. The naive
+    orientation (``row[:, None] == iota[RB, BP]``) forces a lane->sublane
+    relayout of the [RB] bin row for every feature in every grid step;
+    measured on v5e that relayout dominated the whole kernel (22.3 ms/pass
+    vs 9.1 ms transposed at 1M rows x 28 features x 255 bins — the
+    transposed form runs at the MXU streaming roofline, and pass time was
+    flat in both bin count and stats dtype until it was removed).
+    """
     if P == 1:
-        row = b_ref[g, :]                           # [RB] int32
-        bins = lax.broadcasted_iota(jnp.int32, (row.shape[0], BP), 1)
-        oh = (row[:, None] == bins).astype(sb.dtype)
-        h = lax.dot_general(sb, oh, (((1,), (0,)), ((), ())),
+        row = b_ref[g, :]                           # [RB] int32, rows on lanes
+        bins = lax.broadcasted_iota(jnp.int32, (BP, row.shape[0]), 0)
+        oht = (row[None, :] == bins).astype(sb.dtype)      # [BP, RB]
+        h = lax.dot_general(sb, oht, (((1,), (1,)), ((), ())),
                             preferred_element_type=acc)
         o_ref[g] += h
     else:
         pieces = []
         for p in range(P):
             row = b_ref[g * P + p, :]
-            bins = lax.broadcasted_iota(jnp.int32, (row.shape[0], BP), 1)
-            pieces.append((row[:, None] == bins).astype(sb.dtype))
-        oh = jnp.concatenate(pieces, axis=1)        # [RB, P*BP] = 128 lanes
-        h = lax.dot_general(sb, oh, (((1,), (0,)), ((), ())),
+            bins = lax.broadcasted_iota(jnp.int32, (BP, row.shape[0]), 0)
+            pieces.append((row[None, :] == bins).astype(sb.dtype))
+        oht = jnp.concatenate(pieces, axis=0)       # [P*BP, RB] = 128 sublanes
+        h = lax.dot_general(sb, oht, (((1,), (1,)), ((), ())),
                             preferred_element_type=acc)
         for p in range(P):
             o_ref[g * P + p] += h[:, p * BP:(p + 1) * BP]
@@ -463,6 +521,7 @@ def _hist_pallas(binned_t: jnp.ndarray, stats_t: jnp.ndarray,
         out_specs=pl.BlockSpec((Fp, Sp, BP), lambda j: (0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((Fp, Sp, BP), jnp.float32),
         interpret=_interpret_mode(),
+        **_compiler_kwargs(),
     )(binned_t, stats_t)
     return out[:F, :S, :B]
 
@@ -498,5 +557,6 @@ def _node_hist_pallas(binned_t: jnp.ndarray, row_pos: jnp.ndarray,
         out_specs=pl.BlockSpec((Fp, Sp, BP), lambda j: (0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((Fp, Sp, BP), out_dtype),
         interpret=_interpret_mode(),
+        **_compiler_kwargs(),
     )(binned_t, row_pos, base8)
     return out[:F, :S, :B]
