@@ -873,6 +873,14 @@ def train_booster(
             drop_seed=drop_seed, binner=binner, max_bin=max_bin,
             is_cat_j=is_cat_j)
 
+    # single-shard data axis: grow without a collective axis so depthwise
+    # histogram subtraction (single-device only) can engage; psum over a
+    # size-1 axis is the identity it replaces. Voting keeps the axis even at
+    # size 1 — its top-2k ballot restricts the split search and must behave
+    # identically regardless of shard count.
+    grow_axis = ("data" if (dict(mesh.shape).get("data", 1) > 1
+                            or cfg.voting) else None)
+
     def step_local(binned_t, yl, wl, vmask, scores, vbinned, vy, vw,
                    vscores, key, bag_key, it_f):
         """One boosting iteration on local shard rows (inside shard_map).
@@ -923,7 +931,7 @@ def train_booster(
                 else grow_tree)
         for k in range(K):
             tree, row_node = grow(binned_t, grad[:, k], hess[:, k], row_mask,
-                                  fmask, cfg, axis_name="data",
+                                  fmask, cfg, axis_name=grow_axis,
                                   is_cat=is_cat_j,
                                   qkey=(jax.random.fold_in(key, 13 + k)
                                         if cfg.quantized_grad else None))
@@ -1177,6 +1185,8 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
     T_max = num_iterations
     grow = (grow_tree_depthwise if cfg.growth_policy == "depthwise"
             else grow_tree)
+    grow_axis = ("data" if (dict(mesh.shape).get("data", 1) > 1
+                            or cfg.voting) else None)
     base_j = jnp.asarray(base)
 
     def dart_step_local(binned_t, yl, wl, vmask, contribs, eff_scales,
@@ -1202,7 +1212,7 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
         trees_out, new_contrib = [], []
         for k in range(K):
             tree, row_node = grow(binned_t, grad[:, k], hess[:, k], row_mask,
-                                  fmask, cfg, axis_name="data",
+                                  fmask, cfg, axis_name=grow_axis,
                                   is_cat=is_cat_j,
                                   qkey=(jax.random.fold_in(key, 13 + k)
                                         if cfg.quantized_grad else None))

@@ -64,8 +64,11 @@ class GrowConfig(NamedTuple):
     # the default trades that tail-order nuance for ~4-5x fewer passes.
     # The histogram pass cost here is flat in the node axis (the one-hot
     # matmul scans all rows regardless of node sizes), so LightGBM's
-    # parent-minus-sibling histogram subtraction would NOT reduce pass cost
-    # in this formulation — batching is the equivalent lever.
+    # parent-minus-sibling histogram subtraction alone would NOT reduce pass
+    # cost in this formulation — batching is the equivalent lever. (Depthwise
+    # growth additionally offers ``hist_subtraction``, which DOES cut pass
+    # cost by compacting the smaller children's rows into a half-width
+    # buffer before the pass.)
     # Caveat under voting_parallel: the top-2k feature ballot then spans the
     # whole batch's children (one vote per pass, like depthwise's
     # frontier-wide vote) rather than one split's two children, so voting
@@ -89,6 +92,24 @@ class GrowConfig(NamedTuple):
     # quantize to int8 per tree (stochastic rounding) and histograms ride
     # the 2x-rate int8 MXU path with exact int32 accumulation.
     quantized_grad: bool = False
+    # Depthwise histogram subtraction (LightGBM's parent-minus-sibling trick,
+    # made profitable on TPU by row compaction): from level 1 on, gather the
+    # rows of each sibling pair's SMALLER child — at most n//2 rows in total,
+    # guaranteed — into a half-width buffer, build only those children's
+    # histograms, and derive each larger sibling as parent - smaller. The
+    # histogram pass streams half the rows, which is where all the time goes.
+    # Single-device only: a shard's local membership of the globally-smaller
+    # children is unbounded, so sharded fits (axis_name set) keep full-width
+    # passes regardless of this flag. Default off until the selector/gather
+    # costs are validated on TPU hardware (the compaction is a guaranteed
+    # CPU-fallback win but the TPU gather/sort cost is unmeasured through
+    # the relay as of round 3).
+    hist_subtraction: bool = False
+    # Row-compaction selector for hist_subtraction: "argsort" (one stable
+    # [n] sort) or "searchsorted" (cumsum + binary search, no sort). A
+    # config field — not an env var — so every compiled-program cache keyed
+    # on cfg stays correct for free.
+    compact_selector: str = "argsort"
 
 
 def _soft_threshold(g, l1):
@@ -459,6 +480,33 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     return tree, state["row_node"]
 
 
+def _compact_select(sel: jnp.ndarray, h_buf: int, mode: str = "argsort"):
+    """Indices of the selected rows, compacted to the front of an ``h_buf``
+    buffer (stable order). Returns (src [h_buf] int32, n_sel int32 scalar);
+    entries past n_sel point at unselected rows and must be masked by the
+    caller (via the gathered per-row positions, not by index value).
+
+    ``mode`` (GrowConfig.compact_selector) picks the formulation:
+    - "argsort" (default): stable argsort of the not-selected key — one
+      [n] sort.
+    - "searchsorted": cumsum + vectorized binary search for the k-th
+      selected row — 20 rounds of [h_buf] gathers, no sort.
+    Both are measured through the TPU relay before a default is locked in;
+    they are bit-identical in output for valid (j < n_sel) entries.
+    """
+    n = sel.shape[0]
+    n_sel = jnp.sum(sel.astype(jnp.int32))
+    if mode == "searchsorted":
+        c = jnp.cumsum(sel.astype(jnp.int32))
+        src = jnp.searchsorted(c, jnp.arange(1, h_buf + 1, dtype=jnp.int32),
+                               side="left")
+        src = jnp.minimum(src, n - 1).astype(jnp.int32)
+    else:
+        key = jnp.where(sel, jnp.int8(0), jnp.int8(1))
+        src = jnp.argsort(key, stable=True)[:h_buf].astype(jnp.int32)
+    return src, n_sel
+
+
 def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
                         hess: jnp.ndarray, valid: jnp.ndarray,
                         feat_mask: jnp.ndarray, cfg: GrowConfig,
@@ -519,35 +567,101 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
 
     vsplit = jax.vmap(_best_split, in_axes=(0, 0, 0, 0, None, None, 0, None))
 
+    # Histogram subtraction (cfg.hist_subtraction): single-device only (see
+    # the GrowConfig comment), not under voting, and only worth the
+    # selector/gather overhead at real row counts.
+    use_sub = (cfg.hist_subtraction and axis_name is None
+               and not cfg.voting and n >= 8192)
+    h_buf = max(n // 2, 1)
+
+    def _zero_aux(depth: int):
+        """(h_prev, pair_parent, child_raw) zeros shaped for level ``depth``:
+        the previous level's assembled histograms [W_prev, F, 3, B], each
+        sibling pair's parent position in the previous frontier [W//2], and
+        raw per-child row counts [W] (raw = including invalid rows — that is
+        what bounds the compaction buffer)."""
+        Wp = min(2 ** max(depth - 1, 0), L)
+        W = min(2 ** depth, L)
+        return (jnp.zeros((Wp, F, 3, B), jnp.float32),
+                jnp.full((W // 2,), -1, dtype=jnp.int32),
+                jnp.zeros((W,), dtype=jnp.int32))
+
+    def _sub_level_hist(aux, frontier, row_node, W):
+        """[W, F, 3, B] level histograms via smaller-child compaction.
+
+        Gathers the rows of each pair's smaller child (by raw count; at most
+        n//2 rows in total since the pairs' row sets are disjoint) into the
+        half-width buffer, builds only those W//2 histograms, and derives
+        each larger sibling as parent minus smaller (exact for the count
+        channel; f32-rounding-level differences on grad/hess, as in
+        LightGBM's own subtraction)."""
+        h_prev, pair_parent, child_raw = aux
+        Wh = W // 2
+        pair_active = pair_parent >= 0
+        left_raw = child_raw[0::2][:Wh]
+        right_raw = child_raw[1::2][:Wh]
+        small_off = (right_raw < left_raw).astype(jnp.int32)  # ties -> left
+        small_pos = 2 * jnp.arange(Wh, dtype=jnp.int32) + small_off
+        small_slot = frontier[small_pos]
+        slot_to_small = jnp.full(M, -1, dtype=jnp.int32)
+        slot_to_small = slot_to_small.at[
+            jnp.where(pair_active & (small_slot >= 0), small_slot, M)
+        ].set(jnp.arange(Wh, dtype=jnp.int32), mode="drop")
+        row_small = slot_to_small[row_node]            # [n] in [-1, Wh)
+        src, n_sel = _compact_select(row_small >= 0, h_buf,
+                                     cfg.compact_selector)
+        pos_h = jnp.where(jnp.arange(h_buf) < n_sel, row_small[src], -1)
+        binned_h = jnp.take(binned_t, src, axis=1)     # [F, n//2]
+        base_h = jnp.take(base_t, src, axis=1)
+        h_small = node_histogram(binned_h, pos_h, base_h, Wh, B,
+                                 scales=qscales)       # [F, Wh*3, B]
+        h_small = h_small.reshape(F, Wh, 3, B).transpose(1, 0, 2, 3)
+        h_par = h_prev[jnp.maximum(pair_parent, 0)]    # [Wh, F, 3, B]
+        h_large = h_par - h_small
+        sl = (small_off == 0)[:, None, None, None]
+        left_h = jnp.where(sl, h_small, h_large)
+        right_h = jnp.where(sl, h_large, h_small)
+        hw = jnp.stack([left_h, right_h], axis=1).reshape(2 * Wh, F, 3, B)
+        if 2 * Wh != W:
+            # odd frontier width: the last slot never holds a child (children
+            # arrive in pairs), so its channel is inert zero padding
+            hw = jnp.pad(hw, ((0, W - 2 * Wh), (0, 0), (0, 0), (0, 0)))
+        return hw
+
     def make_level(depth: int, W: int):
         def level_work(state):
-            row_node, frontier, num_nodes, leaves, tree_arrays = state
+            row_node, frontier, num_nodes, leaves, tree_arrays = state[:5]
             fr = frontier[:W]
             active = fr >= 0
 
-            # per-row frontier position (rows at finished leaves get -1);
-            # index M is out of bounds -> dropped for inactive frontier slots
-            slot_to_pos = jnp.full(M, -1, dtype=jnp.int32)
-            slot_to_pos = slot_to_pos.at[jnp.where(active, fr, M)].set(
-                jnp.arange(W, dtype=jnp.int32), mode="drop")
-            row_pos = slot_to_pos[row_node]      # [n] in [-1, W)
+            if use_sub and depth >= 1:
+                h = _sub_level_hist(state[5], frontier, row_node, W)
+                feat_mask_lvl = feat_mask
+            else:
+                # per-row frontier position (rows at finished leaves get -1);
+                # index M is out of bounds -> dropped for inactive slots
+                slot_to_pos = jnp.full(M, -1, dtype=jnp.int32)
+                slot_to_pos = slot_to_pos.at[jnp.where(active, fr, M)].set(
+                    jnp.arange(W, dtype=jnp.int32), mode="drop")
+                row_pos = slot_to_pos[row_node]      # [n] in [-1, W)
 
-            # one fused histogram pass covers the whole level: the
-            # row->position one-hot and masked stats are built in VMEM
-            h = node_histogram(binned_t, row_pos, base_t, W, B,
-                               scales=qscales)                 # [F, W*3, B]
-            feat_mask_lvl = feat_mask
-            if axis_name is not None:
-                if cfg.voting:
-                    # per-level voting: shards vote top_k features by their
-                    # best local gain across the WHOLE frontier, then only
-                    # the global top-2k features' level histograms cross
-                    # the interconnect
-                    h, sel = _voting_select(h, feat_mask, cfg, axis_name, W)
-                    feat_mask_lvl = feat_mask & sel
-                else:
-                    h = lax.psum(h, axis_name)
-            h = h.reshape(F, W, 3, B).transpose(1, 0, 2, 3)      # [W, F, 3, B]
+                # one fused histogram pass covers the whole level: the
+                # row->position one-hot and masked stats are built in VMEM
+                h = node_histogram(binned_t, row_pos, base_t, W, B,
+                                   scales=qscales)             # [F, W*3, B]
+                feat_mask_lvl = feat_mask
+                if axis_name is not None:
+                    if cfg.voting:
+                        # per-level voting: shards vote top_k features by
+                        # their best local gain across the WHOLE frontier,
+                        # then only the global top-2k features' level
+                        # histograms cross the interconnect
+                        h, sel = _voting_select(h, feat_mask, cfg, axis_name,
+                                                W)
+                        feat_mask_lvl = feat_mask & sel
+                    else:
+                        h = lax.psum(h, axis_name)
+                h = h.reshape(F, W, 3, B).transpose(1, 0, 2, 3)  # [W,F,3,B]
 
             tot = jnp.stack([tree_arrays["ng"][jnp.maximum(fr, 0)],
                              tree_arrays["nh"][jnp.maximum(fr, 0)],
@@ -577,7 +691,7 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
             # update rows: rows in split nodes move to their child slot
             # (keyed on node slot ids — inactive frontier slots are -1 and
             # match no row since row_node >= 0)
-            row_node, _, _ = _route_rows_to_children(
+            row_node, move, goleft_k = _route_rows_to_children(
                 binned_t, row_node, jnp.where(active, fr, -1), do, feats,
                 bins_, bits_w, lid, is_cat)
 
@@ -615,19 +729,45 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
             frontier = jnp.full(L, -1, dtype=jnp.int32).at[:W_next].set(
                 compacted[:W_next])
 
-            return (row_node, frontier, num_nodes + 2 * n_split,
-                    leaves + n_split, ta)
+            out = (row_node, frontier, num_nodes + 2 * n_split,
+                   leaves + n_split, ta)
+            if use_sub:
+                # aux for the next level, packed with the SAME stable perm as
+                # the child slots so pairs stay adjacent: raw per-child row
+                # counts (from the routing masks — includes invalid rows,
+                # which is what bounds the compaction buffer) and each pair's
+                # parent position in THIS frontier. h_prev = this level's
+                # assembled histograms.
+                rawL = jnp.sum(move & goleft_k, axis=1).astype(jnp.int32)
+                rawA = jnp.sum(move, axis=1).astype(jnp.int32)
+                raw2 = jnp.stack([rawL, rawA - rawL], axis=1).reshape(-1)
+                pp2 = jnp.repeat(
+                    jnp.where(do, jnp.arange(W, dtype=jnp.int32), -1), 2)
+                raw_next = raw2[perm][:W_next]
+                pp_next = pp2[perm][:2 * (W_next // 2)][0::2]
+                out = out + ((h, pp_next, raw_next),)
+            return out
 
         return level_work
 
     state = (row_node, frontier, num_nodes, leaves, tree_arrays)
+    if use_sub:
+        state = state + (_zero_aux(0),)
     for depth in range(depth_cap):           # static unroll: W varies by level
         W = min(2 ** depth, L)
         # runtime skip: once the budget is spent or the frontier is empty,
         # the remaining (slack) levels cost nothing
         pred = (state[3] < jnp.int32(L)) & jnp.any(state[1] >= 0)
-        state = lax.cond(pred, make_level(depth, W), lambda s: s, state)
-    row_node, frontier, num_nodes, leaves, tree_arrays = state
+        if use_sub:
+            # the skip branch must still produce next-level aux shapes (its
+            # content is never read once the tree is finished)
+            def _skip(s, _d=depth):
+                return s[:5] + (_zero_aux(_d + 1),)
+        else:
+            def _skip(s):
+                return s
+        state = lax.cond(pred, make_level(depth, W), _skip, state)
+    row_node, frontier, num_nodes, leaves, tree_arrays = state[:5]
 
     lr = jnp.float32(cfg.learning_rate)
     raw_val = -_soft_threshold(tree_arrays["ng"], cfg.lambda_l1) / (

@@ -298,6 +298,45 @@ class TestBoosterInternals:
             num_leaves=63, min_data_in_leaf=40, leaf_batch=8), **common)
         assert np.allclose(b1.predict(X), b8.predict(X), atol=1e-5)
 
+    def test_hist_subtraction_matches_direct(self):
+        # Depthwise histogram subtraction (smaller-child compaction +
+        # parent-minus-sibling derivation) must reproduce the direct
+        # full-width passes. Needs a single-device mesh (the booster keeps
+        # full-width passes on a sharded data axis) and n >= 8192 (the
+        # engagement threshold). The count channel is exact under
+        # subtraction; grad/hess differ only at f32 rounding, so split
+        # decisions — and therefore predictions — must match.
+        import os
+
+        import jax
+        from mmlspark_tpu.parallel import mesh as meshlib
+
+        n, F = 9000, 10
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(n, F)).astype(np.float32)
+        y = (X[:, 0] * X[:, 1] - X[:, 2] + 0.2 * rng.normal(size=n) > 0
+             ).astype(np.float32)
+        with meshlib.default_mesh(
+                meshlib.make_mesh({"data": 1}, devices=jax.devices()[:1])):
+            preds = {}
+            for sub in (False, True):
+                cfg = GrowConfig(num_leaves=15, growth_policy="depthwise",
+                                 hist_subtraction=sub)
+                b = train_booster(X, y, objective="binary",
+                                  num_iterations=5, cfg=cfg, max_bin=63,
+                                  seed=0)
+                preds[sub] = np.asarray(b.predict(X))
+            np.testing.assert_allclose(preds[True], preds[False], atol=1e-4)
+            # the sort-free selector must agree with the argsort selector
+            cfg = GrowConfig(num_leaves=15, growth_policy="depthwise",
+                             hist_subtraction=True,
+                             compact_selector="searchsorted")
+            b = train_booster(X, y, objective="binary",
+                              num_iterations=5, cfg=cfg, max_bin=63,
+                              seed=0)
+            np.testing.assert_allclose(np.asarray(b.predict(X)),
+                                       preds[True], atol=1e-6)
+
     def test_leaf_batch_budget_quality(self):
         # With a binding leaf budget the batched order may differ from
         # sequential near exhaustion — quality must stay equivalent.
