@@ -257,7 +257,10 @@ def _gbdt_roofline(n_rows: int, n_feat: int, max_bin: int,
         tile_cols = bp // 128
     cols_per_pass = n_rows * (n_feat / pack) * tile_cols
     mxu_cols_per_sec = peak * 1e12 / (2 * 128 * 128)
-    passes_per_tree = 1 + math.ceil(math.log2(31))
+    # depthwise levels actually executed before the 31-leaf budget is spent:
+    # W = 1,2,4,8,16 -> ceil(log2(L)) passes (the W=16 level splits the last
+    # 15 nodes; the slack levels are skipped at runtime)
+    passes_per_tree = math.ceil(math.log2(31))
     roofline_tps = mxu_cols_per_sec / (cols_per_pass * passes_per_tree)
     return {"gbdt_roofline_tps_est": round(roofline_tps, 2),
             "gbdt_roofline_frac": round(trees_per_sec / roofline_tps, 3),

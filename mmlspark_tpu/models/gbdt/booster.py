@@ -633,6 +633,16 @@ class Booster:
 # ---------------------------------------------------------------------------
 
 
+def _grow_axis_for(mesh, cfg) -> "str | None":
+    """Collective axis for tree growth: None on a single-shard data axis so
+    depthwise histogram subtraction (single-device only) can engage — psum
+    over a size-1 axis is the identity it replaces. Voting keeps the axis
+    even at size 1: its top-2k ballot restricts the split search and must
+    behave identically regardless of shard count."""
+    return ("data" if (dict(mesh.shape).get("data", 1) > 1 or cfg.voting)
+            else None)
+
+
 def train_booster(
     X: Optional[np.ndarray] = None,
     y: Optional[np.ndarray] = None,
@@ -873,13 +883,7 @@ def train_booster(
             drop_seed=drop_seed, binner=binner, max_bin=max_bin,
             is_cat_j=is_cat_j)
 
-    # single-shard data axis: grow without a collective axis so depthwise
-    # histogram subtraction (single-device only) can engage; psum over a
-    # size-1 axis is the identity it replaces. Voting keeps the axis even at
-    # size 1 — its top-2k ballot restricts the split search and must behave
-    # identically regardless of shard count.
-    grow_axis = ("data" if (dict(mesh.shape).get("data", 1) > 1
-                            or cfg.voting) else None)
+    grow_axis = _grow_axis_for(mesh, cfg)
 
     def step_local(binned_t, yl, wl, vmask, scores, vbinned, vy, vw,
                    vscores, key, bag_key, it_f):
@@ -1185,8 +1189,7 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
     T_max = num_iterations
     grow = (grow_tree_depthwise if cfg.growth_policy == "depthwise"
             else grow_tree)
-    grow_axis = ("data" if (dict(mesh.shape).get("data", 1) > 1
-                            or cfg.voting) else None)
+    grow_axis = _grow_axis_for(mesh, cfg)
     base_j = jnp.asarray(base)
 
     def dart_step_local(binned_t, yl, wl, vmask, contribs, eff_scales,

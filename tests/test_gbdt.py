@@ -306,8 +306,6 @@ class TestBoosterInternals:
         # engagement threshold). The count channel is exact under
         # subtraction; grad/hess differ only at f32 rounding, so split
         # decisions — and therefore predictions — must match.
-        import os
-
         import jax
         from mmlspark_tpu.parallel import mesh as meshlib
 

@@ -122,25 +122,97 @@ def main(quick=False):
     float(jnp.sum(ds.Xbt_d))
     print(json.dumps({"ingest_sec": round(time.perf_counter() - t0 - floor,
                                           2)}))
-    for policy in (["depthwise"] if quick else ["depthwise", "leafwise"]):
-        cfg = GrowConfig(num_leaves=31, growth_policy=policy)
-        train_booster(dataset=ds, objective="binary", num_iterations=10,
-                      cfg=cfg)     # warm/compile
-        # train_booster ends in the packed tree download (a real device
-        # sync); best-of-2 because identical runs jitter by seconds
-        # through the relay (docs/performance.md)
-        dt = float("inf")
-        for _ in range(2):
+    # train variants: depthwise direct, depthwise + histogram subtraction
+    # (both selectors — this measurement decides the hist_subtraction
+    # default and selector), and leafwise (the parity default)
+    variants = [("depthwise", dict()),
+                ("depthwise+sub/argsort",
+                 dict(hist_subtraction=True, compact_selector="argsort")),
+                ("depthwise+sub/searchsorted",
+                 dict(hist_subtraction=True,
+                      compact_selector="searchsorted"))]
+    if not quick:
+        variants.append(("leafwise", dict(growth_policy="leafwise")))
+    for name, over in variants:
+        cfg = GrowConfig(num_leaves=31, growth_policy="depthwise")._replace(
+            **over)
+        try:
+            train_booster(dataset=ds, objective="binary", num_iterations=10,
+                          cfg=cfg)     # warm/compile
+            # train_booster ends in the packed tree download (a real device
+            # sync); best-of-2 because identical runs jitter by seconds
+            # through the relay (docs/performance.md)
+            dt = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                b = train_booster(dataset=ds, objective="binary",
+                                  num_iterations=10, cfg=cfg)
+                dt = min(dt, time.perf_counter() - t0)
+            acc = float(((b.predict(X[:50_000]) > 0.5) == y[:50_000]).mean())
+            print(json.dumps({"train10_sec": round(dt, 2),
+                              "trees_per_sec": round(10 / dt, 2),
+                              "policy": name,
+                              "train_accuracy_50k": round(acc, 3)}))
+        except Exception as e:  # noqa: BLE001 — one variant must not kill the sweep
+            print(json.dumps({"policy": name, "err": repr(e)[:160]}))
+
+
+def selector_primitives():
+    """Amortized selector/gather primitive costs at the bench shape (K reps
+    inside ONE dispatch — single-op timings are unmeasurable through the
+    relay; the loop body must depend on the carry so XLA cannot hoist it)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+    n, F, K = 1_000_000, 28, 20
+    H = n // 2
+    rng = np.random.default_rng(0)
+    binned = jnp.asarray(rng.integers(0, 255, size=(F, n), dtype=np.int32))
+    sel = jnp.asarray(rng.integers(0, 2, size=n, dtype=np.int32))
+    idx = jnp.asarray(rng.permutation(n)[:H].astype(np.int32))
+
+    def dep(acc, x):
+        return x + jnp.where(acc > 1e30, 1, 0).astype(x.dtype)
+
+    floor = measure_floor(jnp)
+
+    def timed(name, fn, *args):
+        f = jax.jit(fn)
+        float(f(*args))
+        best = float("inf")
+        for _ in range(3):
             t0 = time.perf_counter()
-            b = train_booster(dataset=ds, objective="binary",
-                              num_iterations=10, cfg=cfg)
-            dt = min(dt, time.perf_counter() - t0)
-        acc = float(((b.predict(X[:50_000]) > 0.5) == y[:50_000]).mean())
-        print(json.dumps({"train10_sec": round(dt, 2),
-                          "trees_per_sec": round(10 / dt, 2),
-                          "policy": policy,
-                          "train_accuracy_50k": round(acc, 3)}))
+            float(f(*args))
+            best = min(best, time.perf_counter() - t0)
+        # subtract the dispatch floor BEFORE dividing by K — at K=20 the
+        # ~90 ms floor would otherwise inflate every op by ~4.5 ms
+        per_op = max(best - floor, 1e-9) / K
+        print(json.dumps({"op": name, "ms_per_op": round(per_op * 1e3, 2)}),
+              flush=True)
+
+    def loop(body):
+        return lambda *a: lax.fori_loop(
+            0, K, lambda i, acc: body(acc, *a), 0.0)
+
+    # consume the FULL outputs: slicing before the sum would let XLA shrink
+    # the measured work (gather 4 columns instead of 500k, sort -> top-k)
+    timed("argsort_1M", loop(lambda acc, s: acc + jnp.sum(
+        jnp.argsort(dep(acc, s).astype(jnp.int8), stable=True)[:H]
+    ).astype(jnp.float32) * 1e-30), sel)
+    timed("cumsum_searchsorted_1M", loop(lambda acc, s: acc + jnp.sum(
+        jnp.searchsorted(jnp.cumsum(dep(acc, s)),
+                         jnp.arange(1, H + 1, dtype=jnp.int32))
+    ).astype(jnp.float32) * 1e-30), sel)
+    timed("gather_28x500k_cols", loop(lambda acc, b, ix: acc + jnp.sum(
+        jnp.take(b, dep(acc, ix), axis=1).astype(jnp.float32)
+    ) * 1e-30), binned, idx)
 
 
 if __name__ == "__main__":
-    main(quick="quick" in sys.argv[1:])
+    if "selectors" in sys.argv[1:]:
+        selector_primitives()
+    else:
+        main(quick="quick" in sys.argv[1:])
