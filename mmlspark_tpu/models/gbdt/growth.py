@@ -63,12 +63,10 @@ class GrowConfig(NamedTuple):
     # leaf's. leaf_batch=1 is exact sequential best-first (LightGBM order);
     # the default trades that tail-order nuance for ~4-5x fewer passes.
     # The histogram pass cost here is flat in the node axis (the one-hot
-    # matmul scans all rows regardless of node sizes), so LightGBM's
-    # parent-minus-sibling histogram subtraction alone would NOT reduce pass
-    # cost in this formulation — batching is the equivalent lever. (Depthwise
-    # growth additionally offers ``hist_subtraction``, which DOES cut pass
-    # cost by compacting the smaller children's rows into a half-width
-    # buffer before the pass.)
+    # matmul scans all rows regardless of node sizes), so subtraction alone
+    # would not reduce pass cost — batching cuts the PASS COUNT, and
+    # ``hist_subtraction`` additionally cuts per-pass cost by compacting the
+    # smaller children's rows into a half-width buffer.
     # Caveat under voting_parallel: the top-2k feature ballot then spans the
     # whole batch's children (one vote per pass, like depthwise's
     # frontier-wide vote) rather than one split's two children, so voting
@@ -92,12 +90,14 @@ class GrowConfig(NamedTuple):
     # quantize to int8 per tree (stochastic rounding) and histograms ride
     # the 2x-rate int8 MXU path with exact int32 accumulation.
     quantized_grad: bool = False
-    # Depthwise histogram subtraction (LightGBM's parent-minus-sibling trick,
-    # made profitable on TPU by row compaction): from level 1 on, gather the
-    # rows of each sibling pair's SMALLER child — at most n//2 rows in total,
-    # guaranteed — into a half-width buffer, build only those children's
-    # histograms, and derive each larger sibling as parent - smaller. The
-    # histogram pass streams half the rows, which is where all the time goes.
+    # Histogram subtraction (LightGBM's parent-minus-sibling trick, made
+    # profitable on TPU by row compaction), honored by BOTH growth policies:
+    # gather the rows of each sibling pair's SMALLER child — at most n//2
+    # rows in total, guaranteed — into a half-width buffer, build only those
+    # children's histograms, and derive each larger sibling as parent minus
+    # smaller. Depthwise engages from level 1 (the previous level's
+    # histograms are the parents); leafwise caches every node's histogram
+    # so every round subtracts (see the nhist comment in grow_tree).
     # Single-device only: a shard's local membership of the globally-smaller
     # children is unbounded, so sharded fits (axis_name set) keep full-width
     # passes regardless of this flag. Default off until the selector/gather
@@ -294,6 +294,33 @@ class Tree(NamedTuple):
     #                          splits; all-zero rows are numeric splits)
 
 
+def _subtracted_pair_hists(binned_t, base_t, qscales, row_small,
+                           small_is_left, parent_hists, K, B, h_buf, cfg):
+    """Shared compaction+subtraction core for both growth policies.
+
+    row_small: [n] in [-1, K) -- each row's pair index if it lies in that
+    pair's SMALLER child, else -1. small_is_left: [K] bool. parent_hists:
+    [K, F, 3, B]. Gathers the selected rows (caller guarantees their count
+    is <= h_buf = n//2: pair row sets are disjoint and min(l, r) <= total/2),
+    builds the K smaller-child histograms in one pass over the half-width
+    buffer, derives each larger sibling as parent minus smaller (exact for
+    the count channel; f32-rounding-level differences on grad/hess, as in
+    LightGBM's own subtraction), and returns [2K, F, 3, B] interleaved as
+    [l0, r0, l1, r1, ...]."""
+    F = binned_t.shape[0]
+    src, n_sel = _compact_select(row_small >= 0, h_buf, cfg.compact_selector)
+    pos_h = jnp.where(jnp.arange(h_buf) < n_sel, row_small[src], -1)
+    h_small = node_histogram(jnp.take(binned_t, src, axis=1), pos_h,
+                             jnp.take(base_t, src, axis=1), K, B,
+                             scales=qscales)           # [F, K*3, B]
+    h_small = h_small.reshape(F, K, 3, B).transpose(1, 0, 2, 3)
+    h_large = parent_hists - h_small
+    sl = small_is_left[:, None, None, None]
+    left_h = jnp.where(sl, h_small, h_large)
+    right_h = jnp.where(sl, h_large, h_small)
+    return jnp.stack([left_h, right_h], axis=1).reshape(2 * K, F, 3, B)
+
+
 def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               valid: jnp.ndarray, feat_mask: jnp.ndarray, cfg: GrowConfig,
               axis_name: Optional[str] = None,
@@ -332,6 +359,17 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             return lax.psum(h, axis_name), jnp.ones(F, dtype=bool)
         return _voting_select(h, feat_mask, cfg, axis_name, W)
 
+    # Leafwise histogram subtraction: every round's candidates already have
+    # their own histograms cached in ``nhist`` (root from the root pass,
+    # every later node from the round that created it), so each round can
+    # stream ONLY the smaller child of each split (disjoint candidate row
+    # sets bound the total at n//2) and derive the larger sibling by
+    # subtraction. Same engagement rule as depthwise (single-device, no
+    # voting, real row counts).
+    use_sub = (cfg.hist_subtraction and axis_name is None
+               and not cfg.voting and n >= 8192)
+    h_buf = max(n // 2, 1)
+
     root_hist, sel0 = all_hist(jnp.zeros(n, dtype=jnp.int32), 1)
     # totals from the raw stats (not the histogram: under voting_parallel an
     # unselected feature's rows are zeroed there). Quantized mode totals the
@@ -367,6 +405,13 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         gain=zf,
         num_nodes=jnp.int32(1),
     )
+    if use_sub:
+        # per-node histogram cache [M, F, 3, B] f32 = M*F*3*B*4 bytes —
+        # ~5 MB at 31 leaves x 28 features x 255 bins, LINEAR IN F (a
+        # 1000-feature fit holds ~190 MB of HBM for the whole tree):
+        # the subtraction parent for every future candidate
+        state["nhist"] = jnp.zeros((M, F, 3, B), jnp.float32).at[0].set(
+            root_hist.reshape(F, 3, B))
 
     # Batched best-first: each round splits the top ``leaf_batch`` pending
     # leaves by cached gain in ONE fused histogram pass (their 2*KB children
@@ -396,16 +441,32 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         new_row_node, move, goleft_k = _route_rows_to_children(
             binned_t, st["row_node"], slots, do, feats, bins_, bits_k, lid,
             is_cat)
-        # child position in [0, 2*KB): 2i = left child of candidate i
-        cpos = jnp.where(goleft_k, 2 * arange_kb[:, None],
-                         2 * arange_kb[:, None] + 1)
-        in_any = jnp.any(move, axis=0)
-        child_pos = jnp.where(
-            in_any, jnp.sum(jnp.where(move, cpos, 0), axis=0), -1
-        ).astype(jnp.int32)
+        if use_sub:
+            # stream only each candidate's SMALLER child (by raw routed row
+            # count, which is what bounds the n//2 buffer); the larger
+            # sibling derives from the cached candidate histogram
+            rawL = jnp.sum(move & goleft_k, axis=1).astype(jnp.int32)
+            rawA = jnp.sum(move, axis=1).astype(jnp.int32)
+            small_is_left = rawL <= rawA - rawL               # ties -> left
+            in_small = jnp.any(
+                move & (goleft_k == small_is_left[:, None]), axis=0)
+            spos = jnp.sum(jnp.where(move, arange_kb[:, None], 0), axis=0)
+            row_small = jnp.where(in_small, spos, -1).astype(jnp.int32)
+            hw = _subtracted_pair_hists(
+                binned_t, base_t, qscales, row_small, small_is_left,
+                st["nhist"][jnp.where(do, slots, 0)], KB, B, h_buf, cfg)
+            sel = jnp.ones(F, dtype=bool)
+        else:
+            # child position in [0, 2*KB): 2i = left child of candidate i
+            cpos = jnp.where(goleft_k, 2 * arange_kb[:, None],
+                             2 * arange_kb[:, None] + 1)
+            in_any = jnp.any(move, axis=0)
+            child_pos = jnp.where(
+                in_any, jnp.sum(jnp.where(move, cpos, 0), axis=0), -1
+            ).astype(jnp.int32)
 
-        h, sel = all_hist(child_pos, W2)             # [F, W2*3, B]
-        hw = h.reshape(F, W2, 3, B).transpose(1, 0, 2, 3)   # [W2, F, 3, B]
+            h, sel = all_hist(child_pos, W2)         # [F, W2*3, B]
+            hw = h.reshape(F, W2, 3, B).transpose(1, 0, 2, 3)  # [W2,F,3,B]
 
         # child totals: left from the candidate cache, right = parent - left
         lg = st["clg"][slots]
@@ -449,6 +510,11 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         new["clc"] = st["clc"].at[cslot].set(lc2, mode="drop")
         new["cbits"] = st["cbits"].at[cslot].set(bits2, mode="drop")
         new["num_nodes"] = st["num_nodes"] + 2 * n_split
+        if use_sub:
+            # cache the children's histograms: they are the subtraction
+            # parents of every round that later splits them (cslot order is
+            # [l0, r0, l1, r1, ...], matching hw's channel order)
+            new["nhist"] = st["nhist"].at[cslot].set(hw, mode="drop")
         return new
 
     def round_body(_, st):
@@ -608,20 +674,9 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
             jnp.where(pair_active & (small_slot >= 0), small_slot, M)
         ].set(jnp.arange(Wh, dtype=jnp.int32), mode="drop")
         row_small = slot_to_small[row_node]            # [n] in [-1, Wh)
-        src, n_sel = _compact_select(row_small >= 0, h_buf,
-                                     cfg.compact_selector)
-        pos_h = jnp.where(jnp.arange(h_buf) < n_sel, row_small[src], -1)
-        binned_h = jnp.take(binned_t, src, axis=1)     # [F, n//2]
-        base_h = jnp.take(base_t, src, axis=1)
-        h_small = node_histogram(binned_h, pos_h, base_h, Wh, B,
-                                 scales=qscales)       # [F, Wh*3, B]
-        h_small = h_small.reshape(F, Wh, 3, B).transpose(1, 0, 2, 3)
-        h_par = h_prev[jnp.maximum(pair_parent, 0)]    # [Wh, F, 3, B]
-        h_large = h_par - h_small
-        sl = (small_off == 0)[:, None, None, None]
-        left_h = jnp.where(sl, h_small, h_large)
-        right_h = jnp.where(sl, h_large, h_small)
-        hw = jnp.stack([left_h, right_h], axis=1).reshape(2 * Wh, F, 3, B)
+        hw = _subtracted_pair_hists(
+            binned_t, base_t, qscales, row_small, small_off == 0,
+            h_prev[jnp.maximum(pair_parent, 0)], Wh, B, h_buf, cfg)
         if 2 * Wh != W:
             # odd frontier width: the last slot never holds a child (children
             # arrive in pairs), so its channel is inert zero padding

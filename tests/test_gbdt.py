@@ -334,6 +334,17 @@ class TestBoosterInternals:
                               seed=0)
             np.testing.assert_allclose(np.asarray(b.predict(X)),
                                        preds[True], atol=1e-6)
+            # leafwise: every round's candidates have cached parent
+            # histograms, so subtraction engages on all rounds
+            for s in (False, True):
+                cfg = GrowConfig(num_leaves=15, growth_policy="leafwise",
+                                 hist_subtraction=s)
+                b = train_booster(X, y, objective="binary",
+                                  num_iterations=5, cfg=cfg, max_bin=63,
+                                  seed=0)
+                preds[("leaf", s)] = np.asarray(b.predict(X))
+            np.testing.assert_allclose(preds[("leaf", True)],
+                                       preds[("leaf", False)], atol=1e-4)
 
     def test_leaf_batch_budget_quality(self):
         # With a binding leaf budget the batched order may differ from
