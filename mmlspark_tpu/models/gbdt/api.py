@@ -204,12 +204,27 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
         "useQuantizedGrad", "Quantized-gradient histograms (LightGBM "
         "use_quantized_grad): int8 grad/hess with stochastic rounding ride "
         "the 2x-rate int8 MXU path", False, TypeConverters.to_bool)
+    histSubtraction = Param(
+        "histSubtraction", "Parent-minus-sibling histogram subtraction "
+        "(LightGBM's constant-time trick, here as smaller-child row "
+        "compaction — bounds per-pass histogram rows at n/2). Single-device "
+        "fits only; sharded fits keep full-width passes regardless",
+        False, TypeConverters.to_bool)
+    compactSelector = Param(
+        "compactSelector", "Row-compaction selector for histSubtraction: "
+        "argsort (one stable sort) or searchsorted (cumsum + binary "
+        "search)", "argsort", TypeConverters.to_string)
     categoricalSlotNames = Param(
         "categoricalSlotNames", "Categorical slots by feature name; requires "
         "a featuresCol with slot names (use categoricalSlotIndexes for "
         "plain arrays)", None)
 
     def _grow_config(self) -> GrowConfig:
+        sel = self.get_or_default("compactSelector")
+        if sel not in ("argsort", "searchsorted"):
+            raise ValueError(
+                f"compactSelector must be 'argsort' or 'searchsorted', got "
+                f"{sel!r}")
         return GrowConfig(
             num_leaves=self.get_or_default("numLeaves"),
             max_depth=self.get_or_default("maxDepth"),
@@ -225,6 +240,8 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
             growth_policy=self.get_or_default("growthPolicy"),
             leaf_batch=self.get_or_default("leafBatch"),
             quantized_grad=self.get_or_default("useQuantizedGrad"),
+            hist_subtraction=self.get_or_default("histSubtraction"),
+            compact_selector=self.get_or_default("compactSelector"),
         )
 
     def _extract_arrays(self, dataset: Dataset):

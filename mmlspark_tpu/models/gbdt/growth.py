@@ -294,6 +294,15 @@ class Tree(NamedTuple):
     #                          splits; all-zero rows are numeric splits)
 
 
+def _use_subtraction(cfg, axis_name, n: int) -> bool:
+    """Single engagement rule for histogram subtraction, shared by both
+    growth policies: single-device only (see the GrowConfig comment), not
+    under voting, and only worth the selector/gather overhead at real row
+    counts (threshold provisional until TPU gather costs are measured)."""
+    return (cfg.hist_subtraction and axis_name is None
+            and not cfg.voting and n >= 8192)
+
+
 def _subtracted_pair_hists(binned_t, base_t, qscales, row_small,
                            small_is_left, parent_hists, K, B, h_buf, cfg):
     """Shared compaction+subtraction core for both growth policies.
@@ -364,10 +373,8 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     # every later node from the round that created it), so each round can
     # stream ONLY the smaller child of each split (disjoint candidate row
     # sets bound the total at n//2) and derive the larger sibling by
-    # subtraction. Same engagement rule as depthwise (single-device, no
-    # voting, real row counts).
-    use_sub = (cfg.hist_subtraction and axis_name is None
-               and not cfg.voting and n >= 8192)
+    # subtraction. Same engagement rule as depthwise.
+    use_sub = _use_subtraction(cfg, axis_name, n)
     h_buf = max(n // 2, 1)
 
     root_hist, sel0 = all_hist(jnp.zeros(n, dtype=jnp.int32), 1)
@@ -560,6 +567,10 @@ def _compact_select(sel: jnp.ndarray, h_buf: int, mode: str = "argsort"):
     Both are measured through the TPU relay before a default is locked in;
     they are bit-identical in output for valid (j < n_sel) entries.
     """
+    if mode not in ("argsort", "searchsorted"):
+        raise ValueError(
+            f"compact_selector must be 'argsort' or 'searchsorted', got "
+            f"{mode!r}")
     n = sel.shape[0]
     n_sel = jnp.sum(sel.astype(jnp.int32))
     if mode == "searchsorted":
@@ -633,11 +644,7 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
 
     vsplit = jax.vmap(_best_split, in_axes=(0, 0, 0, 0, None, None, 0, None))
 
-    # Histogram subtraction (cfg.hist_subtraction): single-device only (see
-    # the GrowConfig comment), not under voting, and only worth the
-    # selector/gather overhead at real row counts.
-    use_sub = (cfg.hist_subtraction and axis_name is None
-               and not cfg.voting and n >= 8192)
+    use_sub = _use_subtraction(cfg, axis_name, n)
     h_buf = max(n // 2, 1)
 
     def _zero_aux(depth: int):
