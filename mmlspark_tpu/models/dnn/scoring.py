@@ -118,16 +118,18 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
             [np.asarray(v, np.float32) for v in col])
         n = x.shape[0]
         outs = []
-        for start in range(0, n, bs):
-            batch = x[start:start + bs]
-            real = batch.shape[0]
-            if real < bs:
-                # static shapes: pad the tail batch, drop the padding after
-                pad = np.repeat(batch[-1:], bs - real, axis=0)
-                batch = np.concatenate([batch, pad], axis=0)
-            batch, _ = _pad_to_mesh(batch)
-            out = np.asarray(fwd(self.params, batch))
-            outs.append(out[:real])
+        from ...utils.profiling import annotate
+        with annotate(f"dnn_score:{type(self).__name__}"):
+            for start in range(0, n, bs):
+                batch = x[start:start + bs]
+                real = batch.shape[0]
+                if real < bs:
+                    # static shapes: pad the tail batch, drop padding after
+                    pad = np.repeat(batch[-1:], bs - real, axis=0)
+                    batch = np.concatenate([batch, pad], axis=0)
+                batch, _ = _pad_to_mesh(batch)
+                out = np.asarray(fwd(self.params, batch))
+                outs.append(out[:real])
         result = np.concatenate(outs, axis=0) if outs else np.zeros((0,))
         return dataset.with_column(out_col, result)
 

@@ -1056,7 +1056,9 @@ def train_booster(
 
         multi = _cached_program(fuse_key, build_multi)
         tw.mark("build_multi")
-        trees_dev = multi(Xbt_d, y_d, w_d, vmask_d, scores_d)
+        from ...utils.profiling import annotate
+        with annotate(f"gbdt_train_fused:{num_iterations}it"):
+            trees_dev = multi(Xbt_d, y_d, w_d, vmask_d, scores_d)
         if tw.on:
             jax.block_until_ready(trees_dev)
             tw.mark("multi_exec")

@@ -374,8 +374,10 @@ def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
         g2_0 = jnp.zeros(D, jnp.float32)
         t_0 = jnp.float32(cfg.initial_t)
         lt_0 = jnp.full(D_lt, float(cfg.initial_t), jnp.float32)
-    w_out, w_raw, g2, t, lt = fn(idx_d, val_d, y_d, sw_d, jnp.asarray(w0),
-                                 g2_0, t_0, lt_0)
+    from ...utils.profiling import annotate
+    with annotate(f"vw_sgd_train:{cfg.num_passes}pass"):
+        w_out, w_raw, g2, t, lt = fn(idx_d, val_d, y_d, sw_d,
+                                     jnp.asarray(w0), g2_0, t_0, lt_0)
     if return_state:
         return np.asarray(w_out), (np.asarray(w_raw), np.asarray(g2),
                                    float(t), np.asarray(lt))

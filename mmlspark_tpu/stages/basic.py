@@ -13,6 +13,7 @@ interleaving (each equal-size shard sees every label).
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import pickle
 import time
@@ -197,13 +198,22 @@ class MultiColumnAdapter(Transformer):
 
 
 class Timer(Estimator):
-    """Wrap a stage; log fit/transform wall time (reference: stages/Timer.scala:57-92)."""
+    """Wrap a stage; log fit/transform wall time (reference: stages/Timer.scala:57-92).
+
+    On top of the reference's host wall-clock logging, ``traceDir`` captures
+    an XLA profiler trace of the wrapped fit/transform (device-level MXU/HBM
+    timeline — see utils/profiling.py), the TPU-side replacement for the
+    host StopWatch scopes per SURVEY §5."""
 
     stage = Param("stage", "Wrapped stage", None, is_complex=True)
     logToScala = Param("logToScala", "Log through the framework logger", True,
                        TypeConverters.to_bool)
     disableMaterialization = Param("disableMaterialization", "compat no-op", True,
                                    TypeConverters.to_bool)
+    traceDir = Param("traceDir", "If set, capture an XLA profiler trace of "
+                     "the wrapped fit/transform into this directory "
+                     "(TensorBoard profile format)", None,
+                     TypeConverters.to_string)
 
     def __init__(self, stage: Optional[PipelineStage] = None, **kwargs):
         super().__init__(**kwargs)
@@ -211,9 +221,14 @@ class Timer(Estimator):
             self.set(stage=stage)
 
     def fit(self, dataset: Dataset) -> "TimerModel":
+        from ..utils.profiling import annotate, trace
         inner = self.get_or_default("stage")
+        tdir = self.get_or_default("traceDir")
+        ctx = trace(tdir) if tdir else contextlib.nullcontext()
         t0 = time.perf_counter()
-        fitted = inner.fit(dataset) if isinstance(inner, Estimator) else inner
+        with ctx, annotate(f"Timer.fit:{type(inner).__name__}"):
+            fitted = (inner.fit(dataset) if isinstance(inner, Estimator)
+                      else inner)
         dt = time.perf_counter() - t0
         if self.get_or_default("logToScala"):
             logger.info("Timer: fitting %s took %.3fs", type(inner).__name__, dt)
@@ -224,6 +239,9 @@ class Timer(Estimator):
 
 class TimerModel(Model):
     fitted = Param("fitted", "Fitted inner stage", None, is_complex=True)
+    traceDir = Param("traceDir", "If set, capture an XLA profiler trace of "
+                     "the wrapped transform into this directory", None,
+                     TypeConverters.to_string)
 
     def __init__(self, fitted: Optional[Transformer] = None, **kwargs):
         super().__init__(**kwargs)
@@ -231,9 +249,13 @@ class TimerModel(Model):
             self.set(fitted=fitted)
 
     def transform(self, dataset: Dataset) -> Dataset:
+        from ..utils.profiling import annotate, trace
         inner = self.get_or_default("fitted")
+        tdir = self.get_or_default("traceDir")
+        ctx = trace(tdir) if tdir else contextlib.nullcontext()
         t0 = time.perf_counter()
-        out = inner.transform(dataset)
+        with ctx, annotate(f"Timer.transform:{type(inner).__name__}"):
+            out = inner.transform(dataset)
         logger.info("Timer: transforming %s took %.3fs", type(inner).__name__,
                     time.perf_counter() - t0)
         return out
