@@ -10,4 +10,5 @@ if __name__ == "__main__":
     out = sys.argv[1] if len(sys.argv) > 1 else "python_api"
     result = generate_all(out)
     print(f"wrote {len(result['namespace_files'])} namespace modules, "
-          f"{result['docs']}, {result['tests']}")
+          f"{result['docs']}, {result['tests']}, "
+          f"{result['migration']}")

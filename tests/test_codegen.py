@@ -14,7 +14,8 @@ import pytest
 
 from mmlspark_tpu.codegen import (attach_pyspark_accessors, generate_all,
                                   generate_api_docs,
-                                  generate_compat_namespace)
+                                  generate_compat_namespace,
+                                  generate_migration_table)
 from mmlspark_tpu.core.dataset import Dataset
 
 
@@ -86,6 +87,21 @@ def test_api_docs_generated(generated, tmp_path):
     assert "LightGBMClassifier" in text
     assert "numIterations" in text
     assert "## mmlspark.cyber" in text
+
+
+def test_migration_table_generated(generated, tmp_path):
+    path = generate_migration_table(str(tmp_path / "MIG.md"))
+    text = open(path).read()
+    # every namespace section and a spot-check row per major family
+    assert "## mmlspark.lightgbm" in text
+    assert "`from mmlspark.lightgbm import LightGBMClassifier`" in text
+    assert "`mmlspark_tpu.models.gbdt.api.LightGBMClassifier`" in text
+    assert "## mmlspark.vw" in text
+    # checked-in copy must match a fresh regeneration (sync gate, same as
+    # the namespace modules)
+    repo_copy = os.path.join(os.path.dirname(__file__), "..",
+                             "python_api", "MIGRATION_TABLE.md")
+    assert open(repo_copy).read() == text
 
 
 def test_r_wrappers_generated(generated):
