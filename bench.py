@@ -47,12 +47,13 @@ def _tpu_reachable(timeout_s: int = 90) -> bool:
 
 def _tpu_reachable_with_wait() -> bool:
     """Probe the relay; if it's down, retry for GRAFT_BENCH_TPU_WAIT_SECS
-    (default 30 min) before conceding to the CPU fallback. A wedged relay is
-    usually transient, and a TPU number half an hour late beats publishing a
-    CPU fallback as the round's headline (round-2 lesson)."""
+    (default 60 min) before conceding to the CPU fallback. A wedged relay is
+    usually transient, and a TPU number an hour late beats publishing a
+    CPU fallback as the round's headline (round-2 lesson; round 3 saw a
+    multi-hour wedge)."""
     if _tpu_reachable():
         return True
-    budget = float(os.environ.get("GRAFT_BENCH_TPU_WAIT_SECS", "1800"))
+    budget = float(os.environ.get("GRAFT_BENCH_TPU_WAIT_SECS", "3600"))
     deadline = time.monotonic() + budget
     attempt = 0
     while time.monotonic() < deadline:
