@@ -1,16 +1,19 @@
-"""Example 25: long-context training with two sequence-parallel strategies.
+"""Example 25: long-context training with three sequence-parallel strategies.
 
 The reference has no multi-device single-model execution at all (SURVEY.md
 §2b); this framework makes long-context sequence parallelism first-class
-with two exact, interchangeable strategies over the `seq` mesh axis:
+with three exact, interchangeable strategies over the `seq` mesh axis:
 
 * ring attention — K/V blocks rotate by neighbor `ppermute`, O(S_local)
   memory, no head-count constraint;
+* zig-zag ring — same ring, causally load-balanced: each device holds one
+  early and one late sequence chunk and skips fully-masked chunk pairs
+  (~2x causal speedup; tokens ride through `zigzag_permute`);
 * Ulysses — two `all_to_all` collectives reshard heads<->sequence and run
   flash-style blockwise attention locally.
 
-Both produce identical losses (exactness), shown here by training the SPMD
-transformer on a data+seq+model mesh under each strategy.
+All three produce identical losses (exactness), shown here by training the
+SPMD transformer on a data+seq+model mesh under each strategy.
 """
 
 import numpy as np
@@ -21,6 +24,7 @@ from mmlspark_tpu.models.dnn.transformer import (TransformerConfig,
                                                  shard_opt_state,
                                                  shard_params)
 from mmlspark_tpu.parallel.mesh import make_mesh
+from mmlspark_tpu.parallel.ring_attention import zigzag_permute
 
 
 def main():
@@ -36,7 +40,7 @@ def main():
     tgts = np.roll(toks, -1, axis=1)
 
     losses = {}
-    for mode in ("ring", "ulysses"):
+    for mode in ("ring", "ring_zigzag", "ulysses"):
         cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
                                 d_head=8, n_layers=2, d_ff=64, max_len=128,
                                 seq_attention=mode)
@@ -44,18 +48,23 @@ def main():
                               cfg, mesh)
         opt = shard_opt_state(adamw_init(params), cfg, mesh)
         step = make_train_step(cfg, mesh, lr=1e-2)
+        t_in, y_in = toks, tgts
+        if mode == "ring_zigzag":   # zig-zag expects permuted sequences
+            t_in = zigzag_permute(toks, 2, axis=1)
+            y_in = zigzag_permute(tgts, 2, axis=1)
         trace = []
         for _ in range(5):
-            params, opt, loss = step(params, opt, toks, tgts)
+            params, opt, loss = step(params, opt, t_in, y_in)
             trace.append(float(loss))
         losses[mode] = trace
         print(f"{mode:8s} loss {trace[0]:.4f} -> {trace[-1]:.4f}")
         assert trace[-1] < trace[0]
 
-    # exactness: the two strategies compute the same attention, so the
+    # exactness: all strategies compute the same attention, so the
     # deterministic training trajectories coincide
-    diff = max(abs(a - b) for a, b in zip(losses["ring"],
-                                          losses["ulysses"]))
+    diff = max(abs(a - b)
+               for other in ("ring_zigzag", "ulysses")
+               for a, b in zip(losses["ring"], losses[other]))
     print("max trajectory difference:", round(diff, 6))
     assert diff < 1e-2
     return losses

@@ -99,6 +99,65 @@ class TestBlockwiseAttention:
         assert np.abs(out_b - out_l).max() < 1e-5
 
 
+class TestZigzagRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_local(self, causal):
+        from mmlspark_tpu.parallel.ring_attention import (
+            zigzag_permute, zigzag_ring_attention, zigzag_unpermute)
+
+        n = 4
+        mesh = make_mesh({"seq": n})
+        B, H, S, D = 2, 2, 32, 8
+        rng = np.random.default_rng(3)
+        q, k, v = [rng.normal(size=(B, H, S, D)).astype(np.float32)
+                   for _ in range(3)]
+        qz, kz, vz = [zigzag_permute(x, n, axis=2) for x in (q, k, v)]
+        out_z = run_seq_sharded(
+            lambda q, k, v: zigzag_ring_attention(q, k, v, "seq",
+                                                  causal=causal),
+            mesh, qz, kz, vz)
+        out = zigzag_unpermute(out_z, n, axis=2)
+        ref = np.asarray(local_attention(
+            jax.numpy.asarray(q), jax.numpy.asarray(k), jax.numpy.asarray(v),
+            causal=causal))
+        assert np.abs(out - ref).max() < 1e-5
+
+    def test_permute_roundtrip_and_layout(self):
+        from mmlspark_tpu.parallel.ring_attention import (
+            zigzag_global_positions, zigzag_permute, zigzag_unpermute)
+
+        x = np.arange(16)
+        z = zigzag_permute(x, 4, axis=0)
+        # shard 0 holds chunk 0 and chunk 7 (C=2): positions 0,1,14,15
+        assert list(z[:4]) == [0, 1, 14, 15]
+        assert np.array_equal(zigzag_unpermute(z, 4, axis=0), x)
+        pos = zigzag_global_positions(4, 16)
+        assert pos.shape == (4, 4)
+        assert sorted(pos.reshape(-1).tolist()) == list(range(16))
+
+    def test_indivisible_seq_raises(self):
+        from mmlspark_tpu.parallel.ring_attention import zigzag_permute
+
+        with pytest.raises(ValueError, match="divisible"):
+            zigzag_permute(np.arange(12), 4, axis=0)  # 12 % 8 != 0
+
+    def test_single_shard_degenerates(self):
+        from mmlspark_tpu.parallel.ring_attention import (
+            zigzag_permute, zigzag_ring_attention, zigzag_unpermute)
+
+        mesh = make_mesh({"seq": 1}, devices=jax.devices()[:1])
+        B, H, S, D = 1, 1, 8, 4
+        rng = np.random.default_rng(4)
+        q, k, v = [rng.normal(size=(B, H, S, D)).astype(np.float32)
+                   for _ in range(3)]
+        qz, kz, vz = [zigzag_permute(x, 1, axis=2) for x in (q, k, v)]
+        out = zigzag_unpermute(run_seq_sharded(
+            lambda q, k, v: zigzag_ring_attention(q, k, v, "seq"),
+            mesh, qz, kz, vz), 1, axis=2)
+        ref = np.asarray(local_attention(*map(jax.numpy.asarray, (q, k, v))))
+        assert np.allclose(out, ref, atol=1e-5)
+
+
 class TestUlyssesAttention:
     @pytest.mark.parametrize("causal", [True, False])
     def test_matches_local_and_ring(self, causal):
@@ -185,6 +244,40 @@ class TestTransformer:
             assert losses[-1] < losses[0]
         assert abs(first_losses["ring"][0]
                    - first_losses["ulysses"][0]) < 1e-3
+
+    def test_train_step_ring_zigzag_matches_ring(self):
+        # zig-zag sequence layout: permute tokens/targets, same initial
+        # loss as contiguous ring (exact attention + permutation-invariant
+        # token-mean loss), and it trains
+        from mmlspark_tpu.models.dnn.transformer import (
+            TransformerConfig, adamw_init, init_params, make_train_step,
+            shard_opt_state, shard_params)
+        from mmlspark_tpu.parallel.ring_attention import zigzag_permute
+
+        mesh = make_mesh({"data": 2, "seq": 2, "model": 2})
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, 64, (4, 32)).astype(np.int32)
+        tgts = np.roll(toks, -1, axis=1)
+        first = {}
+        for mode in ("ring", "ring_zigzag"):
+            t_in, y_in = toks, tgts
+            if mode == "ring_zigzag":
+                t_in = zigzag_permute(toks, 2, axis=1)
+                y_in = zigzag_permute(tgts, 2, axis=1)
+            cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                    d_head=8, n_layers=2, d_ff=64,
+                                    max_len=64, seq_attention=mode)
+            params = shard_params(init_params(cfg, jax.random.PRNGKey(0)),
+                                  cfg, mesh)
+            opt = shard_opt_state(adamw_init(params), cfg, mesh)
+            step = make_train_step(cfg, mesh, lr=1e-2)
+            losses = []
+            for _ in range(3):
+                params, opt, loss = step(params, opt, t_in, y_in)
+                losses.append(float(loss))
+            first[mode] = losses
+            assert losses[-1] < losses[0]
+        assert abs(first["ring"][0] - first["ring_zigzag"][0]) < 1e-3
 
     def test_tp_replicated_params_stay_identical(self):
         """Regression: replicated-param grads must be psum'd over 'model' or
