@@ -44,6 +44,26 @@ def _block_attend(q, k, v, bias, acc, m, denom, scale):
     return acc, m_new, denom
 
 
+def _ring_schedule(axis_name: str, n_shards, me, k0, v0, state, attend):
+    """Shared K/V-rotation schedule for both ring variants: attend the
+    local block, then n-1 rounds of rotate-from-neighbor + attend (rotating
+    on loop exit would be a dead neighbor exchange). ``attend(src, k_blk,
+    v_blk, state) -> state`` where ``src`` is the ring position the block
+    started at."""
+    state = attend(me, k0, v0, state)
+
+    def body(i, carry):
+        state, k_blk, v_blk = carry
+        perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        state = attend((me - i) % n_shards, k_blk, v_blk, state)
+        return state, k_blk, v_blk
+
+    state, _, _ = lax.fori_loop(1, n_shards, body, (state, k0, v0))
+    return state
+
+
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis_name: str, causal: bool = True,
                    scale: Optional[float] = None) -> jnp.ndarray:
@@ -64,7 +84,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     q_pos = my_idx * S + jnp.arange(S)
 
-    def attend(src, k_blk, v_blk, acc, m, denom):
+    def attend(src, k_blk, v_blk, state):
+        acc, m, denom = state
         k_pos = src * S + jnp.arange(S)
         if causal:
             bias = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, -jnp.inf)
@@ -72,24 +93,9 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             bias = jnp.zeros((S, S), jnp.float32)
         return _block_attend(q32, k_blk, v_blk, bias, acc, m, denom, scale)
 
-    def body(i, carry):
-        acc, m, denom, k_blk, v_blk = carry
-        # rotate K/V from the previous neighbor, then attend: after i
-        # rotations the block here started at ring position (my_idx - i)
-        perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
-        acc, m, denom = attend((my_idx - i) % n_shards, k_blk, v_blk,
-                               acc, m, denom)
-        return acc, m, denom, k_blk, v_blk
-
-    # step 0 attends the local block; the loop does the n-1 real rotations
-    # (rotating on loop exit would be a dead neighbor exchange)
-    acc, m, denom = attend(my_idx, k.astype(jnp.float32),
-                           v.astype(jnp.float32), acc, m, denom)
-    acc, m, denom, _, _ = lax.fori_loop(
-        1, n_shards, body, (acc, m, denom, k.astype(jnp.float32),
-                            v.astype(jnp.float32)))
+    acc, m, denom = _ring_schedule(
+        axis_name, n_shards, my_idx, k.astype(jnp.float32),
+        v.astype(jnp.float32), (acc, m, denom), attend)
     out = acc / jnp.maximum(denom[..., None], 1e-30)
     return out.astype(q.dtype)
 
@@ -171,9 +177,10 @@ def zigzag_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     denom = jnp.zeros((B, H, S2), jnp.float32)
     my_chunks = (me, 2 * n_shards - 1 - me)
 
-    def attend_pairs(src, k_blk, v_blk, acc, m, denom):
+    def attend_pairs(src, k_blk, v_blk, state):
         """All four (q half, k half) chunk pairs against the K/V block that
         started at ring position ``src``; fully-masked pairs skipped."""
+        acc, m, denom = state
         k_chunks = (src, 2 * n_shards - 1 - src)
         for kh in range(2):
             kc = k_chunks[kh]
@@ -207,22 +214,9 @@ def zigzag_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                 denom = denom.at[:, :, sl].set(dd)
         return acc, m, denom
 
-    def body(i, carry):
-        acc, m, denom, k_blk, v_blk = carry
-        # rotate first; after i rotations this block started at (me - i)
-        perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
-        acc, m, denom = attend_pairs((me - i) % n_shards, k_blk, v_blk,
-                                     acc, m, denom)
-        return acc, m, denom, k_blk, v_blk
-
-    # step 0 attends the local block; the loop does the n-1 real rotations
-    acc, m, denom = attend_pairs(me, k.astype(jnp.float32),
-                                 v.astype(jnp.float32), acc, m, denom)
-    acc, m, denom, _, _ = lax.fori_loop(
-        1, n_shards, body, (acc, m, denom, k.astype(jnp.float32),
-                            v.astype(jnp.float32)))
+    acc, m, denom = _ring_schedule(
+        axis_name, n_shards, me, k.astype(jnp.float32),
+        v.astype(jnp.float32), (acc, m, denom), attend_pairs)
     out = acc / jnp.maximum(denom[..., None], 1e-30)
     return out.astype(q.dtype)
 
@@ -233,41 +227,77 @@ def blockwise_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """Exact flash-style attention on ONE device: online softmax over K/V
     blocks, never materializing the [S, S] score matrix. Memory is
     O(S * block_size) — the single-device analog of the ring loop (and the
-    local kernel Ulysses runs after its all-to-all reshard)."""
+    local kernel Ulysses runs after its all-to-all reshard).
+
+    Under ``causal`` the queries are blocked too, and each Q block scans
+    only its ``qb+1`` at-or-below-diagonal K blocks — strictly-above
+    blocks are fully masked, so skipping them halves the causal compute
+    (the single-device analog of the zig-zag ring's pair skipping) while
+    staying exact."""
     B, H, S, D = q.shape
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     bs = min(int(block_size), S)
     nb = -(-S // bs)
     S_pad = nb * bs
+    pad = ((0, 0), (0, 0), (0, S_pad - S), (0, 0))
     k32 = k.astype(jnp.float32)
     v32 = v.astype(jnp.float32)
     if S_pad != S:
-        pad = ((0, 0), (0, 0), (0, S_pad - S), (0, 0))
         k32, v32 = jnp.pad(k32, pad), jnp.pad(v32, pad)
     k_blocks = k32.reshape(B, H, nb, bs, D).transpose(2, 0, 1, 3, 4)
     v_blocks = v32.reshape(B, H, nb, bs, D).transpose(2, 0, 1, 3, 4)
-
     q32 = q.astype(jnp.float32)
-    q_pos = jnp.arange(S)
 
-    def body(carry, xs):
-        acc, m, denom = carry
-        blk, k_blk, v_blk = xs
-        k_pos = blk * bs + jnp.arange(bs)
+    def attend_block(i, q_blk, q_pos, state):
+        """One K-block online-softmax accumulation against one Q block."""
+        acc, m, denom = state
+        k_blk = lax.dynamic_index_in_dim(k_blocks, i, 0, keepdims=False)
+        v_blk = lax.dynamic_index_in_dim(v_blocks, i, 0, keepdims=False)
+        k_pos = i * bs + jnp.arange(bs)
         ok = k_pos[None, :] < S                      # mask padded keys
         if causal:
             ok = ok & (q_pos[:, None] >= k_pos[None, :])
         bias = jnp.where(ok, 0.0, -jnp.inf)
-        acc, m, denom = _block_attend(q32, k_blk, v_blk, bias, acc, m,
-                                      denom, scale)
-        return (acc, m, denom), None
+        return _block_attend(q_blk, k_blk, v_blk, bias, acc, m, denom, scale)
 
-    init = (jnp.zeros((B, H, S, D), jnp.float32),
-            jnp.full((B, H, S), -jnp.inf, jnp.float32),
-            jnp.zeros((B, H, S), jnp.float32))
-    (acc, m, denom), _ = lax.scan(
-        body, init, (jnp.arange(nb), k_blocks, v_blocks))
-    out = acc / jnp.maximum(denom[..., None], 1e-30)
+    def init_state(nq):
+        return (jnp.zeros((B, H, nq, D), jnp.float32),
+                jnp.full((B, H, nq), -jnp.inf, jnp.float32),
+                jnp.zeros((B, H, nq), jnp.float32))
+
+    if not causal:
+        q_pos = jnp.arange(S)
+        acc, m, denom = lax.fori_loop(
+            0, nb, lambda i, st: attend_block(i, q32, q_pos, st),
+            init_state(S))
+        out = acc / jnp.maximum(denom[..., None], 1e-30)
+        return out.astype(q.dtype)
+
+    # causal: block the queries too and compute only the at-or-below-
+    # diagonal K blocks per Q block; strictly-above blocks are skipped via
+    # lax.cond (executed branch only on the forward AND backward pass, so
+    # the ~2x FLOP saving survives training). ONE scan over Q blocks with
+    # a static-bound inner loop keeps the program size O(1) in nb, and
+    # static bounds keep the loops reverse-differentiable (a dynamic
+    # qb+1 stop would break jax.grad through the Ulysses path).
+    q_pad = jnp.pad(q32, pad) if S_pad != S else q32
+    q_blocks = q_pad.reshape(B, H, nb, bs, D).transpose(2, 0, 1, 3, 4)
+
+    def q_body(_, qb):
+        q_blk = lax.dynamic_index_in_dim(q_blocks, qb, 0, keepdims=False)
+        q_pos = qb * bs + jnp.arange(bs)
+
+        def k_body(i, st):
+            return lax.cond(
+                i <= qb,
+                lambda s: attend_block(i, q_blk, q_pos, s),
+                lambda s: s, st)
+
+        acc, m, denom = lax.fori_loop(0, nb, k_body, init_state(bs))
+        return None, acc / jnp.maximum(denom[..., None], 1e-30)
+
+    _, outs = lax.scan(q_body, None, jnp.arange(nb))   # [nb, B, H, bs, D]
+    out = outs.transpose(1, 2, 0, 3, 4).reshape(B, H, S_pad, D)[:, :, :S]
     return out.astype(q.dtype)
 
 
