@@ -195,11 +195,15 @@ def main() -> None:
 
     predict_rows_per_sec, pred = _guard(_predict_rate, (-1.0, None))
     # sanity: the model must actually learn this signal (reuses the timed
-    # prediction — no extra forest evaluation or re-compile)
+    # prediction — no extra forest evaluation or re-compile). If prediction
+    # itself failed, report -1 rather than killing the primary metric.
     if pred is None:
-        pred = booster.predict(X[:100_000])
-    n_acc = min(len(pred), 100_000)
-    acc = ((pred[:n_acc] > 0.5) == y[:n_acc]).mean()
+        pred = _guard(lambda: booster.predict(X[:100_000]), None)
+    if pred is None:
+        acc = -1.0
+    else:
+        n_acc = min(len(pred), 100_000)
+        acc = ((pred[:n_acc] > 0.5) == y[:n_acc]).mean()
     metric = "gbdt_trees_per_sec_1M_rows_28f" if on_tpu else \
         "gbdt_trees_per_sec_50k_rows_28f_CPU_FALLBACK"
     out = {
