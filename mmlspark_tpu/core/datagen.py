@@ -43,6 +43,9 @@ class ColumnSpec:
 def numeric(name: str, low: float = 0.0, high: float = 1.0,
             missing_fraction: float = 0.0, dtype: str = "float32"
             ) -> ColumnSpec:
+    if not 0.0 <= missing_fraction <= 1.0:
+        raise ValueError(f"column {name!r}: missing_fraction must be in "
+                         f"[0, 1], got {missing_fraction}")
     return ColumnSpec(name, "numeric", low=low, high=high,
                       missing_fraction=missing_fraction, dtype=dtype)
 
@@ -74,12 +77,18 @@ def labels(name: str = "label", num_classes: int = 2) -> ColumnSpec:
 
 def _gen_column(spec: ColumnSpec, n: int, rng: np.random.Generator):
     if spec.kind == "numeric":
-        col = rng.uniform(spec.low, spec.high, size=n)
-        if spec.missing_fraction > 0:
-            if not np.issubdtype(np.dtype(spec.dtype), np.floating):
+        if np.issubdtype(np.dtype(spec.dtype), np.integer):
+            # integer semantics: uniform integers over [low, high] inclusive
+            # (truncating uniform floats would floor-bias and make the
+            # default [0, 1) range a constant column)
+            if spec.missing_fraction > 0:
                 raise ValueError(
                     f"column {spec.name!r}: missing_fraction needs a float "
                     f"dtype (NaN is not representable in {spec.dtype})")
+            return rng.integers(int(spec.low), int(spec.high) + 1,
+                                size=n).astype(spec.dtype)
+        col = rng.uniform(spec.low, spec.high, size=n)
+        if spec.missing_fraction > 0:
             col[rng.random(n) < spec.missing_fraction] = np.nan
         return col.astype(spec.dtype)
     if spec.kind == "categorical":

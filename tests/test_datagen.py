@@ -48,6 +48,17 @@ class TestGeneration:
             categorical("c", [])
         with pytest.raises(ValueError, match="num_classes"):
             labels(num_classes=1)
+        with pytest.raises(ValueError, match="missing_fraction"):
+            numeric("m", missing_fraction=1.5)
+        with pytest.raises(ValueError, match="float dtype"):
+            generate_dataset(
+                [numeric("i", missing_fraction=0.5, dtype="int32")], 10)
+
+    def test_integer_dtype_inclusive_range(self):
+        col = generate_dataset([numeric("i", low=0, high=10, dtype="int32")],
+                               5000, seed=1)["i"]
+        assert col.dtype == np.int32
+        assert col.min() == 0 and col.max() == 10   # inclusive, not biased
 
     def test_feeds_pipeline_end_to_end(self):
         # generated mixed-type data must ride the real featurize+train path

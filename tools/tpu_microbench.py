@@ -130,9 +130,13 @@ def main(quick=False):
                  dict(hist_subtraction=True, compact_selector="argsort")),
                 ("depthwise+sub/searchsorted",
                  dict(hist_subtraction=True,
-                      compact_selector="searchsorted"))]
-    if not quick:
-        variants.append(("leafwise", dict(growth_policy="leafwise")))
+                      compact_selector="searchsorted")),
+                # the LightGBM-parity default (batched best-first, the
+                # round-3 leafBatch path) — quick mode includes it so one
+                # relay window decides both the headline and the default
+                ("leafwise", dict(growth_policy="leafwise")),
+                ("leafwise+sub",
+                 dict(growth_policy="leafwise", hist_subtraction=True))]
     for name, over in variants:
         cfg = GrowConfig(num_leaves=31, growth_policy="depthwise")._replace(
             **over)
