@@ -77,16 +77,25 @@ def labels(name: str = "label", num_classes: int = 2) -> ColumnSpec:
 
 def _gen_column(spec: ColumnSpec, n: int, rng: np.random.Generator):
     if spec.kind == "numeric":
+        is_float = np.issubdtype(np.dtype(spec.dtype), np.floating)
+        if spec.missing_fraction > 0 and not is_float:
+            raise ValueError(
+                f"column {spec.name!r}: missing_fraction needs a float "
+                f"dtype (NaN is not representable in {spec.dtype})")
         if np.issubdtype(np.dtype(spec.dtype), np.integer):
-            # integer semantics: uniform integers over [low, high] inclusive
-            # (truncating uniform floats would floor-bias and make the
-            # default [0, 1) range a constant column)
-            if spec.missing_fraction > 0:
+            # integer semantics: uniform integers over the integers WITHIN
+            # [low, high] inclusive (truncating uniform floats would
+            # floor-bias and make the default [0, 1) range constant)
+            lo, hi = int(np.ceil(spec.low)), int(np.floor(spec.high))
+            if hi < lo:
                 raise ValueError(
-                    f"column {spec.name!r}: missing_fraction needs a float "
-                    f"dtype (NaN is not representable in {spec.dtype})")
-            return rng.integers(int(spec.low), int(spec.high) + 1,
-                                size=n).astype(spec.dtype)
+                    f"column {spec.name!r}: no integers in "
+                    f"[{spec.low}, {spec.high}]")
+            return rng.integers(lo, hi + 1, size=n).astype(spec.dtype)
+        if not is_float:
+            raise ValueError(
+                f"column {spec.name!r}: numeric dtype must be float or "
+                f"integer, got {spec.dtype}")
         col = rng.uniform(spec.low, spec.high, size=n)
         if spec.missing_fraction > 0:
             col[rng.random(n) < spec.missing_fraction] = np.nan

@@ -59,6 +59,17 @@ class TestGeneration:
                                5000, seed=1)["i"]
         assert col.dtype == np.int32
         assert col.min() == 0 and col.max() == 10   # inclusive, not biased
+        # fractional bounds stay inside [low, high] (ceil/floor, not trunc)
+        col = generate_dataset(
+            [numeric("j", low=0.7, high=2.3, dtype="int32")], 500, seed=2)["j"]
+        assert col.min() >= 1 and col.max() <= 2
+        with pytest.raises(ValueError, match="no integers"):
+            generate_dataset(
+                [numeric("k", low=0.2, high=0.8, dtype="int32")], 5)
+        # bool with missing_fraction must raise, not silently corrupt
+        with pytest.raises(ValueError, match="float dtype"):
+            generate_dataset(
+                [numeric("b", missing_fraction=0.5, dtype="bool")], 5)
 
     def test_feeds_pipeline_end_to_end(self):
         # generated mixed-type data must ride the real featurize+train path

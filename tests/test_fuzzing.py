@@ -504,3 +504,43 @@ def test_registry_outputs_are_new_datasets():
     with pytest.raises(AssertionError):
         assert_datasets_equal(a, Dataset({"x": np.asarray([1.0, 2.1]),
                                           "s": ["p", "q"]}))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_schema_sweep_property(seed, tmp_path):
+    """Property sweep over random schemas (datagen-driven, the analog of the
+    reference's constraint-driven GenerateDataset tests): any mix of
+    numeric/NaN/categorical/boolean columns must featurize, train, score
+    with finite probabilities, and survive a save/load round-trip."""
+    from mmlspark_tpu.core.datagen import (boolean, categorical,
+                                           generate_dataset, numeric)
+    from mmlspark_tpu.core.pipeline import Pipeline, PipelineModel
+    from mmlspark_tpu.featurize.core import Featurize
+    from mmlspark_tpu.models.gbdt.api import LightGBMClassifier
+
+    rng = np.random.default_rng(seed)
+    specs = [numeric(f"n{i}", low=float(rng.uniform(-5, 0)),
+                     high=float(rng.uniform(1, 5)),
+                     missing_fraction=float(rng.choice([0.0, 0.2])))
+             for i in range(int(rng.integers(1, 4)))]
+    specs += [categorical(f"c{i}", ["a", "b", "c"][:int(rng.integers(2, 4))])
+              for i in range(int(rng.integers(0, 3)))]
+    if rng.random() < 0.5:
+        specs.append(boolean("flag"))
+    ds = generate_dataset(specs, n_rows=300, seed=seed)
+    base = ds[specs[0].name]
+    base = np.where(np.isnan(np.asarray(base, np.float64)), 0.0,
+                    np.asarray(base, np.float64))
+    ds = ds.with_column("label",
+                        (base > np.median(base)).astype(np.float32))
+    pipe = Pipeline([
+        Featurize(inputCols=[s.name for s in specs], outputCol="features"),
+        LightGBMClassifier(numIterations=5, numLeaves=7, labelCol="label"),
+    ])
+    model = pipe.fit(ds)
+    probs = np.asarray(model.transform(ds)["probability"])
+    assert np.isfinite(probs).all()
+    path = str(tmp_path / "m")
+    model.save(path)
+    probs2 = np.asarray(PipelineModel.load(path).transform(ds)["probability"])
+    np.testing.assert_allclose(probs, probs2, rtol=1e-6)
