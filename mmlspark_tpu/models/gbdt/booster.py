@@ -146,11 +146,12 @@ def _device_tile_scores(base_d, n_pad: int, K: int, mesh: Mesh):
     return fn(base_d)
 
 
-def _bin_program(x_shape, max_bin: int, mesh: Mesh):
+def _bin_program(x_shape, max_bin: int, mesh: Mesh, bin_dtype=jnp.int32):
     return _cached_program(
-        ("bin_cols", x_shape, max_bin, mesh),
+        ("bin_cols", x_shape, max_bin, mesh, jnp.dtype(bin_dtype).name),
         lambda: jax.jit(jax.shard_map(
-            bin_cols_device, mesh=mesh,
+            lambda X, ub: bin_cols_device(X, ub, out_dtype=bin_dtype),
+            mesh=mesh,
             in_specs=(P("data", None), P()), out_specs=P(None, "data"),
             check_vma=False)))
 
@@ -193,6 +194,7 @@ class LightGBMDataset:
                   bin_sample_count: int = 200_000, seed: int = 0,
                   categorical_features=(), mesh: Optional[Mesh] = None,
                   row_valid: Optional[np.ndarray] = None,
+                  bin_dtype="int32",
                   _timer: Optional[_PhaseTimer] = None) -> "LightGBMDataset":
         tw = _timer or _PhaseTimer()
         mesh = mesh or meshlib.get_default_mesh()
@@ -205,6 +207,20 @@ class LightGBMDataset:
             raise ValueError(
                 f"categorical_features indexes {bad_cats} out of range for "
                 f"{F} features")
+        # bin-id storage dtype: int32 (default), int16 or uint8. Bin ids are
+        # < max_bin, so narrow storage is lossless within range; it shrinks
+        # the HBM-resident dataset 2x/4x — the lever that fits Criteo-scale
+        # binned matrices on a v5e pod (docs/performance.md "scaling").
+        # Kernels and routing widen per block in VMEM, never in HBM.
+        bd = jnp.dtype(bin_dtype)
+        limits = {"int32": 1 << 31, "int16": 1 << 15, "uint8": 256}
+        if bd.name not in limits:
+            raise ValueError(
+                f"bin_dtype must be one of {sorted(limits)}, got {bd.name}")
+        if max_bin > limits[bd.name]:
+            raise ValueError(
+                f"bin_dtype={bd.name} holds bin ids < {limits[bd.name]}, "
+                f"but max_bin={max_bin}")
         binner = QuantileBinner(max_bin, bin_sample_count, seed,
                                 categorical_features).fit(X)
         tw.mark("binner_fit")
@@ -217,7 +233,7 @@ class LightGBMDataset:
         if tw.on:
             X_d.block_until_ready()
             tw.mark("xfer_X")
-        bin_fn = _bin_program(X_d.shape, max_bin, mesh)
+        bin_fn = _bin_program(X_d.shape, max_bin, mesh, bin_dtype=bd)
         n_pad = X_d.shape[0]
         Xbt_d = bin_fn(X_d, jnp.asarray(binner.upper_bounds))
         # the raw copy served only to produce the binned matrix: free its
@@ -678,6 +694,7 @@ def train_booster(
     checkpoint_dir: Optional[str] = None,
     checkpoint_period: int = 10,
     categorical_features=(),
+    bin_dtype="int32",
 ) -> Booster:
     """Train a boosted ensemble, rows sharded over the mesh ``data`` axis.
 
@@ -792,7 +809,7 @@ def train_booster(
             _densify(X), y, weight, max_bin=max_bin,
             bin_sample_count=bin_sample_count, seed=seed,
             categorical_features=categorical_features, mesh=mesh,
-            row_valid=row_valid, _timer=tw)
+            row_valid=row_valid, bin_dtype=bin_dtype, _timer=tw)
     mesh = dataset.mesh
     binner = dataset.binner
     max_bin = dataset.max_bin

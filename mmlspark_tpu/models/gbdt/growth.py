@@ -262,7 +262,8 @@ def _route_rows_to_children(binned_t, row_node, slots, do, feats, bins_,
     """
     pos_oh = row_node[None, :] == slots[:, None]
     move = pos_oh & do[:, None]
-    rows = binned_t[feats]                           # [W, n]
+    # widen narrow bin storage once into a [W, n] transient (W is small)
+    rows = binned_t[feats].astype(jnp.int32)         # [W, n]
     goleft_k = rows <= bins_[:, None]
     if is_cat is not None:
         word = jnp.take_along_axis(bits_k, rows >> 5, axis=1)
@@ -336,7 +337,7 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               is_cat: Optional[jnp.ndarray] = None, qkey=None):
     """Grow one tree on (possibly sharded) rows.
 
-    binned_t: [F, n] int32 (column-major); grad/hess: [n] f32; valid: [n] f32
+    binned_t: [F, n] int32/int16/uint8 (column-major); grad/hess: [n] f32; valid: [n] f32
     row mask (0 for padding / bagged-out rows); feat_mask: [F] bool
     (feature_fraction). With ``axis_name`` set (inside shard_map), histograms
     are psum'd so every shard takes identical split decisions —

@@ -651,6 +651,32 @@ class TestLightGBMDataset:
                            cfg=GrowConfig(num_leaves=7))
         assert b2.num_trees == 8
 
+    @pytest.mark.parametrize("dtype", ["uint8", "int16"])
+    def test_narrow_bin_storage_trains_identically(self, dtype):
+        # uint8/int16 bin storage (the Criteo-scale HBM lever) must produce
+        # the SAME model as int32: bin ids are < max_bin so storage width
+        # is semantics-free
+        from mmlspark_tpu.models.gbdt.booster import LightGBMDataset
+        Xtr, _, ytr, _ = _binary_data()
+        kw = dict(objective="binary", num_iterations=5,
+                  cfg=GrowConfig(num_leaves=7))
+        ds32 = LightGBMDataset.construct(Xtr, ytr, max_bin=255)
+        dsn = LightGBMDataset.construct(Xtr, ytr, max_bin=255,
+                                        bin_dtype=dtype)
+        assert str(dsn.Xbt_d.dtype) == dtype
+        p32 = train_booster(dataset=ds32, **kw).predict(Xtr)
+        pn = train_booster(dataset=dsn, **kw).predict(Xtr)
+        np.testing.assert_array_equal(p32, pn)
+
+    def test_narrow_bin_storage_validation(self):
+        from mmlspark_tpu.models.gbdt.booster import LightGBMDataset
+        Xtr, _, ytr, _ = _binary_data()
+        with pytest.raises(ValueError, match="bin_dtype"):
+            LightGBMDataset.construct(Xtr, ytr, bin_dtype="float32")
+        with pytest.raises(ValueError, match="max_bin"):
+            LightGBMDataset.construct(Xtr, ytr, max_bin=300,
+                                      bin_dtype="uint8")
+
     def test_dataset_weighted_and_goss(self):
         from mmlspark_tpu.models.gbdt.booster import LightGBMDataset
         Xtr, _, ytr, _ = _binary_data()

@@ -128,6 +128,41 @@ class TestPallasInterpret:
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
+class TestNarrowBinStorage:
+    """uint8/int16 bin-id storage (the Criteo-scale HBM lever): the Pallas
+    kernels widen per block in VMEM, so results must be bit-identical to
+    int32 storage through the interpreter AND the XLA fallback."""
+
+    @pytest.fixture(autouse=True)
+    def _interp(self, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_PALLAS_INTERPRET", "1")
+
+    @pytest.mark.parametrize("dtype", ["uint8", "int16"])
+    @pytest.mark.parametrize("B,W", [(255, 3), (63, 16)])
+    def test_node_kernel_narrow_matches_int32(self, dtype, B, W):
+        rng = np.random.default_rng(5)
+        n, F = 1100, 6
+        b32 = rng.integers(0, B, size=(F, n), dtype=np.int32)
+        pos = jnp.asarray(rng.integers(-1, W, size=n).astype(np.int32))
+        base = jnp.asarray(rng.normal(size=(3, n)).astype(np.float32))
+        got = np.asarray(node_histogram(
+            jnp.asarray(b32.astype(dtype)), pos, base, W, B))
+        want = np.asarray(node_histogram(jnp.asarray(b32), pos, base, W, B))
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("dtype", ["uint8", "int16"])
+    def test_xla_fallback_narrow_matches_int32(self, dtype, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_DISABLE_PALLAS_HIST", "1")
+        rng = np.random.default_rng(6)
+        n, F, S, B = 900, 4, 6, 255
+        b32 = rng.integers(0, B, size=(F, n), dtype=np.int32)
+        stats_t = jnp.asarray(rng.normal(size=(S, n)).astype(np.float32))
+        got = np.asarray(histogram_cols(
+            jnp.asarray(b32.astype(dtype)), stats_t, B))
+        want = np.asarray(histogram_cols(jnp.asarray(b32), stats_t, B))
+        np.testing.assert_array_equal(got, want)
+
+
 class TestQuantizedHistogram:
     """int8 quantized-gradient histograms (LightGBM use_quantized_grad)."""
 
