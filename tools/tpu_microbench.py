@@ -137,11 +137,18 @@ def main(quick=False):
                 ("leafwise", dict(growth_policy="leafwise")),
                 ("leafwise+sub",
                  dict(growth_policy="leafwise", hist_subtraction=True))]
+    if not quick:
+        # narrow bin storage: bit-identical by construction; this measures
+        # whether the per-block VMEM widening changes TPU pass time
+        variants.append(("depthwise/uint8-bins", dict(bin_dtype="uint8")))
     for name, over in variants:
+        bin_dtype = over.pop("bin_dtype", None)
         cfg = GrowConfig(num_leaves=31, growth_policy="depthwise")._replace(
             **over)
         try:
-            train_booster(dataset=ds, objective="binary", num_iterations=10,
+            dsv = ds if bin_dtype is None else LightGBMDataset.construct(
+                X, y, max_bin=255, bin_dtype=bin_dtype)
+            train_booster(dataset=dsv, objective="binary", num_iterations=10,
                           cfg=cfg)     # warm/compile
             # train_booster ends in the packed tree download (a real device
             # sync); best-of-2 because identical runs jitter by seconds
@@ -149,7 +156,7 @@ def main(quick=False):
             dt = float("inf")
             for _ in range(2):
                 t0 = time.perf_counter()
-                b = train_booster(dataset=ds, objective="binary",
+                b = train_booster(dataset=dsv, objective="binary",
                                   num_iterations=10, cfg=cfg)
                 dt = min(dt, time.perf_counter() - t0)
             acc = float(((b.predict(X[:50_000]) > 0.5) == y[:50_000]).mean())
