@@ -15,6 +15,7 @@ arrays per iteration and are stacked into the Booster.
 from __future__ import annotations
 
 import json
+import os
 import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
@@ -1032,6 +1033,23 @@ def train_booster(
         rounds_no_improve = resume_state.get("rounds_no_improve", 0)
         history = resume_state.get("history", history)
 
+    def _iter_keys(base_key, it):
+        """Per-iteration PRNG derivation, shared by the host loop and both
+        fused paths — host/fused equivalence depends on these staying
+        bit-identical (``it`` may be a Python int or a traced scalar)."""
+        key = jax.random.fold_in(base_key, it)
+        if use_goss or is_rf:
+            # GOSS resamples every iteration; rf re-bags every iteration
+            # too (its gradients are constant, so a reused bag would
+            # duplicate trees); gbdt bagging reuses its subsample for
+            # bagging_freq rounds (LightGBM semantics)
+            bag_step = it
+        elif use_bagging:
+            bag_step = it // max(bagging_freq, 1)
+        else:
+            bag_step = 0
+        return key, jax.random.fold_in(base_key, 1_000_003 + bag_step)
+
     # --- fused fast path: no validation loop, no delegate callbacks, no
     # checkpointing, no resume -> run every iteration inside ONE compiled
     # scan. One device dispatch instead of num_iterations round-trips, which
@@ -1046,14 +1064,7 @@ def train_booster(
                 base_key = jax.random.PRNGKey(seed)
 
                 def it_body(scores_c, it):
-                    key = jax.random.fold_in(base_key, it)
-                    if use_goss or is_rf:
-                        bag_step = it
-                    elif use_bagging:
-                        bag_step = it // max(bagging_freq, 1)
-                    else:
-                        bag_step = 0
-                    bag_key = jax.random.fold_in(base_key, 1_000_003 + bag_step)
+                    key, bag_key = _iter_keys(base_key, it)
                     d = jnp.zeros((), jnp.float32)
                     scores_c, _, trees_stacked, _ = step_local(
                         binned_l, yl, wl, vmask_l, scores_c, d, d, d, d,
@@ -1103,16 +1114,117 @@ def train_booster(
                                objective, depth_cap, objective_kwargs,
                                best_iter, history, init_booster)
 
+    # --- fused early-stopped validation path: validation + early-stopping
+    # bookkeeping run ON DEVICE inside one lax.while_loop, so an
+    # early-stopped training run is still ONE dispatch (the host loop costs
+    # a ~67 ms round-trip per iteration through the tunnel). The stopping
+    # predicate derives from the psum'd metric — replicated across shards,
+    # so the while cond is SPMD-safe. Gated to the plain configuration
+    # (period-1 eval, no callbacks/checkpoint/resume) and equivalence with
+    # the host loop is pinned by tests (same best_iter, history, model);
+    # MMLSPARK_TPU_DISABLE_FUSED_VALID=1 forces the host loop.
+    fuse_es = (has_valid and iteration_callback is None and ckpt_mgr is None
+               and iterations_done == 0 and metric_eval_period == 1
+               and not os.environ.get("MMLSPARK_TPU_DISABLE_FUSED_VALID"))
+    if fuse_es:
+        fuse_key = (cache_key, num_iterations, seed, early_stopping_rounds,
+                    "fused_valid")
+
+        def build_multi_valid():
+            def multi_local(binned_l, yl, wl, vmask_l, scores_l, vbinned_l,
+                            vy_l, vw_l, vscores_l):
+                base_key = jax.random.PRNGKey(seed)
+
+                def one_iter(it, scores_c, vscores_c):
+                    key, bag_key = _iter_keys(base_key, it)
+                    scores_c, vscores_c, trees_stacked, metrics = step_local(
+                        binned_l, yl, wl, vmask_l, scores_c, vbinned_l,
+                        vy_l, vw_l, vscores_c, key, bag_key,
+                        it.astype(jnp.float32))
+                    return (scores_c, vscores_c, pack_trees(trees_stacked),
+                            metrics["valid"].astype(jnp.float32))
+
+                def track(best, best_it, rni, m, it):
+                    # same comparison the host loop applies to the
+                    # downloaded f32 metric
+                    if higher_is_better:
+                        improved = m > best + 1e-12
+                    else:
+                        improved = m < best - 1e-12
+                    return (jnp.where(improved, m, best),
+                            jnp.where(improved, it, best_it),
+                            jnp.where(improved, 0, rni + 1))
+
+                # iteration 0 runs inline: its packed-tree length sizes the
+                # static output buffer for the while carry
+                it0 = jnp.int32(0)
+                scores_c, vscores_c, packed0, m0 = one_iter(
+                    it0, scores_l, vscores_l)
+                buf = jnp.zeros((num_iterations, packed0.shape[0]),
+                                packed0.dtype).at[0].set(packed0)
+                mbuf = jnp.full((num_iterations,), jnp.nan,
+                                jnp.float32).at[0].set(m0)
+                init_best = jnp.float32(
+                    -jnp.inf if higher_is_better else jnp.inf)
+                best, best_it, rni = track(init_best, jnp.int32(-1),
+                                           jnp.int32(0), m0, it0)
+
+                def cond(carry):
+                    it = carry[0]
+                    keep = it < num_iterations
+                    if early_stopping_rounds > 0:
+                        keep &= carry[5] < early_stopping_rounds
+                    return keep
+
+                def body(carry):
+                    it, scores_c, vscores_c, best, best_it, rni, buf, mbuf \
+                        = carry
+                    scores_c, vscores_c, packed, m = one_iter(
+                        it, scores_c, vscores_c)
+                    buf = lax.dynamic_update_index_in_dim(buf, packed, it, 0)
+                    mbuf = mbuf.at[it].set(m)
+                    best, best_it, rni = track(best, best_it, rni, m, it)
+                    return (it + 1, scores_c, vscores_c, best, best_it, rni,
+                            buf, mbuf)
+
+                it, _, _, best, best_it, _, buf, mbuf = lax.while_loop(
+                    cond, body, (jnp.int32(1), scores_c, vscores_c, best,
+                                 best_it, rni, buf, mbuf))
+                return buf, mbuf, it, best_it
+
+            return jax.jit(jax.shard_map(
+                multi_local, mesh=mesh,
+                in_specs=(col_spec, row_spec, row_spec, row_spec, row2_spec,
+                          row2_spec, row_spec, row_spec, row2_spec),
+                out_specs=(P(), P(), P(), P()), check_vma=False))
+
+        multi_v = _cached_program(fuse_key, build_multi_valid)
+        tw.mark("build_multi_valid")
+        from ...utils.profiling import annotate
+        with annotate(f"gbdt_train_fused_valid:{num_iterations}it"):
+            buf_dev, mbuf_dev, n_done_dev, best_it_dev = multi_v(
+                Xbt_d, y_d, w_d, vmask_d, scores_d, Xvb_d, yv_d, wv_d,
+                vscores_d)
+        n_done = int(n_done_dev)
+        best_iter = int(best_it_dev)
+        mbuf = np.asarray(mbuf_dev)[:n_done]
+        history[metric_name].extend(float(x) for x in mbuf)
+        rows = np.asarray(buf_dev)[:n_done]
+        tw.mark("trees_download")
+        for it in range(n_done):
+            # each buffer row is one iteration's pack of K stacked trees —
+            # the same layout the host loop downloads per iteration
+            trees_host = unpack_trees(rows[it], (K,),
+                                      2 * cfg.num_leaves - 1,
+                                      bitset_words(cfg.num_bins))
+            for k in range(K):
+                all_trees.append(jax.tree_util.tree_map(
+                    lambda a: a[k], trees_host))
+        # falls through to the shared finalize/truncate/rf-scale epilogue
+
     base_key = jax.random.PRNGKey(seed)
-    for it in range(iterations_done, num_iterations):
-        key = jax.random.fold_in(base_key, it)
-        # GOSS resamples every iteration; rf re-bags every iteration too (its
-        # gradients are constant, so a reused bag would duplicate trees);
-        # gbdt bagging reuses its subsample for bagging_freq rounds
-        # (LightGBM semantics)
-        bag_step = (it if use_goss or is_rf
-                    else it // max(bagging_freq, 1) if use_bagging else 0)
-        bag_key = jax.random.fold_in(base_key, 1_000_003 + bag_step)
+    for it in ([] if fuse_es else range(iterations_done, num_iterations)):
+        key, bag_key = _iter_keys(base_key, it)
         scores_d, vscores_d_new, trees_packed, metrics = step(
             Xbt_d, y_d, w_d, vmask_d, scores_d,
             Xvb_d if has_valid else dummy, yv_d if has_valid else dummy,

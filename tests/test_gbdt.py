@@ -93,6 +93,32 @@ class TestClassifier:
         assert model.booster.best_iteration >= 0
         assert len(model.booster.eval_history["binary_logloss"]) > 0
 
+    def test_fused_early_stopping_matches_host_loop(self, monkeypatch):
+        # the device while_loop path (validation + stopping bookkeeping on
+        # device, ONE dispatch) must reproduce the host loop exactly: same
+        # best_iter, same metric history, same final model
+        Xtr, Xte, ytr, yte = _binary_data()
+        X = np.concatenate([Xtr, Xte])
+        y = np.concatenate([ytr, yte])
+        vi = np.concatenate([np.zeros(len(ytr)),
+                             np.ones(len(yte))]).astype(bool)
+        clf = LightGBMClassifier(numIterations=60, numLeaves=15,
+                                 minDataInLeaf=5, maxBin=63,
+                                 earlyStoppingRound=5,
+                                 validationIndicatorCol="isVal")
+        monkeypatch.delenv("MMLSPARK_TPU_DISABLE_FUSED_VALID",
+                           raising=False)
+        fused = clf.fit(_to_ds(X, y, isVal=vi))
+        monkeypatch.setenv("MMLSPARK_TPU_DISABLE_FUSED_VALID", "1")
+        host = clf.fit(_to_ds(X, y, isVal=vi))
+        assert fused.booster.best_iteration == host.booster.best_iteration
+        assert fused.booster.num_iterations == host.booster.num_iterations
+        np.testing.assert_allclose(
+            fused.booster.eval_history["binary_logloss"],
+            host.booster.eval_history["binary_logloss"], rtol=1e-6)
+        np.testing.assert_allclose(fused.booster.predict(Xte),
+                                   host.booster.predict(Xte), rtol=1e-6)
+
     def test_is_unbalance(self):
         rng = np.random.default_rng(0)
         n = 2000
