@@ -49,6 +49,13 @@ class TransformerConfig(NamedTuple):
     # "ulysses" (two all-to-alls reshard heads<->sequence, plain local
     # attention; needs per-TP-rank heads divisible by the seq shard count)
     seq_attention: str = "ring"
+    # Gradient rematerialization: recompute each block's activations in the
+    # backward pass instead of storing them — activation memory drops from
+    # O(n_layers * S_local * E) to O(S_local * E) at ~1/3 extra FLOPs, the
+    # standard trade that lets long-context configs fit HBM. Exact to
+    # numerical tolerance (XLA may fuse differently under checkpoint);
+    # trajectory agreement is test-pinned.
+    remat: bool = False
 
 
 def init_params(cfg: TransformerConfig, key) -> Dict:
@@ -176,7 +183,12 @@ def forward_local(params, tokens, cfg: TransformerConfig,
         m = lax.psum(m, "model").astype(dt) + lp["b2"].astype(dt)
         return x + m, None
 
-    x, _ = lax.scan(block, x, params["layers"])
+    # prevent_cse=False: safe and recommended when the checkpointed fn is a
+    # lax.scan body (per jax.checkpoint docs) — keeps XLA's CSE instead of
+    # paying optimization-barrier overhead on every step
+    x, _ = lax.scan(jax.checkpoint(block, prevent_cse=False)
+                    if cfg.remat else block,
+                    x, params["layers"])
     x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
     logits_local = jnp.einsum("bse,ev->bsv", x, params["head"].astype(dt),
                               preferred_element_type=jnp.float32)

@@ -279,6 +279,34 @@ class TestTransformer:
             assert losses[-1] < losses[0]
         assert abs(first["ring"][0] - first["ring_zigzag"][0]) < 1e-3
 
+    def test_remat_is_exact(self):
+        # gradient rematerialization trades FLOPs for activation memory;
+        # the training trajectory must be identical
+        from mmlspark_tpu.models.dnn.transformer import (
+            TransformerConfig, adamw_init, init_params, make_train_step,
+            shard_opt_state, shard_params)
+
+        mesh = make_mesh({"data": 2, "seq": 2, "model": 2})
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, 64, (4, 32)).astype(np.int32)
+        tgts = np.roll(toks, -1, axis=1)
+        losses = {}
+        for remat in (False, True):
+            cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                                    d_head=8, n_layers=2, d_ff=64,
+                                    max_len=64, remat=remat)
+            params = shard_params(init_params(cfg, jax.random.PRNGKey(0)),
+                                  cfg, mesh)
+            opt = shard_opt_state(adamw_init(params), cfg, mesh)
+            step = make_train_step(cfg, mesh, lr=1e-2)
+            tr = []
+            for _ in range(3):
+                params, opt, loss = step(params, opt, toks, tgts)
+                tr.append(float(loss))
+            losses[remat] = tr
+        assert max(abs(a - b) for a, b in
+                   zip(losses[False], losses[True])) < 1e-5
+
     def test_tp_replicated_params_stay_identical(self):
         """Regression: replicated-param grads must be psum'd over 'model' or
         the per-shard layernorm copies silently diverge."""
