@@ -47,13 +47,15 @@ def _tpu_reachable(timeout_s: int = 90) -> bool:
 
 def _tpu_reachable_with_wait() -> bool:
     """Probe the relay; if it's down, retry for GRAFT_BENCH_TPU_WAIT_SECS
-    (default 60 min) before conceding to the CPU fallback. A wedged relay is
-    usually transient, and a TPU number an hour late beats publishing a
-    CPU fallback as the round's headline (round-2 lesson; round 3 saw a
-    multi-hour wedge)."""
+    (default 30 min) before conceding to the CPU fallback. A wedged relay is
+    usually transient, and a late TPU number beats publishing a CPU
+    fallback as the round's headline (round-2 lesson) — but the wait is
+    bounded so a never-returning relay (round 3 saw a 7h wedge) still
+    yields a published fallback line rather than a driver-timeout with no
+    output at all."""
     if _tpu_reachable():
         return True
-    budget = float(os.environ.get("GRAFT_BENCH_TPU_WAIT_SECS", "3600"))
+    budget = float(os.environ.get("GRAFT_BENCH_TPU_WAIT_SECS", "1800"))
     deadline = time.monotonic() + budget
     attempt = 0
     while time.monotonic() < deadline:
