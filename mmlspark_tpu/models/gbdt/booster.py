@@ -650,6 +650,68 @@ class Booster:
 # ---------------------------------------------------------------------------
 
 
+def _fused_es_scan(one_iter, state0, num_iterations: int,
+                   early_stopping_rounds: int, higher_is_better: bool,
+                   track_metric: bool):
+    """Shared on-device training-loop harness for the fused paths (plain
+    gbdt with validation, dart with/without validation).
+
+    ``one_iter(it, state) -> (state, packed_trees [Tp] f32/i32,
+    metric f32 scalar)`` — metric is ignored when ``track_metric`` is
+    False. Returns ``(buf [T, Tp], mbuf [T], n_done i32, best_it i32)``;
+    without metric tracking the scan runs every iteration and
+    ``best_it = -1``. With it, iteration 0 runs inline (its packed length
+    sizes the static buffer) and a ``lax.while_loop`` applies the same
+    stopping bookkeeping the host loops use (the tie epsilon matches the
+    host comparison on the downloaded f32 metric)."""
+    if not track_metric:
+        def it_body(state, it):
+            state, packed, _ = one_iter(it, state)
+            return state, packed
+
+        _, buf = lax.scan(it_body, state0,
+                          jnp.arange(num_iterations, dtype=jnp.int32))
+        return (buf, jnp.full((num_iterations,), jnp.nan, jnp.float32),
+                jnp.int32(num_iterations), jnp.int32(-1))
+
+    def track(best, best_it, rni, m, it):
+        if higher_is_better:
+            improved = m > best + 1e-12
+        else:
+            improved = m < best - 1e-12
+        return (jnp.where(improved, m, best),
+                jnp.where(improved, it, best_it),
+                jnp.where(improved, 0, rni + 1))
+
+    it0 = jnp.int32(0)
+    state, packed0, m0 = one_iter(it0, state0)
+    buf = jnp.zeros((num_iterations, packed0.shape[0]),
+                    packed0.dtype).at[0].set(packed0)
+    mbuf = jnp.full((num_iterations,), jnp.nan, jnp.float32).at[0].set(m0)
+    init_best = jnp.float32(-jnp.inf if higher_is_better else jnp.inf)
+    best, best_it, rni = track(init_best, jnp.int32(-1), jnp.int32(0),
+                               m0, it0)
+
+    def cond(carry):
+        it = carry[0]
+        keep = it < num_iterations
+        if early_stopping_rounds > 0:
+            keep &= carry[4] < early_stopping_rounds
+        return keep
+
+    def body(carry):
+        it, state, best, best_it, rni, buf, mbuf = carry
+        state, packed, m = one_iter(it, state)
+        buf = lax.dynamic_update_index_in_dim(buf, packed, it, 0)
+        mbuf = mbuf.at[it].set(m)
+        best, best_it, rni = track(best, best_it, rni, m, it)
+        return it + 1, state, best, best_it, rni, buf, mbuf
+
+    it, _, _, best_it, _, buf, mbuf = lax.while_loop(
+        cond, body, (jnp.int32(1), state, best, best_it, rni, buf, mbuf))
+    return buf, mbuf, it, best_it
+
+
 def _grow_axis_for(mesh, cfg) -> "str | None":
     """Collective axis for tree growth: None on a single-shard data axis so
     depthwise histogram subtraction (single-device only) can engage — psum
@@ -1135,62 +1197,19 @@ def train_booster(
                             vy_l, vw_l, vscores_l):
                 base_key = jax.random.PRNGKey(seed)
 
-                def one_iter(it, scores_c, vscores_c):
+                def one_iter(it, state):
+                    scores_c, vscores_c = state
                     key, bag_key = _iter_keys(base_key, it)
                     scores_c, vscores_c, trees_stacked, metrics = step_local(
                         binned_l, yl, wl, vmask_l, scores_c, vbinned_l,
                         vy_l, vw_l, vscores_c, key, bag_key,
                         it.astype(jnp.float32))
-                    return (scores_c, vscores_c, pack_trees(trees_stacked),
+                    return ((scores_c, vscores_c), pack_trees(trees_stacked),
                             metrics["valid"].astype(jnp.float32))
 
-                def track(best, best_it, rni, m, it):
-                    # same comparison the host loop applies to the
-                    # downloaded f32 metric
-                    if higher_is_better:
-                        improved = m > best + 1e-12
-                    else:
-                        improved = m < best - 1e-12
-                    return (jnp.where(improved, m, best),
-                            jnp.where(improved, it, best_it),
-                            jnp.where(improved, 0, rni + 1))
-
-                # iteration 0 runs inline: its packed-tree length sizes the
-                # static output buffer for the while carry
-                it0 = jnp.int32(0)
-                scores_c, vscores_c, packed0, m0 = one_iter(
-                    it0, scores_l, vscores_l)
-                buf = jnp.zeros((num_iterations, packed0.shape[0]),
-                                packed0.dtype).at[0].set(packed0)
-                mbuf = jnp.full((num_iterations,), jnp.nan,
-                                jnp.float32).at[0].set(m0)
-                init_best = jnp.float32(
-                    -jnp.inf if higher_is_better else jnp.inf)
-                best, best_it, rni = track(init_best, jnp.int32(-1),
-                                           jnp.int32(0), m0, it0)
-
-                def cond(carry):
-                    it = carry[0]
-                    keep = it < num_iterations
-                    if early_stopping_rounds > 0:
-                        keep &= carry[5] < early_stopping_rounds
-                    return keep
-
-                def body(carry):
-                    it, scores_c, vscores_c, best, best_it, rni, buf, mbuf \
-                        = carry
-                    scores_c, vscores_c, packed, m = one_iter(
-                        it, scores_c, vscores_c)
-                    buf = lax.dynamic_update_index_in_dim(buf, packed, it, 0)
-                    mbuf = mbuf.at[it].set(m)
-                    best, best_it, rni = track(best, best_it, rni, m, it)
-                    return (it + 1, scores_c, vscores_c, best, best_it, rni,
-                            buf, mbuf)
-
-                it, _, _, best, best_it, _, buf, mbuf = lax.while_loop(
-                    cond, body, (jnp.int32(1), scores_c, vscores_c, best,
-                                 best_it, rni, buf, mbuf))
-                return buf, mbuf, it, best_it
+                return _fused_es_scan(one_iter, (scores_l, vscores_l),
+                                      num_iterations, early_stopping_rounds,
+                                      higher_is_better, True)
 
             return jax.jit(jax.shard_map(
                 multi_local, mesh=mesh,
@@ -1426,7 +1445,15 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
     best_iter, rounds_no_improve = -1, 0
     base_key = jax.random.PRNGKey(seed)
 
-    for it in range(num_iterations):
+    # The drop sets depend only on the numpy RNG stream, never on data, so
+    # the whole schedule + scale evolution precomputes up front; BOTH the
+    # fused dispatch and the host loop consume these rows, so there is one
+    # copy of the drop/scale logic (eff_rows[it] = scales entering
+    # iteration it with its drop set zeroed; post_rows[it] = scales after
+    # the iteration's DART renormalization).
+    eff_rows = np.zeros((T_max, T_max), np.float32)
+    post_rows = np.zeros((T_max, T_max), np.float32)
+    for it in range(T_max):
         if it == 0 or rng_drop.uniform() < skip_drop:
             dropped = np.empty(0, np.int64)
         else:
@@ -1434,13 +1461,98 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
             if max_drop > 0 and len(dropped) > max_drop:
                 dropped = rng_drop.choice(dropped, size=max_drop,
                                           replace=False)
-        eff = scales.copy()
-        eff[dropped] = 0.0
+        eff_rows[it] = scales
+        eff_rows[it, dropped] = 0.0
+        kdrop = len(dropped)
+        scales[dropped] *= kdrop / (kdrop + 1.0)
+        scales[it] = 1.0 / (kdrop + 1.0)
+        post_rows[it] = scales
+
+    # --- fused dart: the entire run in ONE device dispatch — a scan
+    # without validation, the shared _fused_es_scan while_loop with
+    # on-device early stopping with it (previously every dart iteration
+    # paid a tunnel round-trip).
+    fuse_dart = (iteration_callback is None
+                 and (not has_valid or metric_eval_period == 1)
+                 and not os.environ.get("MMLSPARK_TPU_DISABLE_FUSED_DART"))
+    if fuse_dart:
+        fuse_key = (cache_key, num_iterations, seed, early_stopping_rounds,
+                    "dart_fused")
+
+        def build_dart_fused():
+            def multi_local(binned_l, yl, wl, vmask_l, contribs_l,
+                            vbinned_l, vcontribs_l, eff_mat, post_mat,
+                            vy_l, vw_l):
+                def one_iter(it, state):
+                    contribs_c, vcontribs_c = state
+                    key = jax.random.fold_in(base_key, it)
+                    bag_step = (it // max(bagging_freq, 1)
+                                if use_bagging else 0)
+                    bag_key = jax.random.fold_in(base_key,
+                                                 1_000_003 + bag_step)
+                    contribs_c, vcontribs_c, packed = dart_step_local(
+                        binned_l, yl, wl, vmask_l, contribs_c, eff_mat[it],
+                        vbinned_l, vcontribs_c, key, bag_key, it)
+                    if has_valid:
+                        m = dart_eval_local(vcontribs_c, post_mat[it],
+                                            vy_l, vw_l).astype(jnp.float32)
+                    else:
+                        m = jnp.float32(jnp.nan)
+                    return (contribs_c, vcontribs_c), packed, m
+
+                return _fused_es_scan(one_iter, (contribs_l, vcontribs_l),
+                                      num_iterations, early_stopping_rounds,
+                                      higher_is_better,
+                                      track_metric=has_valid)
+
+            return jax.jit(jax.shard_map(
+                multi_local, mesh=mesh,
+                in_specs=(col_spec, row_spec, row_spec, row_spec, c_spec,
+                          row2_spec if has_valid else P(),
+                          c_spec if has_valid else P(), P(), P(),
+                          row_spec if has_valid else P(),
+                          row_spec if has_valid else P()),
+                out_specs=(P(), P(), P(), P()), check_vma=False))
+
+        multi_d = _cached_program(fuse_key, build_dart_fused)
+        from ...utils.profiling import annotate
+        with annotate(f"dart_train_fused:{num_iterations}it"):
+            buf_dev, mbuf_dev, n_done_dev, best_it_dev = multi_d(
+                Xbt_d, y_d, w_d, vmask_d, contribs_d,
+                Xvb_d if has_valid else dummy,
+                vcontribs_d if has_valid else dummy,
+                jnp.asarray(eff_rows), jnp.asarray(post_rows),
+                yv_d if has_valid else dummy,
+                wv_d if has_valid else dummy)
+        n_done = int(n_done_dev)
+        best_iter = int(best_it_dev)
+        if has_valid:
+            history[metric_name].extend(
+                float(x) for x in np.asarray(mbuf_dev)[:n_done])
+        rows = np.asarray(buf_dev)[:n_done]
+        for it in range(n_done):
+            trees_host = unpack_trees(rows[it], (K,),
+                                      2 * cfg.num_leaves - 1,
+                                      bitset_words(cfg.num_bins))
+            for k in range(K):
+                all_trees.append(jax.tree_util.tree_map(
+                    lambda a: a[k], trees_host))
+        # the per-tree scale vector is the post-step scales of the last
+        # executed iteration — identical to the host loop's final `scales`
+        scales = post_rows[n_done - 1].copy()
+        booster = _finalize_trees(all_trees, binner, max_bin, K, base,
+                                  objective, depth_cap, objective_kwargs,
+                                  best_iter, history, None)
+        return _scale_booster_values(booster,
+                                     np.repeat(scales[:n_done], K))
+
+    for it in range(num_iterations):
         key = jax.random.fold_in(base_key, it)
         bag_step = it // max(bagging_freq, 1) if use_bagging else 0
         bag_key = jax.random.fold_in(base_key, 1_000_003 + bag_step)
         contribs_d, vcontribs_new, trees_packed = dstep(
-            Xbt_d, y_d, w_d, vmask_d, contribs_d, jnp.asarray(eff),
+            Xbt_d, y_d, w_d, vmask_d, contribs_d,
+            jnp.asarray(eff_rows[it]),
             Xvb_d if has_valid else dummy,
             vcontribs_d if has_valid else dummy,
             key, bag_key, np.int32(it))
@@ -1452,9 +1564,7 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
         for k in range(K):
             all_trees.append(jax.tree_util.tree_map(lambda a: a[k],
                                                     trees_host))
-        kdrop = len(dropped)
-        scales[dropped] *= kdrop / (kdrop + 1.0)
-        scales[it] = 1.0 / (kdrop + 1.0)
+        scales = post_rows[it].copy()
 
         if has_valid and (it % metric_eval_period == 0
                           or it == num_iterations - 1):

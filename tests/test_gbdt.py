@@ -119,6 +119,40 @@ class TestClassifier:
         np.testing.assert_allclose(fused.booster.predict(Xte),
                                    host.booster.predict(Xte), rtol=1e-6)
 
+    def test_fused_dart_matches_host_loop(self, monkeypatch):
+        # the fused dart dispatch precomputes the drop schedule from the
+        # same numpy stream the host loop draws — models must be identical,
+        # with and without a validation set
+        Xtr, Xte, ytr, yte = _binary_data()
+        X = np.concatenate([Xtr, Xte])
+        y = np.concatenate([ytr, yte])
+        vi = np.concatenate([np.zeros(len(ytr)),
+                             np.ones(len(yte))]).astype(bool)
+        for with_valid in (False, True):
+            kw = dict(numIterations=25, numLeaves=15, boostingType="dart",
+                      dropRate=0.3, maxBin=63, labelCol="label")
+            if with_valid:
+                kw.update(validationIndicatorCol="isVal",
+                          earlyStoppingRound=6)
+            data = (_to_ds(X, y, isVal=vi) if with_valid
+                    else _to_ds(Xtr, ytr))
+            monkeypatch.delenv("MMLSPARK_TPU_DISABLE_FUSED_DART",
+                               raising=False)
+            fused = LightGBMClassifier(**kw).fit(data)
+            monkeypatch.setenv("MMLSPARK_TPU_DISABLE_FUSED_DART", "1")
+            host = LightGBMClassifier(**kw).fit(data)
+            monkeypatch.delenv("MMLSPARK_TPU_DISABLE_FUSED_DART")
+            assert fused.booster.num_trees == host.booster.num_trees
+            assert (fused.booster.best_iteration
+                    == host.booster.best_iteration)
+            np.testing.assert_allclose(fused.booster.predict(Xte),
+                                       host.booster.predict(Xte),
+                                       rtol=1e-6)
+            if with_valid:
+                np.testing.assert_allclose(
+                    fused.booster.eval_history["binary_logloss"],
+                    host.booster.eval_history["binary_logloss"], rtol=1e-6)
+
     def test_is_unbalance(self):
         rng = np.random.default_rng(0)
         n = 2000
