@@ -662,8 +662,13 @@ def _fused_es_scan(one_iter, state0, num_iterations: int,
     without metric tracking the scan runs every iteration and
     ``best_it = -1``. With it, iteration 0 runs inline (its packed length
     sizes the static buffer) and a ``lax.while_loop`` applies the same
-    stopping bookkeeping the host loops use (the tie epsilon matches the
-    host comparison on the downloaded f32 metric)."""
+    stopping bookkeeping the host loops use. The 1e-12 tie epsilon is
+    written to mirror the host comparison, but on device it is applied in
+    f32 where it is below one ulp of any realistic metric value — the
+    predicate is effectively a strict compare. Equivalence with the host
+    (which compares in f64) holds because the metric itself is
+    f32-quantized: distinct f32 metric values differ by far more than
+    1e-12, so both predicates make the same decision."""
     if not track_metric:
         def it_body(state, it):
             state, packed, _ = one_iter(it, state)
@@ -1226,9 +1231,12 @@ def train_booster(
                 vscores_d)
         n_done = int(n_done_dev)
         best_iter = int(best_it_dev)
-        mbuf = np.asarray(mbuf_dev)[:n_done]
+        # slice on device before downloading: when early stopping fires well
+        # before num_iterations, the static buffer's unused zero rows must
+        # not cross the (slow, tunneled) host link
+        mbuf = np.asarray(mbuf_dev[:n_done])
         history[metric_name].extend(float(x) for x in mbuf)
-        rows = np.asarray(buf_dev)[:n_done]
+        rows = np.asarray(buf_dev[:n_done])
         tw.mark("trees_download")
         for it in range(n_done):
             # each buffer row is one iteration's pack of K stacked trees —
@@ -1527,9 +1535,10 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
         n_done = int(n_done_dev)
         best_iter = int(best_it_dev)
         if has_valid:
+            # device-side slice: don't download unexecuted zero rows
             history[metric_name].extend(
-                float(x) for x in np.asarray(mbuf_dev)[:n_done])
-        rows = np.asarray(buf_dev)[:n_done]
+                float(x) for x in np.asarray(mbuf_dev[:n_done]))
+        rows = np.asarray(buf_dev[:n_done])
         for it in range(n_done):
             trees_host = unpack_trees(rows[it], (K,),
                                       2 * cfg.num_leaves - 1,

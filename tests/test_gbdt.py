@@ -119,6 +119,45 @@ class TestClassifier:
         np.testing.assert_allclose(fused.booster.predict(Xte),
                                    host.booster.predict(Xte), rtol=1e-6)
 
+    @pytest.mark.parametrize("variant", ["goss", "rf", "multiclass"])
+    def test_fused_es_matches_host_loop_variants(self, monkeypatch, variant):
+        # fuse_es engages by default for EVERY validated configuration;
+        # equivalence was previously pinned only for binary gbdt (+dart).
+        # Pin the other families the fused path silently covers.
+        if variant == "multiclass":
+            X, y = load_iris(return_X_y=True)
+            vi = (np.arange(len(y)) % 3 == 0)
+            kw = dict(numIterations=40, numLeaves=7, minDataInLeaf=3,
+                      maxBin=63, earlyStoppingRound=4,
+                      validationIndicatorCol="isVal")
+            metric = "multi_logloss"
+        else:
+            Xtr, Xte, ytr, yte = _binary_data()
+            X = np.concatenate([Xtr, Xte])
+            y = np.concatenate([ytr, yte])
+            vi = np.concatenate([np.zeros(len(ytr)),
+                                 np.ones(len(yte))]).astype(bool)
+            kw = dict(numIterations=40, numLeaves=15, minDataInLeaf=5,
+                      maxBin=63, earlyStoppingRound=4,
+                      validationIndicatorCol="isVal", boostingType=variant)
+            if variant == "rf":
+                kw.update(baggingFraction=0.632, baggingFreq=1)
+            metric = "binary_logloss"
+        clf = LightGBMClassifier(**kw)
+        data = _to_ds(X, y, isVal=vi)
+        monkeypatch.delenv("MMLSPARK_TPU_DISABLE_FUSED_VALID",
+                           raising=False)
+        fused = clf.fit(data)
+        monkeypatch.setenv("MMLSPARK_TPU_DISABLE_FUSED_VALID", "1")
+        host = clf.fit(data)
+        assert fused.booster.best_iteration == host.booster.best_iteration
+        assert fused.booster.num_iterations == host.booster.num_iterations
+        np.testing.assert_allclose(fused.booster.eval_history[metric],
+                                   host.booster.eval_history[metric],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(fused.booster.predict(X[vi]),
+                                   host.booster.predict(X[vi]), rtol=1e-6)
+
     def test_fused_dart_matches_host_loop(self, monkeypatch):
         # the fused dart dispatch precomputes the drop schedule from the
         # same numpy stream the host loop draws — models must be identical,
