@@ -108,14 +108,13 @@ def main() -> None:
     tpu_up = force_cpu is False and _tpu_reachable()
     cpu_published = False
 
-    def _poll_cpu(block: bool = False) -> None:
+    def _poll_cpu(block: bool = False, deadline: float = 0.0) -> None:
         nonlocal cpu_published
         if cpu_published:
             return
         if block:
             try:
-                cpu_proc.wait(timeout=max(5.0,
-                                          cpu_deadline - time.monotonic()))
+                cpu_proc.wait(timeout=max(5.0, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 cpu_proc.kill()
         if cpu_proc.poll() is not None or block:
@@ -158,7 +157,12 @@ def main() -> None:
 
     # Publish the fallback line before waiting on (or skipping) the TPU
     # leg — from here on the round has a number no matter what happens next.
-    _poll_cpu(block=True)
+    # With no TPU leg coming, a still-healthy CPU leg may use the whole
+    # remaining budget; with one running concurrently, it must yield by
+    # cpu_deadline so the TPU leg's wait isn't starved.
+    _poll_cpu(block=True,
+              deadline=(cpu_deadline if tpu_proc is not None
+                        else start + total - 30.0))
 
     if tpu_proc is None:
         print("[bench] relay never answered within the cap; CPU fallback "

@@ -157,6 +157,24 @@ def _bin_program(x_shape, max_bin: int, mesh: Mesh, bin_dtype=jnp.int32):
             check_vma=False)))
 
 
+def _validate_bin_dtype(bin_dtype, max_bin: int):
+    """Bin-id storage dtype: int32 (default), int16 or uint8. Bin ids are
+    < max_bin, so narrow storage is lossless within range; it shrinks the
+    HBM-resident dataset 2x/4x — the lever that fits Criteo-scale binned
+    matrices on a v5e pod (docs/performance.md "scaling"). Kernels and
+    routing widen per block in VMEM, never in HBM."""
+    bd = jnp.dtype(bin_dtype)
+    limits = {"int32": 1 << 31, "int16": 1 << 15, "uint8": 256}
+    if bd.name not in limits:
+        raise ValueError(
+            f"bin_dtype must be one of {sorted(limits)}, got {bd.name}")
+    if max_bin > limits[bd.name]:
+        raise ValueError(
+            f"bin_dtype={bd.name} holds bin ids < {limits[bd.name]}, "
+            f"but max_bin={max_bin}")
+    return bd
+
+
 class LightGBMDataset:
     """Pre-binned, device-resident GBDT training dataset: bin once, train many.
 
@@ -191,12 +209,45 @@ class LightGBMDataset:
         return int(self.Xbt_d.shape[0])
 
     @classmethod
-    def construct(cls, X, y, weight=None, *, max_bin: int = 255,
+    def construct(cls, X=None, y=None, weight=None, *, max_bin: int = 255,
                   bin_sample_count: int = 200_000, seed: int = 0,
                   categorical_features=(), mesh: Optional[Mesh] = None,
                   row_valid: Optional[np.ndarray] = None,
-                  bin_dtype="int32",
+                  bin_dtype="int32", path=None, label_path=None,
+                  weight_path=None, chunk_rows: Optional[int] = None,
                   _timer: Optional[_PhaseTimer] = None) -> "LightGBMDataset":
+        if path is None and (label_path is not None
+                             or weight_path is not None
+                             or chunk_rows is not None):
+            raise ValueError(
+                "label_path/weight_path/chunk_rows only apply with path= "
+                "(out-of-core); for in-memory arrays pass y/weight directly")
+        if path is not None:
+            # out-of-core: stream file shards through chunked device binning
+            # (host peak = one chunk + the binner sample). The reference's
+            # equivalent is Spark partition files feeding the chunked native
+            # dataset (lightgbm/LightGBMUtils.scala:201-265).
+            if X is not None or y is not None or weight is not None:
+                raise ValueError(
+                    "pass either in-memory arrays or path=..., not both")
+            if label_path is None:
+                raise ValueError("path= requires label_path=")
+            if row_valid is not None:
+                raise ValueError("row_valid is not supported with path= "
+                                 "(ranker group padding is in-memory only)")
+            from .ingest import construct_from_files
+            # out-of-core is the large-n regime: narrow the default bin
+            # storage to uint8 when max_bin allows (explicit non-default
+            # bin_dtype is honored as given)
+            if bin_dtype == "int32" and max_bin <= 256:
+                bin_dtype = "uint8"
+            _validate_bin_dtype(bin_dtype, max_bin)
+            return construct_from_files(
+                path, label_path, weight_path, max_bin=max_bin,
+                bin_sample_count=bin_sample_count, seed=seed,
+                categorical_features=categorical_features, mesh=mesh,
+                bin_dtype=bin_dtype,
+                chunk_rows=262_144 if chunk_rows is None else chunk_rows)
         tw = _timer or _PhaseTimer()
         mesh = mesh or meshlib.get_default_mesh()
         X = np.asarray(X, dtype=np.float32)
@@ -208,20 +259,7 @@ class LightGBMDataset:
             raise ValueError(
                 f"categorical_features indexes {bad_cats} out of range for "
                 f"{F} features")
-        # bin-id storage dtype: int32 (default), int16 or uint8. Bin ids are
-        # < max_bin, so narrow storage is lossless within range; it shrinks
-        # the HBM-resident dataset 2x/4x — the lever that fits Criteo-scale
-        # binned matrices on a v5e pod (docs/performance.md "scaling").
-        # Kernels and routing widen per block in VMEM, never in HBM.
-        bd = jnp.dtype(bin_dtype)
-        limits = {"int32": 1 << 31, "int16": 1 << 15, "uint8": 256}
-        if bd.name not in limits:
-            raise ValueError(
-                f"bin_dtype must be one of {sorted(limits)}, got {bd.name}")
-        if max_bin > limits[bd.name]:
-            raise ValueError(
-                f"bin_dtype={bd.name} holds bin ids < {limits[bd.name]}, "
-                f"but max_bin={max_bin}")
+        bd = _validate_bin_dtype(bin_dtype, max_bin)
         binner = QuantileBinner(max_bin, bin_sample_count, seed,
                                 categorical_features).fit(X)
         tw.mark("binner_fit")

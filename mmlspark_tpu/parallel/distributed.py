@@ -26,7 +26,11 @@ def initialize(coordinator_address: Optional[str] = None,
     triple (LightGBMUtils.scala:116-185) but with exactly-once semantics and no
     bespoke socket protocol.
     """
-    if jax.process_count() > 1:
+    # Guard against double-init WITHOUT touching the XLA backend:
+    # jax.process_count() would initialize it, and jax.distributed must run
+    # first (this exact ordering bug is why the guard reads internal state).
+    from jax._src import distributed as _jdist
+    if getattr(_jdist.global_state, "client", None) is not None:
         return  # already initialized
     try:
         jax.distributed.initialize(
@@ -58,7 +62,14 @@ def barrier(name: str = "barrier") -> None:
 
     Replaces Spark barrier execution mode (reference: TrainUtils.scala:476-483).
     """
-    if jax.process_count() == 1:
-        return
-    client = jax.lib.xla_bridge.get_backend().distributed_client  # pragma: no cover
-    client.wait_at_barrier(name, 60_000)  # pragma: no cover
+    # Read the coordination client BEFORE any jax.* call that could
+    # initialize the XLA backend: a pre-init backend touch here would both
+    # no-op the barrier and poison a later initialize() (same ordering
+    # hazard as in initialize() above).
+    from jax._src import distributed as _jdist
+    client = _jdist.global_state.client
+    if client is None:
+        if jax.process_count() == 1:
+            return                      # single process: barrier is a no-op
+        raise RuntimeError("no distributed client; call initialize() first")
+    client.wait_at_barrier(name, timeout_in_ms=60_000)

@@ -1,0 +1,82 @@
+"""Worker for the two-process ``jax.distributed`` test (not collected by
+pytest — launched as a subprocess by tests/test_distributed_multiprocess.py).
+
+Exercises the real multi-host init path (`parallel/distributed.initialize`
+with an explicit coordinator — the replacement for the reference's driver
+ServerSocket rendezvous, lightgbm/LightGBMUtils.scala:116-185), a barrier, a
+cross-process psum, and a tiny distributed GBDT fit over the global mesh.
+Process 0 prints one JSON line with the results; equality with a
+single-process 2-virtual-device run is asserted by the parent test.
+
+Usage: python _dist_worker.py <coordinator> <num_procs> <process_id>
+       python _dist_worker.py single2   (1 process, 2 virtual devices)
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    single = sys.argv[1] == "single2"
+    if single:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=2").strip()
+    import jax
+
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from mmlspark_tpu.parallel import distributed
+    from mmlspark_tpu.parallel.mesh import default_mesh, make_mesh
+
+    if single:
+        pid = 0
+    else:
+        coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+        distributed.initialize(coord, nproc, pid)
+        assert jax.process_count() == nproc, jax.process_count()
+        assert distributed.process_index() == pid
+        assert distributed.is_coordinator() == (pid == 0)
+        distributed.barrier("worker-start")
+    assert jax.device_count() == 2, jax.devices()
+
+    mesh = make_mesh()                    # all (global) devices on "data"
+    x = np.arange(8, dtype=np.float32)
+    xd = jax.device_put(x, jax.NamedSharding(mesh, P("data")))
+    psum = jax.jit(jax.shard_map(
+        lambda a: jax.lax.psum(a, "data"), mesh=mesh,
+        in_specs=P("data"), out_specs=P(None), check_vma=False))(xd)
+    psum_host = [float(v) for v in np.asarray(psum)]
+
+    from mmlspark_tpu.models.gbdt.booster import (LightGBMDataset,
+                                                  train_booster)
+    from mmlspark_tpu.models.gbdt.growth import GrowConfig
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1024, 6)).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.2 * X[:, 2] > 0).astype(np.float32)
+    with default_mesh(mesh):
+        ds = LightGBMDataset.construct(X, y, max_bin=63)
+        booster = train_booster(
+            dataset=ds, objective="binary", num_iterations=4,
+            cfg=GrowConfig(num_leaves=7, min_data_in_leaf=10))
+    model_text = booster.to_lightgbm_string()
+
+    if not single:
+        distributed.barrier("worker-done")
+    if pid == 0:
+        print(json.dumps({
+            "process_count": jax.process_count(),
+            "device_count": jax.device_count(),
+            "psum": psum_host,
+            "model_sha": __import__("hashlib").sha256(
+                model_text.encode()).hexdigest(),
+            "num_trees": booster.num_trees,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
