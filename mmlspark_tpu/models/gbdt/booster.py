@@ -248,6 +248,10 @@ class LightGBMDataset:
                 categorical_features=categorical_features, mesh=mesh,
                 bin_dtype=bin_dtype,
                 chunk_rows=262_144 if chunk_rows is None else chunk_rows)
+        if X is None or y is None:
+            raise ValueError(
+                "construct needs in-memory arrays (X, y) or file shards "
+                "(path=..., label_path=...)")
         tw = _timer or _PhaseTimer()
         mesh = mesh or meshlib.get_default_mesh()
         X = np.asarray(X, dtype=np.float32)
@@ -470,8 +474,12 @@ class Booster:
         ``method="treeshap"`` (default — parity with the reference's
         ``featuresShapCol``, lightgbm/LightGBMBooster.scala:250-269, which
         rides LightGBM's native TreeSHAP): exact Shapley values of the
-        cover-conditional value function, computed by the polynomial
-        TreeSHAP algorithm on host (see :mod:`.treeshap`).
+        cover-conditional value function. Runs the fixed-shape per-leaf
+        device formulation (:mod:`.treeshap_device` — leaf paths folded on
+        host, all O(depth^2) Shapley-weight work jitted and vectorized
+        over leaves x rows); set ``MMLSPARK_TPU_SHAP_HOST=1`` to force the
+        reference host recursion (:mod:`.treeshap`, Lundberg Alg. 2) the
+        device path is pinned against.
 
         ``method="saabas"``: fast on-device path attribution — walking
         root->leaf attributes the change in expected node value to the
@@ -479,6 +487,16 @@ class Booster:
         correlated features; kept as the throughput option.
         """
         if method == "treeshap":
+            # default by backend: the fixed-shape device program is built
+            # for TPU (tiny fused VPU/MXU ops, one scanned executable);
+            # measured on the XLA CPU backend it loses to the numpy host
+            # recursion, so CPU defaults to host. Env overrides both ways.
+            force_host = os.environ.get("MMLSPARK_TPU_SHAP_HOST") == "1"
+            force_dev = os.environ.get("MMLSPARK_TPU_SHAP_DEVICE") == "1"
+            on_accel = jax.devices()[0].platform not in ("cpu",)
+            if force_dev or (on_accel and not force_host):
+                from .treeshap_device import shap_values_device
+                return shap_values_device(self, X)
             from .treeshap import shap_values
             return shap_values(self, X)
         if method != "saabas":

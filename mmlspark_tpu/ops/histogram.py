@@ -416,6 +416,10 @@ def _hist_group_dot(o_ref, b_ref, sb, g, BP: int, P: int, acc):
     per pass at 1M rows x 28 features x 255 bins, with pass time flat in
     both bin count and stats dtype (the signature of a non-MXU bottleneck).
     Removing it took the fused training step from 9.1 to 24.2 trees/sec.
+    [Capture condition: builder-measured through the round-3 TPU tunnel
+    (tools/tpu_microbench.py), best-of-2 under multi-second transport
+    jitter; NOT yet corroborated by a driver BENCH artifact — see
+    docs/performance.md "Provenance tags".]
     """
     if P == 1:
         # widen narrow bin storage (uint8/int16) per block, in VMEM only

@@ -1,0 +1,72 @@
+"""Golden-corpus interop: load LightGBM text models, reproduce predictions.
+
+Discovers tests/resources/lgbm_golden/<name>/{model.txt, expected.json}
+and pins load->predict equality for each (reference round-trips real
+native models the same way, LightGBMClassifier.scala:172-194).
+
+Corpus provenance (also in each expected.json): the build environment
+cannot install stock lightgbm (no package, zero egress) and the reference
+ships no model files, so the checked-in corpus is hand-constructed to the
+v3 format with expectations from an INDEPENDENT evaluator
+(tools/author_golden_corpus.py). In any environment with the wheel,
+``python tools/gen_lgbm_golden.py`` swaps in true stock-generated models
++ stock predictions and this test validates against those instead; the
+final test here runs that path inline when lightgbm is importable.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.gbdt.booster import Booster
+
+CORPUS = os.path.join(os.path.dirname(__file__), "resources",
+                      "lgbm_golden")
+NAMES = sorted(os.listdir(CORPUS)) if os.path.isdir(CORPUS) else []
+
+
+@pytest.mark.parametrize("name", NAMES)
+def test_golden_load_and_predict(name):
+    d = os.path.join(CORPUS, name)
+    with open(os.path.join(d, "model.txt")) as f:
+        model_text = f.read()
+    with open(os.path.join(d, "expected.json")) as f:
+        exp = json.load(f)
+    b = Booster.from_lightgbm_string(model_text)
+    X = np.asarray(exp["X"], np.float32)
+    raw = b.predict_raw(X)
+    np.testing.assert_allclose(raw, np.asarray(exp["raw"]),
+                               rtol=1e-5, atol=1e-6,
+                               err_msg=f"{name}: raw scores diverge "
+                                       f"({exp['provenance']})")
+    pred = b.predict(X)
+    np.testing.assert_allclose(pred, np.asarray(exp["pred"]),
+                               rtol=1e-5, atol=1e-6,
+                               err_msg=f"{name}: predictions diverge")
+
+
+def test_corpus_complete():
+    assert set(NAMES) >= {"binary", "regression", "dart", "multiclass",
+                          "categorical"}, NAMES
+
+
+def test_emitted_models_reload_in_stock_lightgbm():
+    """The reverse direction, with the real thing: models our emitter
+    writes must load in stock LightGBM and predict identically. Runs only
+    where the wheel exists (skipped in the hermetic build image)."""
+    lgb = pytest.importorskip("lightgbm")
+    from mmlspark_tpu.models.gbdt.booster import train_booster
+    from mmlspark_tpu.models.gbdt.growth import GrowConfig
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 4)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.float32)
+    ours = train_booster(X, y, objective="binary", num_iterations=6,
+                         cfg=GrowConfig(num_leaves=15, min_data_in_leaf=10),
+                         max_bin=63)
+    stock = lgb.Booster(model_str=ours.to_lightgbm_string())
+    np.testing.assert_allclose(stock.predict(X, raw_score=True),
+                               ours.predict_raw(X)[:, 0],
+                               rtol=1e-5, atol=1e-6)

@@ -159,6 +159,38 @@ class TestExactTreeSHAP:
         raw = b.predict_raw(X[:8])[:, 0]
         np.testing.assert_allclose(c.sum(axis=1), raw, atol=1e-4)
 
+    def test_device_matches_host_recursion(self, monkeypatch):
+        # the fixed-shape device program must reproduce the host Alg. 2
+        # recursion (its reference implementation) on awkward inputs: NaNs,
+        # a categorical feature, odd row blocks, multiclass
+        from mmlspark_tpu.models.gbdt.treeshap import shap_values
+        from mmlspark_tpu.models.gbdt.treeshap_device import \
+            shap_values_device
+
+        rng = np.random.default_rng(5)
+        n, F = 600, 8
+        X = rng.normal(size=(n, F)).astype(np.float32)
+        X[rng.random((n, F)) < 0.03] = np.nan
+        X[:, 2] = rng.integers(0, 6, size=n)
+        y2 = ((X[:, 2] % 2 == 0)
+              ^ (np.nan_to_num(X[:, 0]) > 0)).astype(np.float32)
+        y3 = ((np.nan_to_num(X[:, 0]) > 0.5).astype(int)
+              + (np.nan_to_num(X[:, 1]) > 0)).astype(np.float32)
+        for obj, yy, kw in (("binary", y2, {}),
+                            ("multiclass", y3, dict(num_class=3))):
+            b = train_booster(X, yy, objective=obj, num_iterations=8,
+                              cfg=GrowConfig(num_leaves=15,
+                                             min_data_in_leaf=5),
+                              max_bin=31, categorical_features=(2,), **kw)
+            host = shap_values(b, X[:300])
+            dev = shap_values_device(b, X[:300], row_block=128)
+            rel = np.abs(host - dev).max() / max(np.abs(host).max(), 1e-9)
+            assert rel < 1e-4, f"{obj}: device/host diverge ({rel:.2e})"
+        # env escape hatch routes predict_contrib back to the host path
+        monkeypatch.setenv("MMLSPARK_TPU_SHAP_HOST", "1")
+        via_env = b.predict_contrib(X[:50])
+        np.testing.assert_array_equal(via_env, shap_values(b, X[:50]))
+
     def test_categorical_sum_property(self):
         rng = np.random.default_rng(2)
         n = 400
