@@ -318,19 +318,23 @@ def binned_matrix_from_source(src: ShardedMatrixSource,
             host = staging
         else:                 # shard-tail step: second (and last) shape
             host = np.zeros((k * width, F), np.float32)
-        any_rows = False
         for i in my_devs:
             lo = i * per_dev + off
             hi = min(lo + width, n)
             seg = host[i * width:(i + 1) * width]
             got = src.read_into(seg, lo, hi) if hi > lo else 0
-            any_rows |= got > 0
             if got < width:
                 seg[got:] = 0.0            # in-file padding rows
-        if not any_rows and jax.process_count() == 1:
-            continue          # pure padding step: shard stays zero
-        buf = step(buf, jax.device_put(host, row_sh), ub_d,
-                   np.int32(off))
+        # device_put gets a PRIVATE copy of the reused staging buffer:
+        # on the CPU backend device_put zero-copy ALIASES an aligned
+        # numpy array, so refilling `staging` next iteration would race
+        # the still-asynchronous step execution (observed as ~1% of bins
+        # landing at the previous offset). The copy is chunk-sized and
+        # keeps the read/compute pipeline fully async; tail buffers are
+        # fresh allocations and need no copy.
+        chunk_dev = jax.device_put(
+            host.copy() if host is staging else host, row_sh)
+        buf = step(buf, chunk_dev, ub_d, np.int32(off))
     return buf
 
 

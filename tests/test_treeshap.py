@@ -186,10 +186,14 @@ class TestExactTreeSHAP:
             dev = shap_values_device(b, X[:300], row_block=128)
             rel = np.abs(host - dev).max() / max(np.abs(host).max(), 1e-9)
             assert rel < 1e-4, f"{obj}: device/host diverge ({rel:.2e})"
-        # env escape hatch routes predict_contrib back to the host path
-        monkeypatch.setenv("MMLSPARK_TPU_SHAP_HOST", "1")
+        # env override must actually flip the routing: on this CPU backend
+        # host is the default, so force the DEVICE engine and require its
+        # exact (f32) output — a broken/typo'd override would return the
+        # host f64 values and fail the exact-equality check
+        monkeypatch.setenv("MMLSPARK_TPU_SHAP_DEVICE", "1")
         via_env = b.predict_contrib(X[:50])
-        np.testing.assert_array_equal(via_env, shap_values(b, X[:50]))
+        np.testing.assert_array_equal(via_env,
+                                      shap_values_device(b, X[:50]))
 
     def test_categorical_sum_property(self):
         rng = np.random.default_rng(2)
