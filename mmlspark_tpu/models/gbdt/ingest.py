@@ -30,7 +30,7 @@ models.
 from __future__ import annotations
 
 import os
-from typing import List, Optional, Sequence, Tuple, Union
+from typing import List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -145,10 +145,8 @@ class ShardedMatrixSource:
     def read_into(self, out: np.ndarray, start: int, stop: int) -> int:
         """Fill ``out[:stop-start]`` with rows [start, stop); returns the
         row count. For float32 C-order shards the bytes land directly in
-        ``out`` via ``readinto`` — the steady-state ingest loop then
-        allocates NO per-chunk host memory (a fresh buffer per chunk was
-        measured to grow peak RSS ~5x the live set through allocator
-        churn at the 20M-row scale)."""
+        ``out`` via ``readinto`` — no intermediate read buffer or dtype
+        copy between the file and the caller's chunk."""
         start, stop = int(start), int(min(stop, self.n))
         rows = stop - start
         if rows <= 0:
@@ -305,7 +303,6 @@ def binned_matrix_from_source(src: ShardedMatrixSource,
                   P(meshlib.DATA_AXIS, None), P(), P()),
         out_specs=P(None, meshlib.DATA_AXIS), check_vma=False),
         donate_argnums=0)
-    staging = np.zeros((k * c, F), np.float32)       # reused host chunk
     my_proc = jax.process_index()
     my_devs = [i for i, d in enumerate(devs)
                if d.process_index == my_proc]
@@ -314,10 +311,16 @@ def binned_matrix_from_source(src: ShardedMatrixSource,
         # width never crosses the shard boundary: a clamped
         # dynamic_update_slice would silently shift the write
         width = min(c, per_dev - off)
-        if width == c:
-            host = staging
-        else:                 # shard-tail step: second (and last) shape
-            host = np.zeros((k * width, F), np.float32)
+        # FRESH host buffer every step, never mutated after device_put:
+        # the CPU backend zero-copy ALIASES an aligned numpy array, so a
+        # reused staging buffer refilled next iteration raced the
+        # still-asynchronous step execution (observed as ~1% of bins
+        # landing at the previous offset), and other backends make no
+        # public promise about when the H2D transfer reads the source.
+        # Same-size alloc/free per step recycles in the allocator — the
+        # measured RSS pathologies were mixed-size churn and per-device
+        # program pools, not this.
+        host = np.zeros((k * width, F), np.float32)
         for i in my_devs:
             lo = i * per_dev + off
             hi = min(lo + width, n)
@@ -325,16 +328,8 @@ def binned_matrix_from_source(src: ShardedMatrixSource,
             got = src.read_into(seg, lo, hi) if hi > lo else 0
             if got < width:
                 seg[got:] = 0.0            # in-file padding rows
-        # device_put gets a PRIVATE copy of the reused staging buffer:
-        # on the CPU backend device_put zero-copy ALIASES an aligned
-        # numpy array, so refilling `staging` next iteration would race
-        # the still-asynchronous step execution (observed as ~1% of bins
-        # landing at the previous offset). The copy is chunk-sized and
-        # keeps the read/compute pipeline fully async; tail buffers are
-        # fresh allocations and need no copy.
-        chunk_dev = jax.device_put(
-            host.copy() if host is staging else host, row_sh)
-        buf = step(buf, chunk_dev, ub_d, np.int32(off))
+        buf = step(buf, jax.device_put(host, row_sh), ub_d,
+                   np.int32(off))
     return buf
 
 
