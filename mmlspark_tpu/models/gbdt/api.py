@@ -53,14 +53,16 @@ def _cache_enabled() -> bool:
 
 def _cached_binned_dataset(X, y, w, *, max_bin, bin_sample_count, seed,
                            categorical_features,
-                           bin_dtype="int32") -> LightGBMDataset:
+                           bin_dtype="int32",
+                           max_bin_by_feature=None) -> LightGBMDataset:
     if not _cache_enabled():
         # skip fingerprinting entirely: hashing a 1M-row matrix per fit is
         # pure waste when the result will never be cached
         return LightGBMDataset.construct(
             _densify(X), y, w, max_bin=max_bin,
             bin_sample_count=bin_sample_count, seed=seed,
-            categorical_features=categorical_features, bin_dtype=bin_dtype)
+            categorical_features=categorical_features, bin_dtype=bin_dtype,
+            max_bin_by_feature=max_bin_by_feature)
     from ...parallel import mesh as meshlib
     from ...utils.checkpoint import data_fingerprint
 
@@ -78,13 +80,17 @@ def _cached_binned_dataset(X, y, w, *, max_bin, bin_sample_count, seed,
     # identical data must not silently reuse the wide dataset
     key = (fp, max_bin, bin_sample_count, seed,
            tuple(int(i) for i in categorical_features),
-           str(bin_dtype), meshlib.get_default_mesh())
+           str(bin_dtype),
+           None if max_bin_by_feature is None
+           else tuple(int(b) for b in max_bin_by_feature),
+           meshlib.get_default_mesh())
     ds = _BINNED_CACHE.get(key)
     if ds is None:
         ds = LightGBMDataset.construct(
             _densify(X), y, w, max_bin=max_bin,
             bin_sample_count=bin_sample_count, seed=seed,
-            categorical_features=categorical_features, bin_dtype=bin_dtype)
+            categorical_features=categorical_features, bin_dtype=bin_dtype,
+            max_bin_by_feature=max_bin_by_feature)
         _BINNED_CACHE[key] = ds
         while len(_BINNED_CACHE) > _BINNED_CACHE_MAX:
             _BINNED_CACHE.popitem(last=False)
@@ -228,6 +234,46 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
         "categoricalSlotNames", "Categorical slots by feature name; requires "
         "a featuresCol with slot names (use categoricalSlotIndexes for "
         "plain arrays)", None)
+    improvementTolerance = Param(
+        "improvementTolerance", "Early stopping: an iteration counts as "
+        "improved only when it beats the best validation metric by more "
+        "than this (reference: LightGBMParams improvementTolerance)", 0.0,
+        TypeConverters.to_float)
+    isProvideTrainingMetric = Param(
+        "isProvideTrainingMetric", "Record the training-set metric every "
+        "iteration into evalHistory['training_<metric>'] (reference: "
+        "TrainParams isProvideTrainingMetric). gbdt/goss only; forces the "
+        "per-iteration host loop instead of the fused dispatch", False,
+        TypeConverters.to_bool)
+    posBaggingFraction = Param(
+        "posBaggingFraction", "Stratified bagging: keep probability for "
+        "positive rows (binary only; set with negBaggingFraction and "
+        "baggingFreq > 0)", 1.0, TypeConverters.to_float)
+    negBaggingFraction = Param(
+        "negBaggingFraction", "Stratified bagging: keep probability for "
+        "negative rows (binary only)", 1.0, TypeConverters.to_float)
+    maxDeltaStep = Param(
+        "maxDeltaStep", "Clamp each leaf's raw output to +-this before "
+        "shrinkage (0 = off; stabilizes poisson / highly imbalanced "
+        "binary)", 0.0, TypeConverters.to_float)
+    maxBinByFeature = Param(
+        "maxBinByFeature", "Per-feature max bin counts (list as long as "
+        "the feature vector; each capped by maxBin)", None)
+    slotNames = Param(
+        "slotNames", "Feature names for the feature-vector slots — flow "
+        "into the native model string's feature_names and importances "
+        "(reference: LightGBMParams slotNames)", None)
+    driverListenPort = Param(
+        "driverListenPort", "Ignored on TPU (no driver rendezvous socket)",
+        0, TypeConverters.to_int)
+    numTasks = Param(
+        "numTasks", "Ignored on TPU: shard count comes from the device "
+        "mesh (reference capped Spark task count)", 0,
+        TypeConverters.to_int)
+    repartitionByGroupingColumn = Param(
+        "repartitionByGroupingColumn", "Ignored on TPU: the ranker pads "
+        "and shards whole groups itself, so group alignment never depends "
+        "on input partitioning", True, TypeConverters.to_bool)
 
     def _grow_config(self) -> GrowConfig:
         sel = self.get_or_default("compactSelector")
@@ -252,6 +298,7 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
             quantized_grad=self.get_or_default("useQuantizedGrad"),
             hist_subtraction=self.get_or_default("histSubtraction"),
             compact_selector=self.get_or_default("compactSelector"),
+            max_delta_step=self.get_or_default("maxDeltaStep"),
         )
 
     def _extract_arrays(self, dataset: Dataset):
@@ -320,6 +367,13 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
             drop_seed=self.get_or_default("dropSeed"),
             categorical_features=self._categorical_indexes(),
             bin_dtype=self.get_or_default("binDtype"),
+            pos_bagging_fraction=self.get_or_default("posBaggingFraction"),
+            neg_bagging_fraction=self.get_or_default("negBaggingFraction"),
+            early_stopping_tolerance=self.get_or_default(
+                "improvementTolerance"),
+            provide_training_metric=self.get_or_default(
+                "isProvideTrainingMetric"),
+            max_bin_by_feature=self.get_or_default("maxBinByFeature"),
         )
         num_iterations = self.get_or_default("numIterations")
         if (num_batches and num_batches > 1
@@ -346,7 +400,7 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
                     X[sl], y[sl], None if w is None else w[sl],
                     num_iterations=num_iterations, valid_set=valid_set,
                     init_booster=booster, **common)
-            return booster
+            return self._apply_slot_names(booster)
         if common["checkpoint_dir"] is None:
             # sweep fast path: reuse the binned device dataset across fits
             # on identical data + binning params (content-fingerprint keyed)
@@ -356,14 +410,34 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
                 bin_sample_count=common["bin_sample_count"],
                 seed=common["seed"],
                 categorical_features=common["categorical_features"],
-                bin_dtype=common["bin_dtype"])
-            return train_booster(
+                bin_dtype=common["bin_dtype"],
+                max_bin_by_feature=common["max_bin_by_feature"])
+            return self._apply_slot_names(train_booster(
                 X=X if init_booster is not None else None,
                 dataset=dset, num_iterations=num_iterations,
-                valid_set=valid_set, init_booster=init_booster, **common)
-        return train_booster(X, y, w, num_iterations=num_iterations,
-                             valid_set=valid_set, init_booster=init_booster,
-                             **common)
+                valid_set=valid_set, init_booster=init_booster, **common))
+        return self._apply_slot_names(train_booster(
+            X, y, w, num_iterations=num_iterations,
+            valid_set=valid_set, init_booster=init_booster, **common))
+
+    def _apply_slot_names(self, booster: Booster) -> Booster:
+        """Record slotNames as the model's feature names (they flow into
+        the native model string; reference: LightGBMParams slotNames)."""
+        names = self.get_or_default("slotNames")
+        if names:
+            F = booster.binner_state.get("num_features")
+            if F is not None and len(names) != F:
+                raise ValueError(
+                    f"slotNames has {len(names)} entries for {F} features")
+            names = [str(x) for x in names]
+            bad = [x for x in names if not x or any(c.isspace() for c in x)]
+            if bad:
+                # the native text format is whitespace-delimited
+                raise ValueError(
+                    f"slotNames must be non-empty and whitespace-free for "
+                    f"native-model interop; got {bad[:3]}")
+            booster.binner_state["feature_names"] = names
+        return booster
 
 
 class _LightGBMModelBase(Model, _LightGBMParams):
@@ -651,12 +725,18 @@ class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
             num_iterations=self.get_or_default("numIterations"),
             valid_set=valid_set,
             early_stopping_rounds=self.get_or_default("earlyStoppingRound"),
+            early_stopping_tolerance=self.get_or_default(
+                "improvementTolerance"),
+            provide_training_metric=self.get_or_default(
+                "isProvideTrainingMetric"),
+            max_bin_by_feature=self.get_or_default("maxBinByFeature"),
             metric_eval_period=self.get_or_default("metricEvalPeriod"),
             boost_from_average=False,
             objective_kwargs=kwargs,
             row_valid=valid,
             boosting_type=self.get_or_default("boostingType"),
         )
+        booster = self._apply_slot_names(booster)
         model = LightGBMRankerModel(booster)
         self._copy_params_to(model)
         return model

@@ -215,6 +215,7 @@ class LightGBMDataset:
                   row_valid: Optional[np.ndarray] = None,
                   bin_dtype="int32", path=None, label_path=None,
                   weight_path=None, chunk_rows: Optional[int] = None,
+                  max_bin_by_feature=None,
                   _timer: Optional[_PhaseTimer] = None) -> "LightGBMDataset":
         if path is None and (label_path is not None
                              or weight_path is not None
@@ -247,7 +248,8 @@ class LightGBMDataset:
                 bin_sample_count=bin_sample_count, seed=seed,
                 categorical_features=categorical_features, mesh=mesh,
                 bin_dtype=bin_dtype,
-                chunk_rows=262_144 if chunk_rows is None else chunk_rows)
+                chunk_rows=262_144 if chunk_rows is None else chunk_rows,
+                max_bin_by_feature=max_bin_by_feature)
         if X is None or y is None:
             raise ValueError(
                 "construct needs in-memory arrays (X, y) or file shards "
@@ -265,7 +267,8 @@ class LightGBMDataset:
                 f"{F} features")
         bd = _validate_bin_dtype(bin_dtype, max_bin)
         binner = QuantileBinner(max_bin, bin_sample_count, seed,
-                                categorical_features).fit(X)
+                                categorical_features,
+                                max_bin_by_feature).fit(X)
         tw.mark("binner_fit")
         # Binning runs ON DEVICE, producing the column-major [F, n_local]
         # layout tree growth consumes (the host searchsorted pass measured
@@ -619,7 +622,11 @@ class Booster:
                         num_features=self.binner_state["num_features"],
                         categorical_features=list(
                             self.binner_state.get("categorical_features")
-                            or [])),
+                            or []),
+                        max_bin_by_feature=self.binner_state.get(
+                            "max_bin_by_feature"),
+                        feature_names=self.binner_state.get(
+                            "feature_names")),
         )
         arrays["meta_json"] = np.frombuffer(
             json.dumps(meta).encode(), dtype=np.uint8)
@@ -708,7 +715,7 @@ class Booster:
 
 def _fused_es_scan(one_iter, state0, num_iterations: int,
                    early_stopping_rounds: int, higher_is_better: bool,
-                   track_metric: bool):
+                   track_metric: bool, tol: float = 0.0):
     """Shared on-device training-loop harness for the fused paths (plain
     gbdt with validation, dart with/without validation).
 
@@ -718,13 +725,13 @@ def _fused_es_scan(one_iter, state0, num_iterations: int,
     without metric tracking the scan runs every iteration and
     ``best_it = -1``. With it, iteration 0 runs inline (its packed length
     sizes the static buffer) and a ``lax.while_loop`` applies the same
-    stopping bookkeeping the host loops use. The 1e-12 tie epsilon is
-    written to mirror the host comparison, but on device it is applied in
-    f32 where it is below one ulp of any realistic metric value — the
-    predicate is effectively a strict compare. Equivalence with the host
-    (which compares in f64) holds because the metric itself is
-    f32-quantized: distinct f32 metric values differ by far more than
-    1e-12, so both predicates make the same decision."""
+    stopping bookkeeping the host loops use. ``tol`` is the
+    improvementTolerance: an iteration only counts as improved when it
+    beats the best metric by more than tol. The default 0.0 mirrors the
+    host's strict compare; note a device-side tol below one f32 ulp of
+    the metric value vanishes (the compare runs in f32, the host's in
+    f64) — equivalence holds because the metric itself is f32-quantized,
+    so any sub-ulp tolerance makes the same decision on both sides."""
     if not track_metric:
         def it_body(state, it):
             state, packed, _ = one_iter(it, state)
@@ -737,9 +744,9 @@ def _fused_es_scan(one_iter, state0, num_iterations: int,
 
     def track(best, best_it, rni, m, it):
         if higher_is_better:
-            improved = m > best + 1e-12
+            improved = m > best + jnp.float32(tol)
         else:
-            improved = m < best - 1e-12
+            improved = m < best - jnp.float32(tol)
         return (jnp.where(improved, m, best),
                 jnp.where(improved, it, best_it),
                 jnp.where(improved, 0, rni + 1))
@@ -819,6 +826,11 @@ def train_booster(
     checkpoint_period: int = 10,
     categorical_features=(),
     bin_dtype="int32",
+    pos_bagging_fraction: float = 1.0,
+    neg_bagging_fraction: float = 1.0,
+    early_stopping_tolerance: float = 0.0,
+    provide_training_metric: bool = False,
+    max_bin_by_feature=None,
 ) -> Booster:
     """Train a boosted ensemble, rows sharded over the mesh ``data`` axis.
 
@@ -870,12 +882,54 @@ def train_booster(
             raise ValueError(
                 f"checkpointDir is not supported with "
                 f"boostingType={boosting_type!r} (gbdt/goss only)")
-    if boosting_type == "rf" and not (bagging_fraction < 1.0
-                                      and bagging_freq > 0):
+    stratified_bagging = (pos_bagging_fraction != 1.0
+                          or neg_bagging_fraction != 1.0)
+    if boosting_type == "rf" and not (
+            (bagging_fraction < 1.0 or stratified_bagging)
+            and bagging_freq > 0):
         raise ValueError(
             "boostingType='rf' requires bagging: set baggingFraction < 1.0 "
-            "and baggingFreq > 0 (LightGBM semantics — without bagging every "
-            "random-forest tree would be identical)")
+            "(or pos/negBaggingFraction) and baggingFreq > 0 (LightGBM "
+            "semantics — without bagging every random-forest tree would be "
+            "identical)")
+    if stratified_bagging:
+        # LightGBM: pos/neg bagging fractions are a binary-only, set-together
+        # stratified alternative to bagging_fraction
+        if objective != "binary":
+            raise ValueError(
+                "posBaggingFraction/negBaggingFraction apply to the binary "
+                f"objective only (got objective={objective!r})")
+        if bagging_freq <= 0:
+            raise ValueError(
+                "posBaggingFraction/negBaggingFraction need baggingFreq > 0")
+        if not (0.0 < pos_bagging_fraction <= 1.0
+                and 0.0 < neg_bagging_fraction <= 1.0):
+            raise ValueError(
+                "pos/negBaggingFraction must be in (0, 1]; got "
+                f"{pos_bagging_fraction}/{neg_bagging_fraction}")
+        if boosting_type == "goss":
+            raise ValueError("goss does its own gradient-based sampling; "
+                             "pos/negBaggingFraction do not apply")
+        if boosting_type == "dart":
+            raise ValueError(
+                "pos/negBaggingFraction are supported for gbdt/rf; dart's "
+                "fused drop-schedule path keeps plain baggingFraction")
+        # LightGBM semantics: when the stratified fractions are set they
+        # replace bagging_fraction entirely — reject the ambiguous combo
+        # rather than silently ignoring one of them
+        if bagging_fraction < 1.0:
+            raise ValueError(
+                "set either baggingFraction or pos/negBaggingFraction, "
+                "not both (the stratified fractions replace it)")
+    if early_stopping_tolerance < 0:
+        raise ValueError(
+            f"improvementTolerance must be >= 0, got {early_stopping_tolerance}")
+    if provide_training_metric and boosting_type in ("rf", "dart"):
+        raise ValueError(
+            "isProvideTrainingMetric is supported for gbdt/goss (rf keeps "
+            "train scores at the base margin and dart rescales past trees "
+            "each iteration, so neither has a running train margin to "
+            "evaluate)")
 
     ckpt_mgr = None
     ckpt_fingerprint = None
@@ -898,6 +952,10 @@ def train_booster(
                     boost_from_average, feature_fraction,
                     bagging_fraction, bagging_freq, seed, boosting_type,
                     top_rate, other_rate,
+                    pos_bagging_fraction, neg_bagging_fraction,
+                    early_stopping_tolerance,
+                    None if max_bin_by_feature is None
+                    else tuple(int(b) for b in max_bin_by_feature),
                     sorted((objective_kwargs or {}).items()),
                     None if user_init_booster is None
                     else user_init_booster.model_string()))
@@ -933,7 +991,8 @@ def train_booster(
             _densify(X), y, weight, max_bin=max_bin,
             bin_sample_count=bin_sample_count, seed=seed,
             categorical_features=categorical_features, mesh=mesh,
-            row_valid=row_valid, bin_dtype=bin_dtype, _timer=tw)
+            row_valid=row_valid, bin_dtype=bin_dtype,
+            max_bin_by_feature=max_bin_by_feature, _timer=tw)
     mesh = dataset.mesh
     binner = dataset.binner
     max_bin = dataset.max_bin
@@ -1003,7 +1062,8 @@ def train_booster(
 
     use_goss = boosting_type == "goss"
     is_rf = boosting_type == "rf"
-    use_bagging = (not use_goss) and bagging_fraction < 1.0 and bagging_freq > 0
+    use_bagging = ((not use_goss) and bagging_freq > 0
+                   and (bagging_fraction < 1.0 or stratified_bagging))
     metric_name = eval_metric(obj, jnp.zeros((1, K)) if K > 1 else jnp.zeros(1),
                               jnp.zeros(1), jnp.ones(1), **objective_kwargs)[0]
 
@@ -1018,6 +1078,7 @@ def train_booster(
             feature_fraction=feature_fraction, use_bagging=use_bagging,
             bagging_fraction=bagging_fraction, bagging_freq=bagging_freq,
             early_stopping_rounds=early_stopping_rounds,
+            early_stopping_tolerance=float(early_stopping_tolerance),
             iteration_callback=iteration_callback,
             metric_eval_period=metric_eval_period,
             drop_rate=drop_rate, max_drop=max_drop, skip_drop=skip_drop,
@@ -1059,7 +1120,15 @@ def train_booster(
             # bag_key changes only every bagging_freq iterations (LightGBM
             # semantics: the subsample is reused for baggingFreq rounds)
             k = jax.random.fold_in(bag_key, jax.lax.axis_index("data"))
-            bag = (jax.random.uniform(k, vmask.shape) < bagging_fraction)
+            if stratified_bagging:
+                # LightGBM pos/neg_bagging_fraction: per-class keep
+                # probability (binary labels; validated at entry)
+                frac = jnp.where(yl > 0.5,
+                                 jnp.float32(pos_bagging_fraction),
+                                 jnp.float32(neg_bagging_fraction))
+            else:
+                frac = jnp.float32(bagging_fraction)
+            bag = (jax.random.uniform(k, vmask.shape) < frac)
             row_mask = vmask * bag.astype(jnp.float32)
         else:
             row_mask = vmask
@@ -1089,6 +1158,20 @@ def train_booster(
             lambda *xs: jnp.stack(xs), *trees_out)
 
         metrics = {}
+        if provide_training_metric:
+            # isProvideTrainingMetric: the train-set metric on the updated
+            # margin, combined across shards exactly like the valid metric
+            tsc = scores if K > 1 else scores[:, 0]
+            _, tnum = eval_metric(obj, tsc, yl, wl * vmask,
+                                  **objective_kwargs)
+            twsum = jax.lax.psum(jnp.sum(wl * vmask), "data")
+            tlocal = jnp.sum(wl * vmask)
+            if metric_name == "rmse":
+                metrics["train"] = jnp.sqrt(
+                    jax.lax.psum(tnum * tnum * tlocal, "data") / twsum)
+            else:
+                metrics["train"] = (jax.lax.psum(tnum * tlocal, "data")
+                                    / twsum)
         if has_valid:
             for k in range(K):
                 tr = jax.tree_util.tree_map(lambda a: a[k], trees_stacked)
@@ -1129,6 +1212,8 @@ def train_booster(
                  tuple(np.flatnonzero(is_cat_np).tolist()),
                  Xbt_d.shape, None if not has_valid else Xvb_d.shape,
                  use_bagging, bagging_fraction, bagging_freq,
+                 stratified_bagging, pos_bagging_fraction,
+                 neg_bagging_fraction, provide_training_metric,
                  feature_fraction, depth_cap,
                  boosting_type, top_rate, other_rate, mesh,
                  # rf's validation eval closes over the data-dependent base
@@ -1147,6 +1232,7 @@ def train_booster(
     all_trees: List[Tree] = []
     history: Dict[str, List[float]] = {metric_name: []}
     higher_is_better = metric_name in HIGHER_IS_BETTER
+    es_tol = float(early_stopping_tolerance)
     best_metric = -np.inf if higher_is_better else np.inf
     best_iter, rounds_no_improve = -1, 0
     if resume_state is not None:
@@ -1178,7 +1264,7 @@ def train_booster(
     # scan. One device dispatch instead of num_iterations round-trips, which
     # dominates wall time on remote-attached TPUs.
     fuse = (not has_valid and iteration_callback is None and ckpt_mgr is None
-            and iterations_done == 0)
+            and iterations_done == 0 and not provide_training_metric)
     if fuse:
         fuse_key = (cache_key, num_iterations, seed, "fused")
 
@@ -1248,10 +1334,11 @@ def train_booster(
     # MMLSPARK_TPU_DISABLE_FUSED_VALID=1 forces the host loop.
     fuse_es = (has_valid and iteration_callback is None and ckpt_mgr is None
                and iterations_done == 0 and metric_eval_period == 1
+               and not provide_training_metric
                and not os.environ.get("MMLSPARK_TPU_DISABLE_FUSED_VALID"))
     if fuse_es:
         fuse_key = (cache_key, num_iterations, seed, early_stopping_rounds,
-                    "fused_valid")
+                    es_tol, "fused_valid")
 
         def build_multi_valid():
             def multi_local(binned_l, yl, wl, vmask_l, scores_l, vbinned_l,
@@ -1270,7 +1357,7 @@ def train_booster(
 
                 return _fused_es_scan(one_iter, (scores_l, vscores_l),
                                       num_iterations, early_stopping_rounds,
-                                      higher_is_better, True)
+                                      higher_is_better, True, tol=es_tol)
 
             return jax.jit(jax.shard_map(
                 multi_local, mesh=mesh,
@@ -1321,11 +1408,16 @@ def train_booster(
         for k in range(K):
             all_trees.append(jax.tree_util.tree_map(lambda a: a[k], trees_host))
 
+        if provide_training_metric and (it % metric_eval_period == 0
+                                        or it == num_iterations - 1):
+            history.setdefault(f"training_{metric_name}", []).append(
+                float(metrics["train"]))
+
         if has_valid and (it % metric_eval_period == 0 or it == num_iterations - 1):
             m = float(metrics["valid"])
             history[metric_name].append(m)
-            improved = (m > best_metric + 1e-12 if higher_is_better
-                        else m < best_metric - 1e-12)
+            improved = (m > best_metric + es_tol if higher_is_better
+                        else m < best_metric - es_tol)
             if improved:
                 best_metric, best_iter, rounds_no_improve = m, it, 0
             else:
@@ -1381,6 +1473,7 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
                 depth_cap, metric_name, num_iterations, seed,
                 feature_fraction, use_bagging, bagging_fraction, bagging_freq,
                 early_stopping_rounds, iteration_callback, metric_eval_period,
+                early_stopping_tolerance=0.0,
                 drop_rate, max_drop, skip_drop, drop_seed,
                 binner, max_bin, is_cat_j=None) -> Booster:
     """DART boosting: Dropouts meet Multiple Additive Regression Trees.
@@ -1505,6 +1598,7 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
     all_trees: List[Tree] = []
     history: Dict[str, List[float]] = {metric_name: []}
     higher_is_better = metric_name in HIGHER_IS_BETTER
+    es_tol = float(early_stopping_tolerance)
     best_metric = -np.inf if higher_is_better else np.inf
     best_iter, rounds_no_improve = -1, 0
     base_key = jax.random.PRNGKey(seed)
@@ -1541,7 +1635,7 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
                  and not os.environ.get("MMLSPARK_TPU_DISABLE_FUSED_DART"))
     if fuse_dart:
         fuse_key = (cache_key, num_iterations, seed, early_stopping_rounds,
-                    "dart_fused")
+                    es_tol, "dart_fused")
 
         def build_dart_fused():
             def multi_local(binned_l, yl, wl, vmask_l, contribs_l,
@@ -1567,7 +1661,7 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
                 return _fused_es_scan(one_iter, (contribs_l, vcontribs_l),
                                       num_iterations, early_stopping_rounds,
                                       higher_is_better,
-                                      track_metric=has_valid)
+                                      track_metric=has_valid, tol=es_tol)
 
             return jax.jit(jax.shard_map(
                 multi_local, mesh=mesh,
@@ -1635,8 +1729,8 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
                           or it == num_iterations - 1):
             m = float(deval(vcontribs_d, jnp.asarray(scales), yv_d, wv_d))
             history[metric_name].append(m)
-            improved = (m > best_metric + 1e-12 if higher_is_better
-                        else m < best_metric - 1e-12)
+            improved = (m > best_metric + es_tol if higher_is_better
+                        else m < best_metric - es_tol)
             if improved:
                 best_metric, best_iter, rounds_no_improve = m, it, 0
             else:

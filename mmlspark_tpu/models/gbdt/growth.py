@@ -90,6 +90,10 @@ class GrowConfig(NamedTuple):
     # quantize to int8 per tree (stochastic rounding) and histograms ride
     # the 2x-rate int8 MXU path with exact int32 accumulation.
     quantized_grad: bool = False
+    # LightGBM max_delta_step: clamp each leaf's raw output (pre-shrinkage)
+    # to +-this; 0 disables. Stabilizes extreme leaf values (LightGBM
+    # recommends it for poisson / highly imbalanced binary).
+    max_delta_step: float = 0.0
     # Histogram subtraction (LightGBM's parent-minus-sibling trick, made
     # profitable on TPU by row compaction), honored by BOTH growth policies:
     # gather the rows of each sibling pair's SMALLER child — at most n//2
@@ -540,6 +544,8 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     lr = jnp.float32(cfg.learning_rate)
     raw_val = -_soft_threshold(state["ng"], cfg.lambda_l1) / (
         state["nh"] + cfg.lambda_l2 + 1e-38)
+    if cfg.max_delta_step > 0:
+        raw_val = jnp.clip(raw_val, -cfg.max_delta_step, cfg.max_delta_step)
     leaf_value = jnp.where(state["is_leaf"] & (state["nc"] > 0), raw_val * lr, 0.0)
     node_value = jnp.where(state["nc"] > 0, raw_val * lr, 0.0)
 
@@ -835,6 +841,8 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
     lr = jnp.float32(cfg.learning_rate)
     raw_val = -_soft_threshold(tree_arrays["ng"], cfg.lambda_l1) / (
         tree_arrays["nh"] + cfg.lambda_l2 + 1e-38)
+    if cfg.max_delta_step > 0:
+        raw_val = jnp.clip(raw_val, -cfg.max_delta_step, cfg.max_delta_step)
     leaf_value = jnp.where(tree_arrays["is_leaf"] & (tree_arrays["nc"] > 0),
                            raw_val * lr, 0.0)
     node_value = jnp.where(tree_arrays["nc"] > 0, raw_val * lr, 0.0)

@@ -215,7 +215,8 @@ def write_shards(arr_iter, out_dir: PathLike, prefix: str = "part") -> List[str]
 
 def fit_binner_from_source(src: ShardedMatrixSource, *, max_bin: int,
                            bin_sample_count: int, seed: int,
-                           categorical_features=()) -> QuantileBinner:
+                           categorical_features=(),
+                           max_bin_by_feature=None) -> QuantileBinner:
     """Fit the quantile binner on the same sample the in-memory path draws.
 
     ``QuantileBinner.fit(X)`` samples ``rng.choice(n, sample_count,
@@ -225,7 +226,7 @@ def fit_binner_from_source(src: ShardedMatrixSource, *, max_bin: int,
     the sample (<= bin_sample_count rows), never the dataset.
     """
     binner = QuantileBinner(max_bin, bin_sample_count, seed,
-                            categorical_features)
+                            categorical_features, max_bin_by_feature)
     n = src.n
     if n > bin_sample_count:
         rng = np.random.default_rng(seed)
@@ -365,7 +366,8 @@ def construct_from_files(path, label_path, weight_path=None, *,
                          categorical_features=(),
                          mesh: Optional[Mesh] = None,
                          bin_dtype="uint8",
-                         chunk_rows: int = 262_144):
+                         chunk_rows: int = 262_144,
+                         max_bin_by_feature=None):
     """Build a device-resident LightGBMDataset from on-disk shards.
 
     ``bin_dtype`` defaults to ``uint8`` here (unlike the in-memory path's
@@ -392,7 +394,8 @@ def construct_from_files(path, label_path, weight_path=None, *,
         else None
     binner = fit_binner_from_source(
         xsrc, max_bin=max_bin, bin_sample_count=bin_sample_count,
-        seed=seed, categorical_features=categorical_features)
+        seed=seed, categorical_features=categorical_features,
+        max_bin_by_feature=max_bin_by_feature)
     Xbt_d = binned_matrix_from_source(xsrc, binner, mesh, bin_dtype,
                                       chunk_rows)
     n = xsrc.n

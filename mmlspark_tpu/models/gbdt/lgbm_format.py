@@ -205,6 +205,10 @@ def to_lightgbm_string(booster) -> str:
     K = booster.num_class
     F = int(booster.binner_state["upper_bounds"].shape[0])
     ub = np.asarray(booster.binner_state["upper_bounds"], np.float64)
+    # slotNames flow through as the emitted feature names (reference:
+    # LightGBMParams slotNames); default to LightGBM's Column_<i>
+    fnames = booster.binner_state.get("feature_names") or [
+        f"Column_{i}" for i in range(F)]
 
     header = [
         "tree",
@@ -215,7 +219,7 @@ def to_lightgbm_string(booster) -> str:
         f"max_feature_idx={F - 1}",
         "objective=" + _objective_line(booster.objective, K,
                                        booster.objective_kwargs),
-        "feature_names=" + " ".join(f"Column_{i}" for i in range(F)),
+        "feature_names=" + " ".join(fnames),
         # bin upper bounds give a usable [min:max] range per feature
         "feature_infos=" + " ".join(
             f"[{_fmt(ub[i, 0])}:{_fmt(ub[i, -2] if ub.shape[1] > 1 else ub[i, 0])}]"
@@ -231,7 +235,7 @@ def to_lightgbm_string(booster) -> str:
                                       t, bias, 1.0,
                                       catchall_bin=mb - 1 if mb else -1))
     importances = booster.feature_importances("split")
-    imp_lines = [f"Column_{i}={int(importances[i])}"
+    imp_lines = [f"{fnames[i]}={int(importances[i])}"
                  for i in np.argsort(-importances) if importances[i] > 0]
     return ("\n".join(header) + "\n\n"
             + "\n\n\n".join(blocks) + "\n\n\n"

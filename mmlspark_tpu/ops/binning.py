@@ -59,13 +59,32 @@ class QuantileBinner:
     """
 
     def __init__(self, max_bin: int = 255, sample_count: int = 200_000,
-                 seed: int = 0, categorical_features=()):
+                 seed: int = 0, categorical_features=(),
+                 max_bin_by_feature=None):
         self.max_bin = int(max_bin)
         self.sample_count = int(sample_count)
         self.seed = seed
         self.categorical_features = tuple(int(i) for i in categorical_features)
+        # per-feature bin-count caps (LightGBM max_bin_by_feature): feature f
+        # gets min(max_bin, max_bin_by_feature[f]) bins; unused boundary
+        # slots pad with +inf so downstream shapes stay [F, max_bin-1]
+        self.max_bin_by_feature = (None if max_bin_by_feature is None
+                                   else [int(b) for b in max_bin_by_feature])
         self.upper_bounds: Optional[np.ndarray] = None  # [F, max_bin-1] f32
         self.num_features: Optional[int] = None
+
+    def _feature_bins(self, f: int) -> int:
+        if self.max_bin_by_feature is None:
+            return self.max_bin
+        if f >= len(self.max_bin_by_feature):
+            raise ValueError(
+                f"max_bin_by_feature has {len(self.max_bin_by_feature)} "
+                f"entries but feature index {f} was requested")
+        bf = self.max_bin_by_feature[f]
+        if bf < 2:
+            raise ValueError(f"max_bin_by_feature[{f}] = {bf}: every "
+                             "feature needs at least 2 bins")
+        return min(self.max_bin, bf)
 
     def fit(self, X: np.ndarray) -> "QuantileBinner":
         X = np.asarray(X, dtype=np.float32)
@@ -76,20 +95,21 @@ class QuantileBinner:
             X = X[rng.choice(n, self.sample_count, replace=False)]
         B = self.max_bin
         bounds = np.empty((F, B - 1), dtype=np.float32)
-        qs = np.linspace(0.0, 1.0, B + 1)[1:-1]  # interior quantiles
         cat = set(self.categorical_features)
         for f in range(F):
             if f in cat:
                 # identity bins for category ids (bin(c) == c, clipped)
                 bounds[f] = np.arange(B - 1, dtype=np.float32) + 0.5
                 continue
+            Bf = self._feature_bins(f)
+            qs = np.linspace(0.0, 1.0, Bf + 1)[1:-1]  # interior quantiles
             col = X[:, f]
             col = col[~np.isnan(col)]
             if col.size == 0:
                 bounds[f] = 0.0
                 continue
             uniq = np.unique(col)
-            if uniq.size <= B - 1:
+            if uniq.size <= Bf - 1:
                 # few distinct values: one bin per value; boundaries at midpoints
                 mids = (uniq[:-1] + uniq[1:]) / 2.0 if uniq.size > 1 else np.array([uniq[0]])
                 pad = np.full(B - 1 - mids.size, np.float32(np.inf))
@@ -98,7 +118,9 @@ class QuantileBinner:
                 q = np.quantile(col, qs).astype(np.float32)
                 # strictly increasing boundaries; collapse duplicates to the right
                 q = np.maximum.accumulate(q)
-                bounds[f] = q
+                bounds[f] = np.concatenate(
+                    [q, np.full(B - Bf, np.float32(np.inf))]) \
+                    if Bf < B else q
         self.upper_bounds = bounds
         return self
 
@@ -135,13 +157,15 @@ class QuantileBinner:
             "upper_bounds": self.upper_bounds,
             "num_features": self.num_features,
             "categorical_features": list(self.categorical_features),
+            "max_bin_by_feature": self.max_bin_by_feature,
         }
 
     @staticmethod
     def from_state(state: dict) -> "QuantileBinner":
         b = QuantileBinner(state["max_bin"], state["sample_count"],
                            state["seed"],
-                           state.get("categorical_features") or ())
+                           state.get("categorical_features") or (),
+                           state.get("max_bin_by_feature"))
         b.upper_bounds = state["upper_bounds"]
         b.num_features = state["num_features"]
         return b
