@@ -65,6 +65,19 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
                           "pass saves full optimizer state and training "
                           "resumes from the newest one (preemption-safe)",
                           None, TypeConverters.to_string)
+    additionalFeatures = Param(
+        "additionalFeatures", "Additional hashed feature column base names "
+        "appended to featuresCol — each column acts as a VW namespace "
+        "(reference: VowpalWabbitBase additionalFeatures)", None,
+        TypeConverters.to_list_string)
+    ignoreNamespaces = Param(
+        "ignoreNamespaces", "Drop feature columns (namespaces) whose name "
+        "starts with one of these letters (VW --ignore; here a namespace "
+        "is a features column, so the first letter of its base name is "
+        "matched)", None, TypeConverters.to_string)
+    useBarrierExecutionMode = Param(
+        "useBarrierExecutionMode", "Ignored: SPMD gang scheduling is "
+        "inherent on the mesh", False, TypeConverters.to_bool)
 
     def _parse_args(self) -> dict:
         """Map the supported subset of VW command-line args onto config."""
@@ -134,9 +147,24 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
                         self.get_or_default("passThroughArgs")))
 
     def _features(self, dataset: Dataset):
-        base = self.get_or_default("featuresCol")
-        idx = dataset.array(f"{base}_indices", np.int32)
-        val = dataset.array(f"{base}_values", np.float32)
+        bases = [self.get_or_default("featuresCol")]
+        bases += list(self.get_or_default("additionalFeatures") or [])
+        ign = self.get_or_default("ignoreNamespaces") or ""
+        kept = [b for b in bases if not (b and b[0] in ign)]
+        if not kept:
+            raise ValueError(
+                f"ignoreNamespaces={ign!r} drops every features column "
+                f"({bases}); no feature columns remain")
+        if len(kept) == 1:       # common case: no extra copy
+            idx = dataset.array(f"{kept[0]}_indices", np.int32)
+            val = dataset.array(f"{kept[0]}_values", np.float32)
+        else:
+            idx = np.concatenate(
+                [dataset.array(f"{b}_indices", np.int32) for b in kept],
+                axis=1)
+            val = np.concatenate(
+                [dataset.array(f"{b}_values", np.float32) for b in kept],
+                axis=1)
         no_const = self._effective_no_constant()
         if not no_const:
             # VW adds an implicit intercept ("constant") feature to every

@@ -39,6 +39,10 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
         True, TypeConverters.to_bool)
     outputCol = Param("outputCol", "The name of the output column", "features",
                       TypeConverters.to_string)
+    hashSeed = Param("hashSeed", "Seed of the murmur feature hashing (VW "
+                     "--hash_seed; reference: VowpalWabbitBase hashSeed). "
+                     "Train and score featurizers must agree", 0,
+                     TypeConverters.to_int)
 
     def _row_features(self, name: str, value, ns_hash: int, num_bits: int,
                       split: bool, prefix: bool) -> List[Tuple[int, float]]:
@@ -83,7 +87,8 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
         split_cols = set(self.get_or_default("stringSplitInputCols") or [])
         prefix = self.get_or_default("prefixStringsWithColumnName")
         sum_coll = self.get_or_default("sumCollisions")
-        ns_hash = hash_namespace("")  # default namespace
+        # default namespace, seeded by hashSeed (VW --hash_seed)
+        ns_hash = hash_namespace("", self.get_or_default("hashSeed"))
 
         n = len(dataset)
         per_row: List[List[Tuple[int, float]]] = [[] for _ in range(n)]

@@ -73,9 +73,10 @@ def murmur3_32(data: Union[bytes, str], seed: int = 0) -> int:
 
 
 @lru_cache(maxsize=1 << 20)
-def hash_namespace(name: str) -> int:
-    """VW namespace seed: murmur of the namespace string with seed 0."""
-    return murmur3_32(name, 0)
+def hash_namespace(name: str, seed: int = 0) -> int:
+    """VW namespace seed: murmur of the namespace string with ``seed``
+    (VW's --hash_seed, default 0 — the reference's hashSeed param)."""
+    return murmur3_32(name, seed)
 
 
 @lru_cache(maxsize=1 << 20)

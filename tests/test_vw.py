@@ -455,3 +455,69 @@ class TestConstantFeature:
         loaded.weights = w
         preds = loaded.transform(dsf).array("prediction")
         assert float(np.abs(preds).max()) < 1e5
+
+
+class TestNamespaceParams:
+    """Round-4 param-surface tail: hashSeed, additionalFeatures,
+    ignoreNamespaces (reference: VowpalWabbitBase.scala)."""
+
+    def test_hash_seed_changes_hashing_not_quality(self):
+        ds = _text_data()
+        f0 = VowpalWabbitFeaturizer(inputCols=["text"],
+                                    stringSplitInputCols=["text"])
+        f7 = VowpalWabbitFeaturizer(inputCols=["text"],
+                                    stringSplitInputCols=["text"],
+                                    hashSeed=7)
+        d0, d7 = f0.transform(ds), f7.transform(ds)
+        assert not np.array_equal(d0.array("features_indices"),
+                                  d7.array("features_indices"))
+        m = VowpalWabbitClassifier(numPasses=3).fit(d7)
+        acc = (np.asarray(m.transform(d7)["prediction"])
+               == ds.array("label")).mean()
+        assert acc > 0.95
+
+    def _two_namespace_ds(self):
+        # the signal lives ONLY in the second (additional) namespace
+        ds = _text_data()
+        noise = ["the a is"] * len(ds)
+        base = VowpalWabbitFeaturizer(
+            inputCols=["noise"], stringSplitInputCols=["noise"],
+            outputCol="features").transform(
+            ds.with_column("noise", noise))
+        both = VowpalWabbitFeaturizer(
+            inputCols=["text"], stringSplitInputCols=["text"],
+            outputCol="extra").transform(base)
+        return both
+
+    def test_additional_features_namespace(self):
+        ds = self._two_namespace_ds()
+        weak = VowpalWabbitClassifier(numPasses=3).fit(ds)
+        strong = VowpalWabbitClassifier(
+            numPasses=3, additionalFeatures=["extra"]).fit(ds)
+        y = ds.array("label")
+        acc_weak = (np.asarray(weak.transform(ds)["prediction"]) == y).mean()
+        acc_strong = (np.asarray(
+            strong.transform(ds)["prediction"]) == y).mean()
+        assert acc_strong > 0.95 > acc_weak + 0.2
+
+    def test_ignore_namespaces_drops_column(self):
+        ds = self._two_namespace_ds()
+        y = ds.array("label")
+        # 'e' drops the "extra" namespace -> back to noise-only quality
+        ignored = VowpalWabbitClassifier(
+            numPasses=3, additionalFeatures=["extra"],
+            ignoreNamespaces="e").fit(ds)
+        acc = (np.asarray(ignored.transform(ds)["prediction"]) == y).mean()
+        assert acc < 0.7
+        with pytest.raises(ValueError, match="drops every"):
+            VowpalWabbitClassifier(
+                numPasses=1, additionalFeatures=["extra"],
+                ignoreNamespaces="ef").fit(ds)
+
+    def test_barrier_param_accepted(self):
+        ds = _text_data(100)
+        ds = VowpalWabbitFeaturizer(inputCols=["text"],
+                                    stringSplitInputCols=["text"]
+                                    ).transform(ds)
+        VowpalWabbitClassifier(numPasses=1,
+                               useBarrierExecutionMode=True).fit(ds)
