@@ -259,6 +259,13 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
     maxBinByFeature = Param(
         "maxBinByFeature", "Per-feature max bin counts (list as long as "
         "the feature vector; each capped by maxBin)", None)
+    metric = Param(
+        "metric", "Validation/early-stopping metric override (reference: "
+        "LightGBMParams metric). Per objective family: binary -> "
+        "binary_logloss | binary_error | auc; multiclass -> multi_logloss "
+        "| multi_error; regression family -> rmse/l2 | mae/l1; ranker -> "
+        "ndcg. auc computes the exact weighted rank statistic on host",
+        None, TypeConverters.to_string)
     slotNames = Param(
         "slotNames", "Feature names for the feature-vector slots — flow "
         "into the native model string's feature_names and importances "
@@ -374,6 +381,7 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
             provide_training_metric=self.get_or_default(
                 "isProvideTrainingMetric"),
             max_bin_by_feature=self.get_or_default("maxBinByFeature"),
+            eval_metric_name=self.get_or_default("metric"),
         )
         num_iterations = self.get_or_default("numIterations")
         if (num_batches and num_batches > 1

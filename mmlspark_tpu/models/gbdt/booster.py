@@ -831,6 +831,7 @@ def train_booster(
     early_stopping_tolerance: float = 0.0,
     provide_training_metric: bool = False,
     max_bin_by_feature=None,
+    eval_metric_name: Optional[str] = None,
 ) -> Booster:
     """Train a boosted ensemble, rows sharded over the mesh ``data`` axis.
 
@@ -930,6 +931,37 @@ def train_booster(
             "train scores at the base margin and dart rescales past trees "
             "each iteration, so neither has a running train margin to "
             "evaluate)")
+    # metric override (LightGBM `metric` param): validated against the
+    # objective family before anything traces
+    requested_metric = (eval_metric_name or "").strip() or None
+    eval_override = requested_metric
+    auc_host = False
+    if eval_override:
+        from .objectives import SUPPORTED_EVAL_METRICS
+        fam = objective if objective in ("binary", "multiclass",
+                                         "lambdarank") else "_regression"
+        allowed = SUPPORTED_EVAL_METRICS[fam]
+        if eval_override not in allowed:
+            raise ValueError(
+                f"metric={eval_override!r} is not supported for the "
+                f"{objective!r} objective (choose from {allowed})")
+        if boosting_type == "dart":
+            raise ValueError("metric overrides are not supported with "
+                             "dart (its fused drop-schedule eval keeps the "
+                             "objective default)")
+        auc_host = eval_override == "auc"
+        if auc_host:
+            eval_override = None      # device steps keep the default metric
+            if provide_training_metric:
+                raise ValueError(
+                    "metric='auc' with isProvideTrainingMetric would "
+                    "download the full training margin every iteration; "
+                    "use the default metric for the training history")
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "metric='auc' computes the exact rank statistic on "
+                    "the host and needs the validation scores addressable "
+                    "in one process")
 
     ckpt_mgr = None
     ckpt_fingerprint = None
@@ -954,6 +986,7 @@ def train_booster(
                     top_rate, other_rate,
                     pos_bagging_fraction, neg_bagging_fraction,
                     early_stopping_tolerance,
+                    requested_metric,
                     None if max_bin_by_feature is None
                     else tuple(int(b) for b in max_bin_by_feature),
                     sorted((objective_kwargs or {}).items()),
@@ -1064,8 +1097,10 @@ def train_booster(
     is_rf = boosting_type == "rf"
     use_bagging = ((not use_goss) and bagging_freq > 0
                    and (bagging_fraction < 1.0 or stratified_bagging))
-    metric_name = eval_metric(obj, jnp.zeros((1, K)) if K > 1 else jnp.zeros(1),
-                              jnp.zeros(1), jnp.ones(1), **objective_kwargs)[0]
+    metric_name = ("auc" if auc_host else eval_metric(
+        obj, jnp.zeros((1, K)) if K > 1 else jnp.zeros(1),
+        jnp.zeros(1), jnp.ones(1), metric=eval_override,
+        **objective_kwargs)[0])
 
     if boosting_type == "dart":
         return _train_dart(
@@ -1163,7 +1198,7 @@ def train_booster(
             # margin, combined across shards exactly like the valid metric
             tsc = scores if K > 1 else scores[:, 0]
             _, tnum = eval_metric(obj, tsc, yl, wl * vmask,
-                                  **objective_kwargs)
+                                  metric=eval_override, **objective_kwargs)
             twsum = jax.lax.psum(jnp.sum(wl * vmask), "data")
             tlocal = jnp.sum(wl * vmask)
             if metric_name == "rmse":
@@ -1185,7 +1220,8 @@ def train_booster(
             else:
                 veval = vscores
             sc = veval if K > 1 else veval[:, 0]
-            _, num = eval_metric(obj, sc, vy, vw, **objective_kwargs)
+            _, num = eval_metric(obj, sc, vy, vw, metric=eval_override,
+                                 **objective_kwargs)
             # metric is a weighted mean: combine across shards
             wsum = jax.lax.psum(jnp.sum(vw), "data")
             local_wsum = jnp.sum(vw)
@@ -1214,7 +1250,7 @@ def train_booster(
                  use_bagging, bagging_fraction, bagging_freq,
                  stratified_bagging, pos_bagging_fraction,
                  neg_bagging_fraction, provide_training_metric,
-                 feature_fraction, depth_cap,
+                 eval_override, feature_fraction, depth_cap,
                  boosting_type, top_rate, other_rate, mesh,
                  # rf's validation eval closes over the data-dependent base
                  # score; it must key the cache or a sweep over same-shape
@@ -1334,7 +1370,7 @@ def train_booster(
     # MMLSPARK_TPU_DISABLE_FUSED_VALID=1 forces the host loop.
     fuse_es = (has_valid and iteration_callback is None and ckpt_mgr is None
                and iterations_done == 0 and metric_eval_period == 1
-               and not provide_training_metric
+               and not provide_training_metric and not auc_host
                and not os.environ.get("MMLSPARK_TPU_DISABLE_FUSED_VALID"))
     if fuse_es:
         fuse_key = (cache_key, num_iterations, seed, early_stopping_rounds,
@@ -1414,7 +1450,15 @@ def train_booster(
                 float(metrics["train"]))
 
         if has_valid and (it % metric_eval_period == 0 or it == num_iterations - 1):
-            m = float(metrics["valid"])
+            if auc_host:
+                # exact weighted tie-handled AUC from the downloaded
+                # validation margin (rank statistics don't psum)
+                from .objectives import auc_weighted
+                # (no rf rescale: AUC is rank-based, invariant under the
+                # strictly increasing average-so-far transform)
+                m = auc_weighted(np.asarray(vscores_d)[:nv, 0], yv, wv)
+            else:
+                m = float(metrics["valid"])
             history[metric_name].append(m)
             improved = (m > best_metric + es_tol if higher_is_better
                         else m < best_metric - es_tol)

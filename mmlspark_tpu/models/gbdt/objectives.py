@@ -263,16 +263,54 @@ def get_objective(name: str, num_class: int = 1, alpha: float = 0.9,
 
 HIGHER_IS_BETTER = {"ndcg", "auc", "map"}
 
+# metric-param override support (reference: LightGBMParams `metric`): which
+# eval metrics each objective family accepts. "auc" is host-computed (exact
+# rank statistic — not a weighted mean, so it cannot ride the psum combine);
+# everything else evaluates on device, fused early stopping included.
+SUPPORTED_EVAL_METRICS = {
+    "binary": ("binary_logloss", "binary_error", "auc"),
+    "multiclass": ("multi_logloss", "multi_error"),
+    "lambdarank": ("ndcg",),
+    "_regression": ("rmse", "l2", "mae", "l1"),
+}
+
 
 def eval_metric(objective: Objective, scores, y, w,
                 group_size: int = 0, max_position: int = 20,
-                eval_at: int = 0, **_unused) -> Tuple[str, jnp.ndarray]:
-    """Default per-objective eval metric (higher_is_better handled by caller).
+                eval_at: int = 0, metric: str = None,
+                **_unused) -> Tuple[str, jnp.ndarray]:
+    """Per-objective eval metric (higher_is_better handled by caller).
+
+    ``metric`` overrides the objective's default with another supported
+    metric of the same family (LightGBM `metric` param; validated by the
+    caller against SUPPORTED_EVAL_METRICS). Every value returned here is a
+    LOCAL weighted mean — the training step re-combines across shards by
+    weight, with the "rmse" name square/sqrt special case.
 
     ``eval_at`` (the reference's evalAt positions) truncates the NDCG metric
     independently of the lambdarank training truncation ``max_position``.
     """
     name = objective.name
+    if metric:
+        if name == "binary" and metric == "binary_error":
+            miss = ((scores > 0.0) != (y > 0.5)).astype(jnp.float32)
+            return "binary_error", jnp.sum(miss * w) / jnp.sum(w)
+        if name == "multiclass" and metric == "multi_error":
+            pred = jnp.argmax(scores, axis=-1)
+            miss = (pred != y.astype(jnp.int32)).astype(jnp.float32)
+            return "multi_error", jnp.sum(miss * w) / jnp.sum(w)
+        if name not in ("binary", "multiclass", "lambdarank"):
+            pred = objective.transform(scores)
+            if metric in ("mae", "l1"):
+                # l1 is LightGBM's alias for mae; history keys track the
+                # requested name
+                return metric, jnp.sum(jnp.abs(pred - y) * w) / jnp.sum(w)
+            if metric == "l2":
+                # LightGBM l2 is MSE (not RMSE) — plain weighted mean, so
+                # the cross-shard combine needs no special case
+                return "l2", jnp.sum((pred - y) ** 2 * w) / jnp.sum(w)
+        # remaining supported values are the family defaults (or host-side
+        # auc, which never reaches this function)
     if name == "lambdarank":
         S = int(group_size)
         if scores.shape[0] < S or scores.shape[0] % S != 0:
@@ -290,3 +328,29 @@ def eval_metric(objective: Objective, scores, y, w,
     pred = objective.transform(scores)
     se = (pred - y) ** 2
     return "rmse", jnp.sqrt(jnp.sum(se * w) / jnp.sum(w))
+
+
+def auc_weighted(scores, y, w) -> float:
+    """Exact weighted AUC with tie-averaged ranks (host numpy; LightGBM's
+    binary `auc` metric semantics). Used for metric="auc" early stopping —
+    an exact rank statistic can't ride the device weighted-mean combine."""
+    import numpy as np
+
+    scores = np.asarray(scores, np.float64)
+    pos = np.asarray(y, np.float64) > 0.5
+    w = (np.ones_like(scores) if w is None
+         else np.asarray(w, np.float64))
+    order = np.argsort(scores, kind="mergesort")
+    s, p, ww = scores[order], pos[order], w[order]
+    wpos = np.where(p, ww, 0.0)
+    wneg = np.where(p, 0.0, ww)
+    # tie groups: runs of equal score share a rank; a positive in a group
+    # is "above" all lighter negatives plus half the group's own negatives
+    starts = np.flatnonzero(np.concatenate([[True], np.diff(s) != 0]))
+    gpos = np.add.reduceat(wpos, starts)
+    gneg = np.add.reduceat(wneg, starts)
+    cneg_before = np.concatenate([[0.0], np.cumsum(gneg)[:-1]])
+    tp, tn = wpos.sum(), wneg.sum()
+    if tp <= 0 or tn <= 0:
+        return 0.5               # degenerate: single class (LightGBM: NaN)
+    return float(np.sum(gpos * (cneg_before + 0.5 * gneg)) / (tp * tn))

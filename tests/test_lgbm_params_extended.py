@@ -238,3 +238,98 @@ class TestNonCachedPathsHonorPerFeatureBins:
         finite = np.isfinite(
             np.asarray(b.binner_state["upper_bounds"])[0]).sum()
         assert finite <= 3
+
+
+class TestMetricOverride:
+    """LightGBM `metric` param (reference: LightGBMParams metric)."""
+
+    def test_binary_error_device_path(self, monkeypatch):
+        X, y = _binary()
+        vi = (np.arange(len(y)) % 4 == 0)
+        kw = dict(numIterations=20, numLeaves=15, maxBin=63,
+                  earlyStoppingRound=4, metric="binary_error",
+                  validationIndicatorCol="isVal")
+        m = LightGBMClassifier(**kw).fit(_ds(X, y, isVal=vi))
+        hist = m.booster.eval_history["binary_error"]
+        assert 0 <= min(hist) and max(hist) <= 1
+        assert min(hist) < 0.2            # the signal is learnable
+        # fused-vs-host equivalence holds under the override too
+        monkeypatch.setenv("MMLSPARK_TPU_DISABLE_FUSED_VALID", "1")
+        host = LightGBMClassifier(**kw).fit(_ds(X, y, isVal=vi))
+        assert host.booster.num_iterations == m.booster.num_iterations
+        np.testing.assert_allclose(host.booster.eval_history["binary_error"],
+                                   hist, rtol=1e-6)
+
+    def test_auc_host_early_stopping(self):
+        X, y = _binary()
+        vi = (np.arange(len(y)) % 4 == 0)
+        m = LightGBMClassifier(numIterations=15, numLeaves=15, maxBin=63,
+                               earlyStoppingRound=5, metric="auc",
+                               validationIndicatorCol="isVal").fit(
+            _ds(X, y, isVal=vi))
+        hist = m.booster.eval_history["auc"]
+        assert len(hist) >= 1 and max(hist) > 0.9
+        assert all(0.0 <= v <= 1.0 for v in hist)
+
+    def test_auc_matches_sklearn(self):
+        from sklearn.metrics import roc_auc_score
+
+        from mmlspark_tpu.models.gbdt.objectives import auc_weighted
+
+        rng = np.random.default_rng(0)
+        s = np.round(rng.normal(size=500), 1)     # rounding forces ties
+        y = (s + rng.normal(scale=1.0, size=500) > 0).astype(float)
+        w = rng.random(500) + 0.1
+        ours = auc_weighted(s, y, w)
+        ref = roc_auc_score(y, s, sample_weight=w)
+        assert abs(ours - ref) < 1e-10
+
+    def test_mae_regression(self):
+        from mmlspark_tpu.models.gbdt.api import LightGBMRegressor
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(2000, 5)).astype(np.float32)
+        y = (2 * X[:, 0] + rng.normal(scale=0.1, size=2000)).astype(
+            np.float64)
+        vi = (np.arange(2000) % 4 == 0)
+        m = LightGBMRegressor(numIterations=15, numLeaves=15, maxBin=63,
+                              metric="mae",
+                              validationIndicatorCol="isVal").fit(
+            _ds(X, y, isVal=vi))
+        hist = m.booster.eval_history["mae"]
+        assert hist[-1] < hist[0]
+
+    def test_invalid_combos_rejected(self):
+        X, y = _binary(300)
+        with pytest.raises(ValueError, match="not supported"):
+            train_booster(X, y, objective="binary", num_iterations=2,
+                          eval_metric_name="ndcg")
+        with pytest.raises(ValueError, match="not supported"):
+            train_booster(X, y, objective="regression", num_iterations=2,
+                          eval_metric_name="auc")
+        with pytest.raises(ValueError, match="dart"):
+            train_booster(X, y, objective="binary", num_iterations=2,
+                          boosting_type="dart",
+                          eval_metric_name="binary_error")
+
+    def test_l2_is_mse_and_l1_alias(self):
+        from mmlspark_tpu.models.gbdt.api import LightGBMRegressor
+
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(1500, 4)).astype(np.float32)
+        y = (X[:, 0] + rng.normal(scale=0.1, size=1500)).astype(np.float64)
+        vi = (np.arange(1500) % 4 == 0)
+        l2 = LightGBMRegressor(numIterations=8, maxBin=63, metric="l2",
+                               validationIndicatorCol="isVal").fit(
+            _ds(X, y, isVal=vi))
+        rmse = LightGBMRegressor(numIterations=8, maxBin=63,
+                                 validationIndicatorCol="isVal").fit(
+            _ds(X, y, isVal=vi))
+        h2 = l2.booster.eval_history["l2"]
+        hr = rmse.booster.eval_history["rmse"]
+        # LightGBM l2 is MSE: the square of the rmse curve
+        np.testing.assert_allclose(h2, np.square(hr), rtol=1e-5)
+        l1 = LightGBMRegressor(numIterations=4, maxBin=63, metric="l1",
+                               validationIndicatorCol="isVal").fit(
+            _ds(X, y, isVal=vi))
+        assert "l1" in l1.booster.eval_history
