@@ -40,6 +40,23 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
                        TypeConverters.to_string)
     miniBatchSize = Param("miniBatchSize", "rows per device batch", 64,
                           TypeConverters.to_int)
+    feedDict = Param(
+        "feedDict", "Map of model input name -> dataset column (reference: "
+        "CNTKModel feedDict). Multiple entries feed a multi-input apply_fn "
+        "as a dict of batches; a single entry is an inputCol alias", None,
+        is_complex=True)
+    fetchDict = Param(
+        "fetchDict", "Map of output column -> capture node (reference: "
+        "CNTKModel fetchDict). One forward pass captures every requested "
+        "node and writes each to its column", None, is_complex=True)
+    convertOutputToDenseVector = Param(
+        "convertOutputToDenseVector", "Accepted for reference parity; "
+        "outputs here are always dense ndarrays", True,
+        TypeConverters.to_bool)
+    batchInput = Param(
+        "batchInput", "Accepted for reference parity; scoring always "
+        "micro-batches to the static compiled shape", True,
+        TypeConverters.to_bool)
 
     def __init__(self, params: Any = None, apply_fn: Callable = None,
                  apply_spec: Optional[Dict[str, Any]] = None, **kwargs):
@@ -84,12 +101,19 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
         return c
 
     # -- compiled forward ---------------------------------------------------
-    def _forward(self, node: Optional[str]) -> Callable:
+    def _forward(self, node) -> Callable:
+        """Compiled forward for one capture spec: ``None`` (final output),
+        a node name, or a TUPLE of node names (fetchDict — one pass
+        captures all of them and returns the dict)."""
         if node not in self._compiled:
             import jax
 
             if node is None:
                 fn = lambda p, x: self.apply_fn(p, x)  # noqa: E731
+            elif isinstance(node, tuple):
+                def fn(p, x, _nodes=node):
+                    _, acts = self.apply_fn(p, x, capture=list(_nodes))
+                    return {k: acts[k] for k in _nodes}
             else:
                 def fn(p, x):
                     _, acts = self.apply_fn(p, x, capture=[node])
@@ -106,30 +130,72 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
             self._compiled[node] = jfn
         return self._compiled[node]
 
+    @staticmethod
+    def _column_matrix(dataset: Dataset, col: str) -> np.ndarray:
+        data = dataset[col]
+        return data if isinstance(data, np.ndarray) else np.stack(
+            [np.asarray(v, np.float32) for v in data])
+
     def transform(self, dataset: Dataset) -> Dataset:
-        in_col = self.get_or_default("inputCol")
         out_col = self.get_or_default("outputCol") or "output"
         node = self.get_or_default("outputNode")
         bs = int(self.get_or_default("miniBatchSize"))
+        feed = self.get_or_default("feedDict")
+        fetch = self.get_or_default("fetchDict")
+        if fetch:
+            if node is not None:
+                raise ValueError(
+                    "set either outputNode or fetchDict, not both (fetchDict "
+                    "routes every capture to its own column)")
+            # fetchDict: one pass captures every node; column order fixed
+            out_cols = sorted(fetch)
+            node = tuple(fetch[c] for c in out_cols)
+        if feed:
+            # Dataset enforces uniform column lengths at construction, so
+            # the feed batches are aligned by invariant
+            xs = {name: self._column_matrix(dataset, c)
+                  for name, c in feed.items()}
+            if len(xs) == 1:
+                xs = next(iter(xs.values()))   # plain single-input apply
+        else:
+            xs = self._column_matrix(dataset,
+                                     self.get_or_default("inputCol"))
         fwd = self._forward(node)
 
-        col = dataset[in_col]
-        x = col if isinstance(col, np.ndarray) else np.stack(
-            [np.asarray(v, np.float32) for v in col])
-        n = x.shape[0]
+        multi_in = isinstance(xs, dict)
+        n = (next(iter(xs.values())) if multi_in else xs).shape[0]
+
+        def slice_batch(start):
+            def one(a):
+                b = a[start:start + bs]
+                real = b.shape[0]
+                if real < bs:
+                    # static shapes: pad the tail batch, drop padding after
+                    b = np.concatenate(
+                        [b, np.repeat(b[-1:], bs - real, axis=0)], axis=0)
+                return _pad_to_mesh(b)[0], real
+            if multi_in:
+                pairs = {k: one(a) for k, a in xs.items()}
+                return ({k: v[0] for k, v in pairs.items()},
+                        next(iter(pairs.values()))[1])
+            return one(xs)
+
         outs = []
         from ...utils.profiling import annotate
         with annotate(f"dnn_score:{type(self).__name__}"):
             for start in range(0, n, bs):
-                batch = x[start:start + bs]
-                real = batch.shape[0]
-                if real < bs:
-                    # static shapes: pad the tail batch, drop padding after
-                    pad = np.repeat(batch[-1:], bs - real, axis=0)
-                    batch = np.concatenate([batch, pad], axis=0)
-                batch, _ = _pad_to_mesh(batch)
-                out = np.asarray(fwd(self.params, batch))
-                outs.append(out[:real])
+                batch, real = slice_batch(start)
+                out = fwd(self.params, batch)
+                if isinstance(node, tuple):
+                    outs.append({k: np.asarray(v)[:real]
+                                 for k, v in out.items()})
+                else:
+                    outs.append(np.asarray(out)[:real])
+        if isinstance(node, tuple):
+            cols = {c: np.concatenate([o[nd] for o in outs], axis=0)
+                    if outs else np.zeros((0,))
+                    for c, nd in zip(out_cols, node)}
+            return dataset.with_columns(cols)
         result = np.concatenate(outs, axis=0) if outs else np.zeros((0,))
         return dataset.with_column(out_col, result)
 

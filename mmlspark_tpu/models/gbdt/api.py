@@ -738,6 +738,7 @@ class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
             provide_training_metric=self.get_or_default(
                 "isProvideTrainingMetric"),
             max_bin_by_feature=self.get_or_default("maxBinByFeature"),
+            eval_metric_name=self.get_or_default("metric"),
             metric_eval_period=self.get_or_default("metricEvalPeriod"),
             boost_from_average=False,
             objective_kwargs=kwargs,

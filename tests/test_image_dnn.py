@@ -286,3 +286,55 @@ class TestTrainedFixture:
         schema.sha256 = "0" * 64   # simulates fixture/catalog mismatch
         with pytest.raises(IOError, match="hash mismatch"):
             d.download_model(schema)
+
+
+def test_feed_fetch_dicts(tiny_cnn):
+    """CNTKModel feedDict/fetchDict parity: one pass, many outputs;
+    named inputs feed multi-input models."""
+    params, cfg, apply_fn = tiny_cnn
+    x = np.random.default_rng(3).normal(size=(6, 16, 16, 3)).astype(
+        np.float32)
+    ds = Dataset({"img": x})
+    # fetchDict: logits + pool from ONE forward pass into two columns
+    m = DNNModel(params, apply_fn).set(
+        feedDict={"input": "img"},
+        fetchDict={"scores": "logits", "feats": "pool"},
+        miniBatchSize=4)
+    out = m.transform(ds)
+    assert out["scores"].shape == (6, 5)
+    assert out["feats"].shape == (6, feature_dim(cfg))
+    ref_logits, ref_acts = apply_fn(params, x, ["logits", "pool"])
+    np.testing.assert_allclose(out["scores"], np.asarray(ref_acts["logits"]),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(out["feats"], np.asarray(ref_acts["pool"]),
+                               rtol=2e-4, atol=2e-5)
+
+    # multi-input feedDict with a custom two-input apply
+    def two_input_apply(p, xd, capture=()):
+        s = xd["a"] * 2.0 + xd["b"]
+        acts = {"sum": s.sum(axis=1)}
+        return s, acts
+
+    a = np.arange(12, dtype=np.float32).reshape(6, 2)
+    b = np.ones((6, 2), np.float32)
+    ds2 = Dataset({"ca": a, "cb": b})
+    m2 = DNNModel(None, two_input_apply).set(
+        feedDict={"a": "ca", "b": "cb"},
+        fetchDict={"s": "sum"}, miniBatchSize=4)
+    out2 = m2.transform(ds2)
+    np.testing.assert_allclose(out2["s"], (a * 2 + b).sum(axis=1),
+                               rtol=1e-6)
+
+
+def test_feed_fetch_validation(tiny_cnn):
+    params, cfg, apply_fn = tiny_cnn
+    x = np.zeros((4, 16, 16, 3), np.float32)
+    with pytest.raises(ValueError, match="not both"):
+        DNNModel(params, apply_fn).set(
+            outputNode="pool", fetchDict={"s": "logits"},
+            miniBatchSize=4).transform(Dataset({"img": x}))
+    # feed columns can never disagree on length: the Dataset itself
+    # rejects ragged columns at construction
+    with pytest.raises(ValueError, match="length"):
+        Dataset({"ca": np.zeros((4, 2), np.float32),
+                 "cb": np.zeros((3, 2), np.float32)})

@@ -333,3 +333,19 @@ class TestMetricOverride:
                                validationIndicatorCol="isVal").fit(
             _ds(X, y, isVal=vi))
         assert "l1" in l1.booster.eval_history
+
+    def test_ranker_validates_metric(self):
+        from mmlspark_tpu.models.gbdt.api import LightGBMRanker
+
+        rng = np.random.default_rng(0)
+        n = 400
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        y = rng.integers(0, 3, n).astype(np.float64)
+        g = np.repeat(np.arange(n // 8), 8).astype(np.int64)
+        ds = Dataset({"features": X, "label": y, "group": g})
+        with pytest.raises(ValueError, match="not supported"):
+            LightGBMRanker(numIterations=2, groupCol="group",
+                           metric="auc").fit(ds)
+        m = LightGBMRanker(numIterations=3, groupCol="group",
+                           metric="ndcg").fit(ds)
+        assert m.booster.num_trees == 3
