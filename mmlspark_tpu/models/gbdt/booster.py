@@ -952,11 +952,6 @@ def train_booster(
         auc_host = eval_override == "auc"
         if auc_host:
             eval_override = None      # device steps keep the default metric
-            if provide_training_metric:
-                raise ValueError(
-                    "metric='auc' with isProvideTrainingMetric would "
-                    "download the full training margin every iteration; "
-                    "use the default metric for the training history")
             if jax.process_count() > 1:
                 raise ValueError(
                     "metric='auc' computes the exact rank statistic on "
@@ -1097,10 +1092,13 @@ def train_booster(
     is_rf = boosting_type == "rf"
     use_bagging = ((not use_goss) and bagging_freq > 0
                    and (bagging_fraction < 1.0 or stratified_bagging))
-    metric_name = ("auc" if auc_host else eval_metric(
+    # device-side metric name (what the step computes); the published
+    # early-stopping metric name diverges only for host-computed auc
+    device_metric_name = eval_metric(
         obj, jnp.zeros((1, K)) if K > 1 else jnp.zeros(1),
         jnp.zeros(1), jnp.ones(1), metric=eval_override,
-        **objective_kwargs)[0])
+        **objective_kwargs)[0]
+    metric_name = "auc" if auc_host else device_metric_name
 
     if boosting_type == "dart":
         return _train_dart(
@@ -1201,7 +1199,7 @@ def train_booster(
                                   metric=eval_override, **objective_kwargs)
             twsum = jax.lax.psum(jnp.sum(wl * vmask), "data")
             tlocal = jnp.sum(wl * vmask)
-            if metric_name == "rmse":
+            if device_metric_name == "rmse":
                 metrics["train"] = jnp.sqrt(
                     jax.lax.psum(tnum * tnum * tlocal, "data") / twsum)
             else:
@@ -1222,10 +1220,13 @@ def train_booster(
             sc = veval if K > 1 else veval[:, 0]
             _, num = eval_metric(obj, sc, vy, vw, metric=eval_override,
                                  **objective_kwargs)
-            # metric is a weighted mean: combine across shards
+            # metric is a weighted mean: combine across shards. The combine
+            # rule keys off the DEVICE-computed metric name — with a
+            # host-computed early-stopping metric (auc) the step still
+            # evaluates the objective default here
             wsum = jax.lax.psum(jnp.sum(vw), "data")
             local_wsum = jnp.sum(vw)
-            if metric_name == "rmse":
+            if device_metric_name == "rmse":
                 local = num * num * local_wsum
                 metrics["valid"] = jnp.sqrt(jax.lax.psum(local, "data") / wsum)
             else:
@@ -1446,7 +1447,10 @@ def train_booster(
 
         if provide_training_metric and (it % metric_eval_period == 0
                                         or it == num_iterations - 1):
-            history.setdefault(f"training_{metric_name}", []).append(
+            # the train history records what the device step computes —
+            # with metric='auc' that is the objective default, so key by
+            # the device metric name, not the early-stopping one
+            history.setdefault(f"training_{device_metric_name}", []).append(
                 float(metrics["train"]))
 
         if has_valid and (it % metric_eval_period == 0 or it == num_iterations - 1):
