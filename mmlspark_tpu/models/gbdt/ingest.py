@@ -213,6 +213,130 @@ def write_shards(arr_iter, out_dir: PathLike, prefix: str = "part") -> List[str]
     return paths
 
 
+def csv_to_shards(csv_path: PathLike, out_dir: PathLike, *,
+                  label_col: int, num_cols: int = None,
+                  weight_col: int = None, shard_rows: int = 1_000_000,
+                  skip_header: bool = None,
+                  read_bytes: int = 64 << 20):
+    """Stream a numeric CSV into .npy feature/label(/weight) shards.
+
+    The bridge from interchange data to the out-of-core path: the file is
+    read in bounded byte chunks (cut at line boundaries), parsed by the
+    native C++ CSV reader (``native.csv_read_floats``; bad fields -> NaN,
+    pure-Python fallback), split into feature vs label/weight columns, and
+    written as numbered shards of exactly ``shard_rows`` rows (the last
+    one smaller) — peak host memory is roughly one read chunk plus one
+    shard. Stale ``part-*.npy`` files in the target directories are
+    removed first, so re-runs never mix old shards into the dataset.
+    Returns ``(x_dir, y_dir, w_dir_or_None)`` ready for
+    ``LightGBMDataset.construct(path=..., label_path=...)``.
+
+    ``skip_header=None`` auto-detects: a first line that does not parse as
+    numbers is dropped. Reference equivalent: Spark's CSV reader feeding
+    partitioned ingestion (the reference gets this from the platform).
+    """
+    from ...native import csv_read_floats
+
+    out_dir = os.fspath(out_dir)
+    xdir = os.path.join(out_dir, "x")
+    ydir = os.path.join(out_dir, "y")
+    wdir = os.path.join(out_dir, "w") if weight_col is not None else None
+
+    with open(csv_path, "rb") as f:
+        first = f.readline()
+        if num_cols is None:
+            num_cols = first.count(b",") + 1
+        if skip_header is None:
+            # the CSV parser maps non-numeric fields to NaN rather than
+            # raising, so headers are detected by inspection: any field
+            # that is non-empty and non-numeric marks a header line
+            def _numeric(p: str) -> bool:
+                p = p.strip()
+                if not p:
+                    return True        # empty field = missing value
+                try:
+                    float(p)
+                    return True
+                except ValueError:
+                    return False
+
+            parts = first.decode("utf-8", "replace").strip().split(",")
+            skip_header = (len(parts) != num_cols
+                           or not all(_numeric(p) for p in parts))
+        if not skip_header:
+            f.seek(0)
+
+        drop = [label_col] + ([weight_col] if weight_col is not None
+                              else [])
+        bad = [c for c in drop if not (0 <= c < num_cols)]
+        if bad:
+            raise ValueError(f"column index {bad} out of range for "
+                             f"{num_cols} CSV columns")
+        feat_cols = [c for c in range(num_cols) if c not in drop]
+
+        for d in (xdir, ydir, wdir):
+            if d:
+                os.makedirs(d, exist_ok=True)
+                for stale in os.listdir(d):
+                    if stale.startswith("part-") and stale.endswith(".npy"):
+                        os.unlink(os.path.join(d, stale))
+
+        shard = 0
+        pend: list = []              # parsed blocks awaiting shard cuts
+        pend_rows = 0
+        carry = b""
+
+        def write_shard(block):
+            nonlocal shard
+            np.save(os.path.join(xdir, f"part-{shard:05d}.npy"),
+                    np.ascontiguousarray(block[:, feat_cols]))
+            np.save(os.path.join(ydir, f"part-{shard:05d}.npy"),
+                    np.ascontiguousarray(block[:, label_col]))
+            if wdir:
+                np.save(os.path.join(wdir, f"part-{shard:05d}.npy"),
+                        np.ascontiguousarray(block[:, weight_col]))
+            shard += 1
+
+        def drain(final=False):
+            # emit exact shard_rows slices; keep the remainder pending
+            nonlocal pend, pend_rows
+            if not pend or (pend_rows < shard_rows and not final):
+                return
+            block = pend[0] if len(pend) == 1 else np.concatenate(pend)
+            off = 0
+            while block.shape[0] - off >= shard_rows:
+                write_shard(block[off:off + shard_rows])
+                off += shard_rows
+            if final and off < block.shape[0]:
+                write_shard(block[off:])
+                off = block.shape[0]
+            pend = [block[off:]] if off < block.shape[0] else []
+            pend_rows = block.shape[0] - off
+
+        while True:
+            chunk = f.read(read_bytes)
+            if not chunk:
+                break
+            chunk = carry + chunk
+            cut = chunk.rfind(b"\n")
+            if cut < 0:
+                carry = chunk
+                continue
+            carry, text = chunk[cut + 1:], chunk[:cut + 1]
+            parsed = csv_read_floats(text, num_cols)
+            pend.append(parsed)
+            pend_rows += parsed.shape[0]
+            drain()
+        if carry.strip():
+            parsed = csv_read_floats(carry, num_cols)
+            pend.append(parsed)
+            pend_rows += parsed.shape[0]
+        drain(final=True)
+    if shard == 0:
+        raise ValueError(f"{os.fspath(csv_path)}: no data rows parsed")
+    return xdir, ydir, wdir
+
+
 def fit_binner_from_source(src: ShardedMatrixSource, *, max_bin: int,
                            bin_sample_count: int, seed: int,
                            categorical_features=(),

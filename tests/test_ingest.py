@@ -210,3 +210,70 @@ print(json.dumps({{"grew": after - before}}))
         assert grew < 0.7 * raw_bytes, (
             f"peak RSS grew {grew / 1e6:.0f} MB on "
             f"{raw_bytes / 1e6:.0f} MB raw — raw matrix materialized?")
+
+
+class TestCsvToShards:
+    def test_csv_roundtrip_matches_in_memory(self, tmp_path):
+        from mmlspark_tpu.models.gbdt.ingest import csv_to_shards
+
+        rng = np.random.default_rng(4)
+        n, F = 5000, 5
+        X = np.round(rng.normal(size=(n, F)), 4).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        w = np.round(rng.random(n) + 0.5, 3).astype(np.float32)
+        lines = ["f0,f1,f2,label,f3,f4,weight"]
+        for i in range(n):
+            vals = [f"{X[i,0]}", f"{X[i,1]}", f"{X[i,2]}", f"{y[i]:.0f}",
+                    f"{X[i,3]}", f"{X[i,4]}", f"{w[i]}"]
+            if i == 17:
+                vals[1] = ""               # empty field -> NaN
+            lines.append(",".join(vals))
+        p = tmp_path / "data.csv"
+        p.write_text("\n".join(lines) + "\n")
+
+        xdir, ydir, wdir = csv_to_shards(
+            p, tmp_path / "shards", label_col=3, weight_col=6,
+            shard_rows=1200, read_bytes=8192)
+        ds = LightGBMDataset.construct(path=xdir, label_path=ydir,
+                                       weight_path=wdir, max_bin=63)
+        assert ds.n == n
+        Xm = X.copy()
+        Xm[17, 1] = np.nan
+        ds_mem = LightGBMDataset.construct(Xm, y, w, max_bin=63,
+                                           bin_dtype="uint8")
+        np.testing.assert_array_equal(np.asarray(ds.Xbt_d)[:, :n],
+                                      np.asarray(ds_mem.Xbt_d)[:, :n])
+        np.testing.assert_array_equal(np.asarray(ds.y_d)[:n], y)
+        np.testing.assert_array_equal(np.asarray(ds.w_d)[:n], w)
+
+    def test_headerless_and_errors(self, tmp_path):
+        from mmlspark_tpu.models.gbdt.ingest import csv_to_shards
+
+        p = tmp_path / "plain.csv"
+        p.write_text("1.0,2.0,0\n3.0,4.0,1\n")
+        xdir, ydir, wdir = csv_to_shards(p, tmp_path / "s", label_col=2)
+        src = ShardedMatrixSource(xdir)
+        assert src.n == 2 and src.num_features == 2 and wdir is None
+        with pytest.raises(ValueError, match="out of range"):
+            csv_to_shards(p, tmp_path / "s2", label_col=5)
+        empty = tmp_path / "empty.csv"
+        empty.write_text("a,b,c\n")
+        with pytest.raises(ValueError, match="no data rows"):
+            csv_to_shards(empty, tmp_path / "s3", label_col=0)
+
+    def test_rerun_clears_stale_shards_and_exact_shard_rows(self, tmp_path):
+        from mmlspark_tpu.models.gbdt.ingest import csv_to_shards
+
+        big = tmp_path / "big.csv"
+        big.write_text("\n".join(f"{i}.0,{i%2}" for i in range(5000)) + "\n")
+        xdir, ydir, _ = csv_to_shards(big, tmp_path / "o", label_col=1,
+                                      shard_rows=1000, read_bytes=4096)
+        import os
+        shard_sizes = [np.load(os.path.join(xdir, f)).shape[0]
+                       for f in sorted(os.listdir(xdir))]
+        assert shard_sizes == [1000] * 5        # exact shard_rows honored
+        small = tmp_path / "small.csv"
+        small.write_text("1.0,0\n2.0,1\n3.0,0\n")
+        csv_to_shards(small, tmp_path / "o", label_col=1, shard_rows=1000)
+        src = ShardedMatrixSource(xdir)
+        assert src.n == 3                       # no stale shards mixed in
