@@ -174,8 +174,18 @@ def main() -> None:
         tpu_proc.wait(timeout=remaining)
     except subprocess.TimeoutExpired:
         tpu_proc.kill()
-        print("[bench] TPU leg timed out; CPU fallback line stands",
-              file=sys.stderr)
+        tpu_out.flush()
+        partial = _last_json_line(tpu_out.name)
+        if partial is not None:
+            # the leg publishes a primary-only line as soon as the headline
+            # measurement lands — a timeout mid-secondaries still yields a
+            # real TPU number
+            _emit(partial)
+            print("[bench] TPU leg timed out after its primary line; "
+                  "published the partial", file=sys.stderr)
+        else:
+            print("[bench] TPU leg timed out; CPU fallback line stands",
+                  file=sys.stderr)
         return
     tpu_out.flush()
     line = _last_json_line(tpu_out.name)
@@ -243,6 +253,23 @@ def _run_leg(on_tpu: bool) -> None:
                                 **common)
         dt = min(dt, time.perf_counter() - t0)
     trees_per_sec = bench_iters / dt
+
+    # ONE primary dict feeds both the immediate partial line and the full
+    # line below — the two must never diverge on metric name or anchor.
+    primary = {
+        "metric": ("gbdt_trees_per_sec_1M_rows_28f" if on_tpu else
+                   "gbdt_trees_per_sec_50k_rows_28f_CPU_FALLBACK"),
+        "value": round(trees_per_sec, 3), "unit": "trees/sec",
+        "vs_baseline": round(trees_per_sec / BASELINE_TREES_PER_SEC, 3),
+        "platform": "tpu" if on_tpu else "cpu-fallback",
+    }
+    # Publish the primary-only line IMMEDIATELY: if this leg is killed
+    # while a secondary compiles (cold cache on a slow box — the shape of
+    # two lost rounds), the real headline number still stands. The full
+    # line printed at the end supersedes it (last line wins).
+    print(json.dumps(dict(primary, partial="primary only; superseded by "
+                          "the full line when all secondaries finish")),
+          flush=True)
 
     # secondary GBDT configs (fewer iterations: they share the warm compile
     # cache and only need a rate, not a long soak):
@@ -315,13 +342,9 @@ def _run_leg(on_tpu: bool) -> None:
     else:
         n_acc = min(len(pred), 100_000)
         acc = ((pred[:n_acc] > 0.5) == y[:n_acc]).mean()
-    metric = "gbdt_trees_per_sec_1M_rows_28f" if on_tpu else \
-        "gbdt_trees_per_sec_50k_rows_28f_CPU_FALLBACK"
     out = {
-        "metric": metric,
-        "value": round(trees_per_sec, 3),
-        "unit": "trees/sec",
-        "vs_baseline": round(trees_per_sec / BASELINE_TREES_PER_SEC, 3),
+        **primary,                 # same metric/value/anchor as the
+                                   # partial line this supersedes
         "train_accuracy": round(float(acc), 4),
         "bench_iterations": bench_iters,
         "growth_policy": "depthwise",

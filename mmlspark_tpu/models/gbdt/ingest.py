@@ -244,6 +244,13 @@ def csv_to_shards(csv_path: PathLike, out_dir: PathLike, *,
 
     with open(csv_path, "rb") as f:
         first = f.readline()
+        if first.startswith(b"\xef\xbb\xbf"):
+            # Excel-style 'CSV UTF-8' BOM would make the first data field
+            # non-numeric (and the header auto-detect drop a data row)
+            first = first[3:]
+            bom = 3
+        else:
+            bom = 0
         if num_cols is None:
             num_cols = first.count(b",") + 1
         if skip_header is None:
@@ -264,7 +271,7 @@ def csv_to_shards(csv_path: PathLike, out_dir: PathLike, *,
             skip_header = (len(parts) != num_cols
                            or not all(_numeric(p) for p in parts))
         if not skip_header:
-            f.seek(0)
+            f.seek(bom)
 
         drop = [label_col] + ([weight_col] if weight_col is not None
                               else [])
@@ -274,12 +281,16 @@ def csv_to_shards(csv_path: PathLike, out_dir: PathLike, *,
                              f"{num_cols} CSV columns")
         feat_cols = [c for c in range(num_cols) if c not in drop]
 
-        for d in (xdir, ydir, wdir):
-            if d:
-                os.makedirs(d, exist_ok=True)
+        # always clear the w/ layout slot too: a previous weighted run's
+        # shards must not survive next to this run's features
+        for d in (xdir, ydir, wdir or os.path.join(out_dir, "w")):
+            if os.path.isdir(d):
                 for stale in os.listdir(d):
                     if stale.startswith("part-") and stale.endswith(".npy"):
                         os.unlink(os.path.join(d, stale))
+        for d in (xdir, ydir, wdir):
+            if d:
+                os.makedirs(d, exist_ok=True)
 
         shard = 0
         pend: list = []              # parsed blocks awaiting shard cuts

@@ -222,6 +222,10 @@ def csv_read_floats(text: str, ncols: int,
             rows.append([parse(p) for p in parts])
             if len(rows) >= max_rows:
                 break
+        if not rows:
+            # keep the native path's [0, ncols] shape so callers can
+            # concatenate empty and non-empty parses
+            return np.zeros((0, ncols), dtype=np.float32)
         return np.asarray(rows, dtype=np.float32)
     out = np.empty((max_rows, ncols), dtype=np.float32)
     n = lib.mm_csv_read_floats(

@@ -277,3 +277,26 @@ class TestCsvToShards:
         csv_to_shards(small, tmp_path / "o", label_col=1, shard_rows=1000)
         src = ShardedMatrixSource(xdir)
         assert src.n == 3                       # no stale shards mixed in
+
+    def test_bom_and_blank_lines(self, tmp_path):
+        from mmlspark_tpu.models.gbdt.ingest import csv_to_shards
+
+        p = tmp_path / "bom.csv"
+        p.write_bytes(b"\xef\xbb\xbf1.0,2.0,0\n\n3.0,4.0,1\n\n")
+        xdir, _, _ = csv_to_shards(p, tmp_path / "sb", label_col=2)
+        src = ShardedMatrixSource(xdir)
+        assert src.n == 2                 # BOM row kept, blank lines dropped
+        np.testing.assert_array_equal(src.read(0, 2),
+                                      [[1.0, 2.0], [3.0, 4.0]])
+
+    def test_stale_weight_dir_cleared(self, tmp_path):
+        from mmlspark_tpu.models.gbdt.ingest import csv_to_shards
+
+        p = tmp_path / "d.csv"
+        p.write_text("1.0,0,0.5\n2.0,1,0.7\n")
+        out = tmp_path / "o2"
+        csv_to_shards(p, out, label_col=1, weight_col=2)
+        assert len(list((out / "w").glob("part-*.npy"))) == 1
+        # re-run WITHOUT weights: the old w/ shards must not survive
+        csv_to_shards(p, out, label_col=1)
+        assert list((out / "w").glob("part-*.npy")) == []
