@@ -179,7 +179,11 @@ def main() -> None:
         if partial is not None:
             # the leg publishes a primary-only line as soon as the headline
             # measurement lands — a timeout mid-secondaries still yields a
-            # real TPU number
+            # real TPU number. Rewrite its self-description: no full line
+            # is coming to supersede this one.
+            if "partial" in partial:
+                partial["partial"] = ("leg timed out mid-secondaries; "
+                                      "primary measurement only")
             _emit(partial)
             print("[bench] TPU leg timed out after its primary line; "
                   "published the partial", file=sys.stderr)
@@ -343,12 +347,11 @@ def _run_leg(on_tpu: bool) -> None:
         n_acc = min(len(pred), 100_000)
         acc = ((pred[:n_acc] > 0.5) == y[:n_acc]).mean()
     out = {
-        **primary,                 # same metric/value/anchor as the
-                                   # partial line this supersedes
+        **primary,                 # same metric/value/anchor/platform as
+                                   # the partial line this supersedes
         "train_accuracy": round(float(acc), 4),
         "bench_iterations": bench_iters,
         "growth_policy": "depthwise",
-        "platform": "tpu" if on_tpu else "cpu-fallback",
         "measures": "train phase on pre-constructed LightGBMDataset "
                     "(lgb.Dataset convention); ingest reported separately",
         # round-over-round note: value/vs_baseline use this train-phase

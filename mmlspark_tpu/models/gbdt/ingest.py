@@ -283,7 +283,7 @@ def csv_to_shards(csv_path: PathLike, out_dir: PathLike, *,
 
         # always clear the w/ layout slot too: a previous weighted run's
         # shards must not survive next to this run's features
-        for d in (xdir, ydir, wdir or os.path.join(out_dir, "w")):
+        for d in (xdir, ydir, os.path.join(out_dir, "w")):
             if os.path.isdir(d):
                 for stale in os.listdir(d):
                     if stale.startswith("part-") and stale.endswith(".npy"):
