@@ -105,6 +105,8 @@ class CognitiveServicesBase(Transformer, _HasServiceParams, HasOutputCol,
                         TypeConverters.to_int)
     timeout = Param("timeout", "per-request timeout seconds", 60.0,
                     TypeConverters.to_float)
+    backoffs = Param("backoffs", "explicit retry backoff schedule in ms "
+                     "(reference: ComputerVision backoffs)", None)
 
     def set_subscription_key(self, v: str):
         return self.set(subscriptionKey=v)
@@ -131,9 +133,10 @@ class CognitiveServicesBase(Transformer, _HasServiceParams, HasOutputCol,
             h[self.subscription_key_header] = key
         return h
 
-    def build_request(self, row_params: Dict[str, Any]) -> HTTPRequestData:
-        """Default: POST all service params as the JSON body; params declared
-        ``is_url_param`` go to the query string instead."""
+    def _split_service_params(self, row_params: Dict[str, Any]):
+        """Partition non-None row params into (url_parts, body) by their
+        ServiceParam.is_url_param declaration — the one reflection loop
+        every request builder shares."""
         cls = type(self)
         url_parts, body = {}, {}
         for name in dir(cls):
@@ -146,6 +149,12 @@ class CognitiveServicesBase(Transformer, _HasServiceParams, HasOutputCol,
                     url_parts[name] = v
                 else:
                     body[name] = _jsonable(v)
+        return url_parts, body
+
+    def build_request(self, row_params: Dict[str, Any]) -> HTTPRequestData:
+        """Default: POST all service params as the JSON body; params declared
+        ``is_url_param`` go to the query string instead."""
+        url_parts, body = self._split_service_params(row_params)
         url = append_query(self.get_or_default("url"), url_parts)
         return HTTPRequestData(
             url=url, method="POST", headers=self.auth_headers(),
@@ -167,7 +176,16 @@ class CognitiveServicesBase(Transformer, _HasServiceParams, HasOutputCol,
         for i in range(len(dataset)):
             rp = self.service_param_values(dataset, i)
             missing = [n for n in self._required_params() if rp.get(n) is None]
-            requests.append(None if missing else self.build_request(rp))
+            if missing:
+                requests.append(None)
+                continue
+            try:
+                requests.append(self.build_request(rp))
+            except ValueError:
+                # per-row request-shape validation (e.g. VerifyFaces modes)
+                # errors THIS row, like a missing required param — it must
+                # not abort the whole batch (ErrorUtils semantics)
+                requests.append(None)
         staged = dataset.with_column("_cog_request", requests)
 
         inp = CustomInputParser(udf=lambda r: r)
@@ -180,7 +198,8 @@ class CognitiveServicesBase(Transformer, _HasServiceParams, HasOutputCol,
                 .set(inputCol="_cog_request", outputCol=out_col,
                      errorCol=err_col,
                      concurrency=self.get_or_default("concurrency"),
-                     timeout=self.get_or_default("timeout")))
+                     timeout=self.get_or_default("timeout"),
+                     backoffs=self.get_or_default("backoffs")))
         return PipelineModel([http]).transform(staged).drop("_cog_request")
 
     def _required_params(self) -> List[str]:

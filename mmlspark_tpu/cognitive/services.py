@@ -145,6 +145,10 @@ class _TextAnalyticsBase(CognitiveServicesBase):
 
     text = ServiceParam("text", "document text", is_required=True)
     language = ServiceParam("language", "document language")
+    modelVersion = ServiceParam("modelVersion",
+                                "model-version query param (v3 API)")
+    showStats = ServiceParam("showStats",
+                             "include statistics in the response")
     _ta_version = "v3.0"
     _ta_path = ""
 
@@ -161,8 +165,13 @@ class _TextAnalyticsBase(CognitiveServicesBase):
             langs = [langs] * len(texts)
         docs = [{"id": str(i), "language": l, "text": t}
                 for i, (t, l) in enumerate(zip(texts, langs))]
+        q = {}
+        if rp.get("modelVersion") is not None:
+            q["model-version"] = rp["modelVersion"]
+        if rp.get("showStats") is not None:
+            q["showStats"] = str(bool(rp["showStats"])).lower()
         return HTTPRequestData(
-            url=self.get_or_default("url"), method="POST",
+            url=append_query(self.get_or_default("url"), q), method="POST",
             headers=self.auth_headers(),
             entity=json.dumps({"documents": docs}).encode())
 
@@ -243,6 +252,9 @@ class DetectFace(_VisionBase):
 class FindSimilarFace(CognitiveServicesBase):
     faceId = ServiceParam("faceId", "probe face id", is_required=True)
     faceIds = ServiceParam("faceIds", "candidate face ids")
+    faceListId = ServiceParam("faceListId", "candidate face list")
+    largeFaceListId = ServiceParam("largeFaceListId",
+                                   "candidate large face list")
     maxNumOfCandidatesReturned = ServiceParam("maxNumOfCandidatesReturned",
                                               "max candidates")
     mode = ServiceParam("mode", "matchPerson or matchFace")
@@ -255,14 +267,35 @@ class GroupFaces(CognitiveServicesBase):
 class IdentifyFaces(CognitiveServicesBase):
     faceIds = ServiceParam("faceIds", "probe ids", is_required=True)
     personGroupId = ServiceParam("personGroupId", "person group")
+    largePersonGroupId = ServiceParam("largePersonGroupId",
+                                      "large person group")
     maxNumOfCandidatesReturned = ServiceParam("maxNumOfCandidatesReturned",
                                               "max candidates")
     confidenceThreshold = ServiceParam("confidenceThreshold", "threshold")
 
 
 class VerifyFaces(CognitiveServicesBase):
-    faceId1 = ServiceParam("faceId1", "first face", is_required=True)
-    faceId2 = ServiceParam("faceId2", "second face", is_required=True)
+    faceId1 = ServiceParam("faceId1", "first face (face-to-face mode)")
+    faceId2 = ServiceParam("faceId2", "second face (face-to-face mode)")
+    faceId = ServiceParam("faceId", "probe face (face-to-person mode)")
+    personId = ServiceParam("personId", "person to verify against")
+    personGroupId = ServiceParam("personGroupId", "person's group")
+    largePersonGroupId = ServiceParam("largePersonGroupId",
+                                      "person's large group")
+
+    def build_request(self, rp):
+        two_face = (rp.get("faceId1") is not None
+                    and rp.get("faceId2") is not None)
+        to_person = (rp.get("faceId") is not None
+                     and rp.get("personId") is not None
+                     and (rp.get("personGroupId") is not None
+                          or rp.get("largePersonGroupId") is not None))
+        if not (two_face or to_person):
+            raise ValueError(
+                "VerifyFaces needs faceId1+faceId2 (face-to-face) or "
+                "faceId+personId+person[Group|LargeGroup]Id "
+                "(face-to-person)")
+        return super().build_request(rp)
 
 
 # ---------------------------------------------------------------------------
@@ -276,10 +309,13 @@ class SpeechToText(CognitiveServicesBase):
     language = ServiceParam("language", "recognition language",
                             is_url_param=True)
     format = ServiceParam("format", "simple or detailed", is_url_param=True)
+    profanity = ServiceParam("profanity", "masked, raw or removed",
+                             is_url_param=True)
 
     def build_request(self, rp):
         url = append_query(self.get_or_default("url"),
-                           {k: rp[k] for k in ("language", "format")
+                           {k: rp[k] for k in ("language", "format",
+                                               "profanity")
                             if rp.get(k)})
         headers = self.auth_headers()
         headers["Content-Type"] = "audio/wav; codecs=audio/pcm; samplerate=16000"
@@ -298,6 +334,8 @@ class _AnomalyBase(CognitiveServicesBase):
     maxAnomalyRatio = ServiceParam("maxAnomalyRatio", "max anomaly ratio")
     sensitivity = ServiceParam("sensitivity", "sensitivity")
     customInterval = ServiceParam("customInterval", "custom interval")
+    period = ServiceParam("period", "fixed seasonal period (rows per "
+                          "cycle); omit for auto-detection")
 
 
 class DetectLastAnomaly(_AnomalyBase):
@@ -343,8 +381,12 @@ class SimpleDetectAnomalies(_AnomalyBase):
             # supplies per-group scalar params like granularity)
             rp = self.service_param_values(dataset, idxs[0])
             rp["series"] = series
+            bo = self.get_or_default("backoffs")
             resp = advanced_handling(
-                self.build_request(rp), timeout=self.get_or_default("timeout"))
+                self.build_request(rp),
+                **({"backoffs": [int(b) for b in bo]}
+                   if bo is not None else {}),
+                timeout=self.get_or_default("timeout"))
             if not (200 <= resp.status_code < 300):
                 for i in idxs:
                     errors[i] = resp.to_dict()
@@ -376,12 +418,25 @@ class BingImageSearch(CognitiveServicesBase):
     offset = ServiceParam("offset", "result offset", is_url_param=True)
     imageType = ServiceParam("imageType", "image type filter",
                              is_url_param=True)
+    aspect = ServiceParam("aspect", "aspect-ratio filter", is_url_param=True)
+    color = ServiceParam("color", "color filter", is_url_param=True)
+    freshness = ServiceParam("freshness", "discovery-time filter",
+                             is_url_param=True)
+    imageContent = ServiceParam("imageContent", "content filter",
+                                is_url_param=True)
+    license = ServiceParam("license", "license filter", is_url_param=True)
+    mkt = ServiceParam("mkt", "market/locale", is_url_param=True)
+    maxFileSize = ServiceParam("maxFileSize", "max bytes", is_url_param=True)
+    minFileSize = ServiceParam("minFileSize", "min bytes", is_url_param=True)
+    maxHeight = ServiceParam("maxHeight", "max pixels", is_url_param=True)
+    minHeight = ServiceParam("minHeight", "min pixels", is_url_param=True)
+    maxWidth = ServiceParam("maxWidth", "max pixels", is_url_param=True)
+    minWidth = ServiceParam("minWidth", "min pixels", is_url_param=True)
 
     def build_request(self, rp):
-        url = append_query(self.get_or_default("url"),
-                           {k: rp[k] for k in ("q", "count", "offset",
-                                               "imageType")
-                            if rp.get(k) is not None})
+        # GET: every declared url-param ServiceParam rides the query string
+        q, _ = self._split_service_params(rp)
+        url = append_query(self.get_or_default("url"), q)
         return HTTPRequestData(url=url, method="GET",
                                headers=self.auth_headers())
 
@@ -401,12 +456,14 @@ class BingImageSearch(CognitiveServicesBase):
 
 def _search_upload_batch(url: str, headers: Dict[str, str],
                          docs: List[Dict[str, Any]], timeout: float,
-                         what: str) -> int:
+                         what: str, backoffs=None) -> int:
     """POST one document batch to a search index; shared by AddDocuments and
     AzureSearchWriter so the wire contract lives in exactly one place."""
     resp = advanced_handling(
         HTTPRequestData(url=url, method="POST", headers=headers,
                         entity=json.dumps({"value": docs}).encode()),
+        **({"backoffs": [int(b) for b in backoffs]}
+           if backoffs is not None else {}),
         timeout=timeout)
     if not (200 <= resp.status_code < 300):
         raise IOError(f"{what} failed: {resp.status_code} {resp.text}")
@@ -467,7 +524,8 @@ class AddDocuments(CognitiveServicesBase):
             try:
                 code = _search_upload_batch(
                     url, self.auth_headers(), docs,
-                    self.get_or_default("timeout"), "AddDocuments")
+                    self.get_or_default("timeout"), "AddDocuments",
+                    backoffs=self.get_or_default("backoffs"))
                 statuses.extend([code] * len(docs))
                 errors.extend([None] * len(docs))
             except IOError as e:

@@ -222,6 +222,14 @@ class SpeechToTextSDK(Transformer, HasOutputCol):
     recordedFileNameCol = Param("recordedFileNameCol",
                                 "Per-row output file for recorded audio",
                                 None, TypeConverters.to_string)
+    profanity = Param("profanity", "Masked, Raw or Removed (reference: "
+                      "SpeechToTextSDK profanity; sent out-of-band with "
+                      "the stream)", None, TypeConverters.to_string)
+    extraFfmpegArgs = Param("extraFfmpegArgs", "Accepted for reference "
+                            "parity: compressed audio here passes through "
+                            "to the service as-is (CompressedStream), so "
+                            "no local ffmpeg invocation exists to receive "
+                            "extra args", None)
 
     def _load_audio(self, v) -> bytes:
         if isinstance(v, (bytes, bytearray, memoryview)):
@@ -254,6 +262,12 @@ class SpeechToTextSDK(Transformer, HasOutputCol):
                 "recordAudioData=True requires recordedFileNameCol")
         headers = {"Content-Type": f"audio/{ftype}",
                    "X-Language": lang}
+        prof = self.get_or_default("profanity")
+        if prof:
+            if prof.capitalize() not in ("Masked", "Raw", "Removed"):
+                raise ValueError(
+                    f"profanity must be Masked, Raw or Removed, got {prof!r}")
+            headers["X-Profanity"] = prof.capitalize()
         if key:
             headers["Ocp-Apim-Subscription-Key"] = key
 

@@ -237,12 +237,19 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
                     TypeConverters.to_float)
     maxRetries = Param("maxRetries", "retries on 429/5xx/conn errors", 3,
                        TypeConverters.to_int)
+    backoffs = Param("backoffs", "explicit retry backoff schedule in ms "
+                     "(reference: ComputerVision backoffs); overrides "
+                     "maxRetries' exponential default", None)
 
     def _client(self):
         n = self.get_or_default("concurrency")
         timeout = self.get_or_default("timeout")
+        explicit = self.get_or_default("backoffs")
         retries = self.get_or_default("maxRetries")
-        backoffs = [100 * (2 ** i) for i in range(retries)]
+        # `is not None`: an explicit [] means DISABLE retries (the
+        # reference's empty-Seq semantics), not "use the default"
+        backoffs = ([int(b) for b in explicit] if explicit is not None
+                    else [100 * (2 ** i) for i in range(retries)])
         handler = lambda r: advanced_handling(r, backoffs, timeout)  # noqa: E731
         if n <= 1:
             return SingleThreadedHTTPClient(handler)
@@ -384,6 +391,11 @@ class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol, HasErrorCol)
                         TypeConverters.to_int)
     timeout = Param("timeout", "per-request timeout seconds", 60.0,
                     TypeConverters.to_float)
+    maxRetries = Param("maxRetries", "retries on 429/5xx/conn errors", 3,
+                       TypeConverters.to_int)
+    backoffs = Param("backoffs", "explicit retry backoff schedule in ms "
+                     "(reference: ComputerVision backoffs); overrides "
+                     "maxRetries' exponential default", None)
 
     def __init__(self, input_parser: Transformer = None,
                  output_parser: Transformer = None, **kwargs):
@@ -408,7 +420,9 @@ class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol, HasErrorCol)
         http = HTTPTransformer().set(
             inputCol="_http_request", outputCol="_http_response",
             concurrency=self.get_or_default("concurrency"),
-            timeout=self.get_or_default("timeout"))
+            timeout=self.get_or_default("timeout"),
+            maxRetries=self.get_or_default("maxRetries"),
+            backoffs=self.get_or_default("backoffs"))
         outp = self.output_parser or JSONOutputParser()
         outp.set(inputCol="_http_response", outputCol=out_col)
         return PipelineModel([inp, http, outp])

@@ -324,3 +324,132 @@ def test_add_documents_stage(base):
     ds2 = Dataset({"id": ["9"], "@search.action": ["merge"]})
     stage.transform(ds2)
     assert _Mock.uploaded[0]["@search.action"] == "merge"
+
+
+class TestRound4ParamTail:
+    """Reference param-surface tail: request-shaping params added in
+    round 4 (BingImageSearch filters, TextAnalytics v3 query params,
+    VerifyFaces face-to-person mode, anomaly period, explicit backoffs)."""
+
+    def test_bing_filters_ride_the_query_string(self):
+        from mmlspark_tpu.cognitive.services import BingImageSearch
+
+        s = BingImageSearch().set(
+            url="https://api.example.com/images/search",
+            subscriptionKey="k")
+        s.set_service_param("q", "cats")
+        s.set_service_param("aspect", "Wide")
+        s.set_service_param("license", "Public")
+        s.set_service_param("mkt", "en-US")
+        s.set_service_param("minWidth", 300)
+        s._init_service_params()
+        req = s.build_request({"q": "cats", "aspect": "Wide",
+                               "license": "Public", "mkt": "en-US",
+                               "minWidth": 300})
+        assert "aspect=Wide" in req.url and "license=Public" in req.url
+        assert "mkt=en-US" in req.url and "minWidth=300" in req.url
+        assert req.method == "GET"
+
+    def test_text_analytics_v3_query_params(self):
+        from mmlspark_tpu.cognitive.services import TextSentiment
+
+        s = TextSentiment().set(url="https://ta.example.com/sentiment",
+                                subscriptionKey="k")
+        req = s.build_request({"text": "hello", "modelVersion": "2021-01-01",
+                              "showStats": True})
+        assert "model-version=2021-01-01" in req.url
+        assert "showStats=true" in req.url
+
+    def test_verify_faces_modes(self):
+        import json as _json
+
+        from mmlspark_tpu.cognitive.services import VerifyFaces
+
+        s = VerifyFaces().set(url="https://face.example.com/verify",
+                              subscriptionKey="k")
+        body = _json.loads(s.build_request(
+            {"faceId": "f1", "personId": "p1",
+             "largePersonGroupId": "g1"}).entity)
+        assert body == {"faceId": "f1", "personId": "p1",
+                        "largePersonGroupId": "g1"}
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="face-to-person"):
+            s.build_request({"faceId1": "a"})
+
+    def test_anomaly_period_in_body(self):
+        import json as _json
+
+        from mmlspark_tpu.cognitive.services import DetectAnomalies
+
+        s = DetectAnomalies().set(url="https://an.example.com/detect",
+                                  subscriptionKey="k")
+        body = _json.loads(s.build_request(
+            {"series": [{"timestamp": "t", "value": 1.0}],
+             "granularity": "daily", "period": 7}).entity)
+        assert body["period"] == 7
+
+    def test_explicit_backoffs_accepted(self):
+        from mmlspark_tpu.io.http import SimpleHTTPTransformer
+
+        t = SimpleHTTPTransformer().set(url="https://x.example.com",
+                                        backoffs=[50, 100])
+        assert t.get_or_default("backoffs") == [50, 100]
+        t._pipeline()            # plumbs through without error
+
+    def test_sdk_profanity_validation(self):
+        import numpy as np
+        import pytest as _pytest
+
+        from mmlspark_tpu.cognitive.speech_sdk import SpeechToTextSDK
+        from mmlspark_tpu.core.dataset import Dataset
+
+        sdk = SpeechToTextSDK().set(url="http://localhost:1/x",
+                                    profanity="sideways")
+        with _pytest.raises(ValueError, match="Masked"):
+            sdk.transform(Dataset({"audio": [np.zeros(4, np.uint8)
+                                             .tobytes()]}))
+
+    def test_verify_faces_bad_row_errors_not_aborts(self):
+        import numpy as np
+
+        from mmlspark_tpu.cognitive.services import VerifyFaces
+        from mmlspark_tpu.core.dataset import Dataset
+
+        s = (VerifyFaces()
+             .set(url="http://localhost:1/verify", subscriptionKey="k",
+                  outputCol="out", errorCol="err", backoffs=[]))
+        s.set_service_param_col("faceId1", "f1")
+        s.set_service_param_col("faceId2", "f2")
+        # row 0 is mode-incomplete (f2 missing); the batch must survive
+        ds = Dataset({"f1": ["a", "b"], "f2": [None, "c"]})
+        out = s.transform(ds)
+        assert out["out"][0] is None       # invalid row errored per-row
+        # row 1 built a request (it fails to CONNECT, which also lands as
+        # a row error — the point is no ValueError aborted the transform)
+        assert len(out["out"]) == 2
+
+    def test_empty_backoffs_disables_retries(self):
+        from mmlspark_tpu.io.http import HTTPTransformer
+
+        t = HTTPTransformer().set(backoffs=[])
+        # reaching into the client: the handler must carry an empty
+        # schedule, not the 3-retry default
+        import mmlspark_tpu.io.http as h
+        from mmlspark_tpu.io.http import HTTPRequestData
+        seen = {}
+        orig = h.advanced_handling
+
+        def spy(req, backoffs=(100, 500, 1000), timeout=60.0):
+            seen["backoffs"] = list(backoffs)
+            raise IOError("stop here")
+
+        h.advanced_handling = spy
+        try:
+            client = t._client()
+            try:
+                client.handler(HTTPRequestData(url="http://x.invalid/"))
+            except Exception:
+                pass
+        finally:
+            h.advanced_handling = orig
+        assert seen.get("backoffs") == []
