@@ -106,7 +106,8 @@ class CognitiveServicesBase(Transformer, _HasServiceParams, HasOutputCol,
     timeout = Param("timeout", "per-request timeout seconds", 60.0,
                     TypeConverters.to_float)
     backoffs = Param("backoffs", "explicit retry backoff schedule in ms "
-                     "(reference: ComputerVision backoffs)", None)
+                     "(reference: ComputerVision backoffs)", None,
+                     TypeConverters.to_list_int)
 
     def set_subscription_key(self, v: str):
         return self.set(subscriptionKey=v)

@@ -165,15 +165,20 @@ class _TextAnalyticsBase(CognitiveServicesBase):
             langs = [langs] * len(texts)
         docs = [{"id": str(i), "language": l, "text": t}
                 for i, (t, l) in enumerate(zip(texts, langs))]
+        return HTTPRequestData(
+            url=append_query(self.get_or_default("url"),
+                             self._ta_query(rp)),
+            method="POST", headers=self.auth_headers(),
+            entity=json.dumps({"documents": docs}).encode())
+
+    def _ta_query(self, rp):
+        """v3 query params shared by every text-analytics builder."""
         q = {}
         if rp.get("modelVersion") is not None:
             q["model-version"] = rp["modelVersion"]
         if rp.get("showStats") is not None:
             q["showStats"] = str(bool(rp["showStats"])).lower()
-        return HTTPRequestData(
-            url=append_query(self.get_or_default("url"), q), method="POST",
-            headers=self.auth_headers(),
-            entity=json.dumps({"documents": docs}).encode())
+        return q
 
 
 class TextSentiment(_TextAnalyticsBase):
@@ -192,13 +197,16 @@ class LanguageDetector(_TextAnalyticsBase):
     _ta_path = "languages"
 
     def build_request(self, rp):
+        # language detection docs carry no language field (the base
+        # builder would inject the 'en' default); query params are shared
         texts = rp["text"]
         if isinstance(texts, str):
             texts = [texts]
         docs = [{"id": str(i), "text": t} for i, t in enumerate(texts)]
         return HTTPRequestData(
-            url=self.get_or_default("url"), method="POST",
-            headers=self.auth_headers(),
+            url=append_query(self.get_or_default("url"),
+                             self._ta_query(rp)),
+            method="POST", headers=self.auth_headers(),
             entity=json.dumps({"documents": docs}).encode())
 
 
@@ -381,11 +389,9 @@ class SimpleDetectAnomalies(_AnomalyBase):
             # supplies per-group scalar params like granularity)
             rp = self.service_param_values(dataset, idxs[0])
             rp["series"] = series
-            bo = self.get_or_default("backoffs")
             resp = advanced_handling(
                 self.build_request(rp),
-                **({"backoffs": [int(b) for b in bo]}
-                   if bo is not None else {}),
+                backoffs=self.get_or_default("backoffs"),
                 timeout=self.get_or_default("timeout"))
             if not (200 <= resp.status_code < 300):
                 for i in idxs:
@@ -462,9 +468,7 @@ def _search_upload_batch(url: str, headers: Dict[str, str],
     resp = advanced_handling(
         HTTPRequestData(url=url, method="POST", headers=headers,
                         entity=json.dumps({"value": docs}).encode()),
-        **({"backoffs": [int(b) for b in backoffs]}
-           if backoffs is not None else {}),
-        timeout=timeout)
+        backoffs=backoffs, timeout=timeout)
     if not (200 <= resp.status_code < 300):
         raise IOError(f"{what} failed: {resp.status_code} {resp.text}")
     return resp.status_code

@@ -180,11 +180,28 @@ class Featurize(Estimator, HasOutputCol):
     numberOfFeatures = Param("numberOfFeatures",
                              "hash buckets for high-cardinality strings", 4096,
                              TypeConverters.to_int)
+    featureColumns = Param("featureColumns", "Reference-compat mapping "
+                           "{outputCol: [inputCols]} (Featurize "
+                           "featureColumns). One entry only — it sets "
+                           "outputCol and inputCols", None, is_complex=True)
+    allowImages = Param("allowImages", "Accepted for reference parity: "
+                        "image columns are featurized by the dedicated "
+                        "ImageFeaturizer stage here, not by Featurize",
+                        False, TypeConverters.to_bool)
     maxOneHotCardinality = Param("maxOneHotCardinality",
                                  "one-hot when distinct count <= this", 100,
                                  TypeConverters.to_int)
 
     def fit(self, dataset: Dataset) -> "FeaturizeModel":
+        fc = self.get_or_default("featureColumns")
+        if fc:
+            if len(fc) != 1:
+                raise ValueError(
+                    "featureColumns supports exactly one "
+                    "{outputCol: [inputCols]} entry here (one assembled "
+                    "vector per Featurize stage); chain stages for more")
+            out, cols = next(iter(fc.items()))
+            self.set(outputCol=str(out), inputCols=[str(c) for c in cols])
         in_cols = self.get_or_default("inputCols")
         if in_cols is None:
             in_cols = [c for c in dataset.columns

@@ -143,12 +143,14 @@ RETRY_STATUS = (0, 429, 502, 503, 504)
 
 
 def advanced_handling(request: HTTPRequestData,
-                      backoffs: Sequence[int] = (100, 500, 1000),
+                      backoffs: Optional[Sequence[int]] = (100, 500, 1000),
                       timeout: float = 60.0) -> HTTPResponseData:
     """Retry/backoff handler (reference: io/http/HandlingUtils.advancedUDF —
     retries 429/5xx/connection failures on a millisecond backoff schedule,
     honouring Retry-After when present)."""
     resp = send_request(request, timeout)
+    if backoffs is None:
+        backoffs = (100, 500, 1000)      # callers may pass an unset param
     for backoff_ms in backoffs:
         if resp.status_code not in RETRY_STATUS:
             return resp
@@ -239,7 +241,8 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
                        TypeConverters.to_int)
     backoffs = Param("backoffs", "explicit retry backoff schedule in ms "
                      "(reference: ComputerVision backoffs); overrides "
-                     "maxRetries' exponential default", None)
+                     "maxRetries' exponential default", None,
+                     TypeConverters.to_list_int)
 
     def _client(self):
         n = self.get_or_default("concurrency")
@@ -395,7 +398,8 @@ class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol, HasErrorCol)
                        TypeConverters.to_int)
     backoffs = Param("backoffs", "explicit retry backoff schedule in ms "
                      "(reference: ComputerVision backoffs); overrides "
-                     "maxRetries' exponential default", None)
+                     "maxRetries' exponential default", None,
+                     TypeConverters.to_list_int)
 
     def __init__(self, input_parser: Transformer = None,
                  output_parser: Transformer = None, **kwargs):

@@ -271,11 +271,26 @@ class EnsembleByKey(Transformer):
                      TypeConverters.to_string)
     collapseGroup = Param("collapseGroup", "one row per group", True,
                           TypeConverters.to_bool)
+    colNames = Param("colNames", "output names for the aggregated columns "
+                     "(parallel to cols; default 'mean(<col>)' — "
+                     "reference: EnsembleByKey colNames)", None,
+                     TypeConverters.to_list_string)
     vectorDims = Param("vectorDims", "compat no-op", None)
 
     def transform(self, dataset: Dataset) -> Dataset:
         keys = self.get_or_default("keys")
         cols = self.get_or_default("cols")
+        names = self.get_or_default("colNames")
+        if names is not None and len(names) != len(cols):
+            raise ValueError(
+                f"colNames has {len(names)} entries for {len(cols)} cols")
+        if names is not None and (len(set(names)) != len(names)
+                                  or set(names) & set(keys)):
+            raise ValueError(
+                f"colNames must be distinct and must not collide with key "
+                f"columns; got {names} with keys {keys}")
+        out_name = dict(zip(cols, names)) if names else \
+            {c: f"mean({c})" for c in cols}
         key_data = [dataset[k] for k in keys]
         n = len(dataset)
         groups: Dict[tuple, List[int]] = {}
@@ -286,13 +301,13 @@ class EnsembleByKey(Transformer):
             raise ValueError("only 'mean' strategy is supported (parity with reference)")
         out_cols: Dict[str, list] = {k: [] for k in keys}
         for c in cols:
-            out_cols[f"mean({c})"] = []
+            out_cols[out_name[c]] = []
         for k, idxs in groups.items():
             for name, val in zip(keys, k):
                 out_cols[name].append(val)
             for c in cols:
                 arr = np.asarray([dataset[c][i] for i in idxs], dtype=np.float64)
-                out_cols[f"mean({c})"].append(arr.mean(axis=0))
+                out_cols[out_name[c]].append(arr.mean(axis=0))
         final = {}
         for name, vals in out_cols.items():
             try:
@@ -303,7 +318,8 @@ class EnsembleByKey(Transformer):
             # broadcast group aggregate back onto original rows
             gmap = {k: i for i, k in enumerate(groups.keys())}
             rows = [gmap[tuple(kd[i] for kd in key_data)] for i in range(n)]
-            add = {f"mean({c})": np.asarray(final[f"mean({c})"])[rows] for c in cols}
+            add = {out_name[c]: np.asarray(final[out_name[c]])[rows]
+                   for c in cols}
             return dataset.with_columns(add)
         return Dataset(final)
 

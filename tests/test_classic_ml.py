@@ -284,3 +284,39 @@ class TestPlotUtils:
         assert "AUC" in ax2.get_title()
         ax2.figure.savefig(tmp_path / "roc.png")
         assert (tmp_path / "roc.png").stat().st_size > 0
+
+
+def test_ensemble_by_key_col_names():
+    from mmlspark_tpu.core.dataset import Dataset
+    from mmlspark_tpu.stages.basic import EnsembleByKey
+
+    ds = Dataset({"k": ["a", "a", "b"],
+                  "score": np.array([1.0, 3.0, 5.0])})
+    out = EnsembleByKey().set(keys=["k"], cols=["score"],
+                              colNames=["avgScore"]).transform(ds)
+    assert "avgScore" in out.columns
+    got = dict(zip(out["k"], out["avgScore"]))
+    assert got["a"] == 2.0 and got["b"] == 5.0
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="colNames"):
+        EnsembleByKey().set(keys=["k"], cols=["score"],
+                            colNames=["a", "b"]).transform(ds)
+    with _pytest.raises(ValueError, match="collide"):
+        EnsembleByKey().set(keys=["k"], cols=["score"],
+                            colNames=["k"]).transform(ds)
+
+
+def test_featurize_feature_columns_mapping():
+    from mmlspark_tpu.core.dataset import Dataset
+    from mmlspark_tpu.featurize.core import Featurize
+
+    ds = Dataset({"age": np.array([20.0, 30.0, 40.0]),
+                  "city": ["p", "q", "p"],
+                  "label": np.array([0.0, 1.0, 0.0])})
+    model = Featurize(featureColumns={"vec": ["age", "city"]}).fit(ds)
+    out = model.transform(ds)
+    assert "vec" in out.columns
+    assert out["vec"].shape[0] == 3
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="exactly one"):
+        Featurize(featureColumns={"a": ["age"], "b": ["city"]}).fit(ds)

@@ -360,6 +360,17 @@ class TestRound4ParamTail:
         assert "model-version=2021-01-01" in req.url
         assert "showStats=true" in req.url
 
+    def test_language_detector_keeps_query_params(self):
+        from mmlspark_tpu.cognitive.services import LanguageDetector
+
+        s = LanguageDetector().set(url="https://ta.example.com/languages",
+                                   subscriptionKey="k")
+        req = s.build_request({"text": "bonjour", "modelVersion": "latest"})
+        assert "model-version=latest" in req.url
+        import json as _json
+        docs = _json.loads(req.entity)["documents"]
+        assert docs == [{"id": "0", "text": "bonjour"}]  # no language field
+
     def test_verify_faces_modes(self):
         import json as _json
 
