@@ -194,6 +194,8 @@ class Featurize(Estimator, HasOutputCol):
 
     def fit(self, dataset: Dataset) -> "FeaturizeModel":
         fc = self.get_or_default("featureColumns")
+        out_override = None
+        in_cols = self.get_or_default("inputCols")
         if fc:
             if len(fc) != 1:
                 raise ValueError(
@@ -201,8 +203,9 @@ class Featurize(Estimator, HasOutputCol):
                     "{outputCol: [inputCols]} entry here (one assembled "
                     "vector per Featurize stage); chain stages for more")
             out, cols = next(iter(fc.items()))
-            self.set(outputCol=str(out), inputCols=[str(c) for c in cols])
-        in_cols = self.get_or_default("inputCols")
+            # resolve locally — fitting must not mutate the estimator
+            out_override = str(out)
+            in_cols = [str(c) for c in cols]
         if in_cols is None:
             in_cols = [c for c in dataset.columns
                        if c != self.get_or_default("labelCol")]
@@ -227,6 +230,8 @@ class Featurize(Estimator, HasOutputCol):
                                  "width": int(self.get_or_default("numberOfFeatures"))})
         model = FeaturizeModel(plan=plan)
         self._copy_params_to(model)
+        if out_override is not None:
+            model.set(outputCol=out_override)
         return model
 
 

@@ -58,6 +58,15 @@ class DecodeImage(Transformer, HasInputCol, HasOutputCol):
 def _as_float(img: np.ndarray) -> np.ndarray:
     return img.astype(np.float32) if img.dtype != np.float32 else img
 
+def _check_channels(img_or_batch, nc: Optional[int]) -> None:
+    """Validate channel count for one HxWxC image or an NxHxWxC batch."""
+    if nc is None or img_or_batch is None:
+        return
+    nd = getattr(img_or_batch, "ndim", 0)
+    got = img_or_batch.shape[-1] if nd in (3, 4) else 1
+    if got != nc:
+        raise ValueError(f"nChannels={nc} but images have {got} channels")
+
 
 def resize_image(img: np.ndarray, height: int, width: int) -> np.ndarray:
     """Bilinear resize on device via jax.image (replaces cv::resize).
@@ -246,8 +255,15 @@ class ResizeImageTransformer(Transformer, HasInputCol, HasOutputCol):
 
     height = Param("height", "target height", None, TypeConverters.to_int)
     width = Param("width", "target width", None, TypeConverters.to_int)
+    nChannels = Param("nChannels", "expected channel count; mismatching "
+                      "images raise (reference: ResizeImageTransformer "
+                      "nChannels)", None, TypeConverters.to_int)
 
     def transform(self, dataset: Dataset) -> Dataset:
+        nc = self.get_or_default("nChannels")
+        if nc is not None:
+            for img in dataset[self.get_or_default("inputCol")]:
+                _check_channels(img, nc)
         return (ImageTransformer()
                 .set(inputCol=self.get_or_default("inputCol"),
                      outputCol=self.get_or_default("outputCol"))
@@ -261,18 +277,25 @@ class UnrollImage(Transformer, HasInputCol, HasOutputCol):
     reference unrolls to CNTK's CHW plane order; we keep that convention so
     featurizer vectors are comparable."""
 
+    nChannels = Param("nChannels", "expected channel count; mismatching "
+                      "images raise (reference: UnrollImage nChannels)",
+                      None, TypeConverters.to_int)
+
     def transform(self, dataset: Dataset) -> Dataset:
         in_col = self.get_or_default("inputCol")
         out_col = self.get_or_default("outputCol") or "unrolled"
         col = dataset[in_col]
+        nc = self.get_or_default("nChannels")
 
         def unroll(img):
             if img is None:
                 return None
+            _check_channels(img, nc)
             f = _as_float(img)
             return np.moveaxis(f, -1, 0).reshape(-1)  # HWC -> CHW -> flat
 
         if isinstance(col, np.ndarray) and col.ndim == 4:
+            _check_channels(col, nc)
             out = np.moveaxis(_as_float(col), -1, 1).reshape(col.shape[0], -1)
         else:
             out = [unroll(img) for img in col]

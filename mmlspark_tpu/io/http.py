@@ -296,11 +296,14 @@ class JSONInputParser(Transformer, HasInputCol, HasOutputCol):
 
 
 class CustomInputParser(Transformer, HasInputCol, HasOutputCol):
-    """Arbitrary row -> HTTPRequestData function (Parsers.scala:24)."""
+    """Arbitrary row -> HTTPRequestData function (Parsers.scala:24).
 
-    def __init__(self, udf: Callable[[Any], HTTPRequestData] = None, **kwargs):
+    ``udfPython`` is the reference's name for the same slot."""
+
+    def __init__(self, udf: Callable[[Any], HTTPRequestData] = None,
+                 udfPython: Callable = None, **kwargs):
         super().__init__(**kwargs)
-        self.udf = udf
+        self.udf = udf or udfPython
 
     def set_udf(self, udf) -> "CustomInputParser":
         self.udf = udf
@@ -401,11 +404,19 @@ class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol, HasErrorCol)
                      "maxRetries' exponential default", None,
                      TypeConverters.to_list_int)
 
+    flattenOutputBatches = Param(
+        "flattenOutputBatches", "Accepted for reference parity: rows map "
+        "1:1 through the exchange here, so there are no output batches to "
+        "flatten", None, TypeConverters.to_bool)
+
     def __init__(self, input_parser: Transformer = None,
-                 output_parser: Transformer = None, **kwargs):
+                 output_parser: Transformer = None,
+                 inputParser: Transformer = None,
+                 outputParser: Transformer = None, **kwargs):
         super().__init__(**kwargs)
-        self.input_parser = input_parser
-        self.output_parser = output_parser
+        # camelCase kwargs mirror the reference's param names
+        self.input_parser = input_parser or inputParser
+        self.output_parser = output_parser or outputParser
 
     def set_input_parser(self, p) -> "SimpleHTTPTransformer":
         self.input_parser = p

@@ -277,6 +277,10 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
     the global-average-pool features; 0 fetches logits.
     """
 
+    dropNa = Param("dropNa", "drop rows whose image is missing/undecodable "
+                   "before featurizing (reference: ImageFeaturizer "
+                   "dropNa); False keeps them as None outputs", True,
+                   TypeConverters.to_bool)
     cutOutputLayers = Param("cutOutputLayers", "how many layers to cut", 1,
                             TypeConverters.to_int)
     miniBatchSize = Param("miniBatchSize", "rows per device batch", 32,
@@ -313,6 +317,28 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
 
         in_col = self.get_or_default("inputCol")
         out_col = self.get_or_default("outputCol") or "features"
+        imgs = dataset[in_col]
+        keep = np.asarray([i for i, v in enumerate(imgs) if v is not None],
+                          dtype=np.int64)
+        if len(keep) == 0:
+            # nothing featurizable: empty dataset under dropNa, or
+            # all-None outputs with rows preserved
+            if self.get_or_default("dropNa"):
+                return dataset.take(keep).with_column(out_col, [])
+            return dataset.with_column(out_col, [None] * len(dataset))
+        if len(keep) != len(dataset):
+            if self.get_or_default("dropNa"):
+                # reference ImageFeaturizer dropNa: undecodable rows leave
+                # the dataset entirely
+                dataset = dataset.take(keep)
+            else:
+                # keep row alignment: featurize the valid rows, reinsert
+                # None outputs at the missing positions
+                feats = self.transform(dataset.take(keep))[out_col]
+                outs: List[Any] = [None] * len(dataset)
+                for j, i in enumerate(keep):
+                    outs[int(i)] = feats[j]
+                return dataset.with_column(out_col, outs)
         h, w = self.input_hw
         prep = (ImageTransformer()
                 .set(inputCol=in_col, outputCol="_img_prepped")

@@ -30,6 +30,10 @@ class TrainClassifier(Estimator, HasLabelCol):
                         TypeConverters.to_int)
     reindexLabel = Param("reindexLabel", "index the label column", True,
                          TypeConverters.to_bool)
+    labels = Param("labels", "explicit label-value ordering: index i is "
+                   "assigned to labels[i] (reference: TrainClassifier "
+                   "labels); unlisted values raise", None,
+                   TypeConverters.to_list_string)
 
     def __init__(self, model=None, **kwargs):
         super().__init__(**kwargs)
@@ -42,9 +46,35 @@ class TrainClassifier(Estimator, HasLabelCol):
         levels = None
         ds = dataset
         if self.get_or_default("reindexLabel"):
-            indexer = ValueIndexer(inputCol=label, outputCol=label).fit(ds)
-            levels = indexer.get_or_default("levels")
-            ds = indexer.transform(ds)
+            explicit = self.get_or_default("labels")
+            if explicit:
+                # reference TrainClassifier `labels`: the given ordering IS
+                # the index mapping; values outside it must fail loudly.
+                # Levels must match the column's value domain — numeric
+                # columns index by float, string columns by str (the
+                # Param converter stores the list as strings either way).
+                from ..featurize.core import ValueIndexerModel, _is_numeric
+                col = ds[label]
+                if _is_numeric(col):
+                    levels = [float(v) for v in explicit]
+                    seen = {float(v) for v in np.asarray(col).ravel()
+                            if not (isinstance(v, float) and np.isnan(v))}
+                else:
+                    levels = [str(v) for v in explicit]
+                    seen = {str(v) for v in col if v is not None}
+                extra = sorted(seen - set(levels))
+                if extra:
+                    raise ValueError(
+                        f"label column contains values {extra} not in the "
+                        f"explicit labels list {explicit}")
+                indexer_model = ValueIndexerModel(
+                    levels=levels).set(inputCol=label, outputCol=label)
+                ds = indexer_model.transform(ds)
+            else:
+                indexer = ValueIndexer(inputCol=label,
+                                       outputCol=label).fit(ds)
+                levels = indexer.get_or_default("levels")
+                ds = indexer.transform(ds)
         feat_model = Featurize(
             labelCol=label, outputCol=fcol,
             numberOfFeatures=self.get_or_default("numFeatures")).fit(ds)

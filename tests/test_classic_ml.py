@@ -320,3 +320,33 @@ def test_featurize_feature_columns_mapping():
     import pytest as _pytest
     with _pytest.raises(ValueError, match="exactly one"):
         Featurize(featureColumns={"a": ["age"], "b": ["city"]}).fit(ds)
+
+
+def test_train_classifier_explicit_labels():
+    from mmlspark_tpu.models.gbdt.api import LightGBMClassifier
+    from mmlspark_tpu.train.core import TrainClassifier
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 4)).astype(np.float32)
+    y = np.where(X[:, 0] > 0, "yes", "no")
+    ds = Dataset({"f0": X[:, 0], "f1": X[:, 1], "f2": X[:, 2],
+                  "f3": X[:, 3], "label": list(y)})
+    m = TrainClassifier(model=LightGBMClassifier(numIterations=5,
+                                                 numLeaves=7, maxBin=31),
+                        labels=["yes", "no"]).fit(ds)
+    # explicit ordering: 'yes' -> index 0 (auto-sort would put 'no' first)
+    assert m.get_or_default("levels")[0] == "yes"
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="not in the"):
+        TrainClassifier(model=LightGBMClassifier(numIterations=2),
+                        labels=["yes"]).fit(ds)
+    # numeric label columns index by value, not by string representation
+    dsn = Dataset({"f0": X[:, 0], "f1": X[:, 1],
+                   "label": (X[:, 0] > 0).astype(np.float64)})
+    mn = TrainClassifier(model=LightGBMClassifier(numIterations=4,
+                                                  numLeaves=7, maxBin=31),
+                         labels=["1", "0"]).fit(dsn)
+    out = mn.transform(dsn)
+    acc = (np.asarray(out["prediction"]).astype(int)
+           == np.asarray([0 if v > 0 else 1 for v in X[:, 0]])).mean()
+    assert acc > 0.9, acc

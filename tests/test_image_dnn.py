@@ -338,3 +338,43 @@ def test_feed_fetch_validation(tiny_cnn):
     with pytest.raises(ValueError, match="length"):
         Dataset({"ca": np.zeros((4, 2), np.float32),
                  "cb": np.zeros((3, 2), np.float32)})
+
+
+def test_image_featurizer_drop_na(tiny_cnn):
+    from mmlspark_tpu.models.dnn.scoring import ImageFeaturizer
+
+    params, cfg, apply_fn = tiny_cnn
+    inner = DNNModel(params, apply_fn)
+    rng = np.random.default_rng(0)
+    good = rng.normal(size=(16, 16, 3)).astype(np.float32)
+    imgs = [good, None, good + 1]
+    feat = ImageFeaturizer(dnn_model=inner, input_hw=(16, 16)).set(
+        inputCol="img", outputCol="f", miniBatchSize=4)
+    dropped = feat.set(dropNa=True).transform(Dataset({"img": imgs}))
+    assert len(dropped) == 2                      # bad row left the dataset
+    kept = feat.set(dropNa=False).transform(Dataset({"img": imgs}))
+    assert len(kept) == 3 and kept["f"][1] is None
+    # all-None column: dropNa empties the dataset rather than crashing
+    none_ds = Dataset({"img": [None, None], "id": np.array([1, 2])})
+    assert len(feat.set(dropNa=True).transform(none_ds)) == 0
+    all_none = feat.set(dropNa=False).transform(none_ds)
+    assert list(all_none["f"]) == [None, None]
+    np.testing.assert_allclose(np.asarray(kept["f"][0]),
+                               np.asarray(dropped["f"][0]), rtol=1e-5)
+
+
+def test_unroll_and_resize_nchannels():
+    from mmlspark_tpu.image.ops import ResizeImageTransformer, UnrollImage
+
+    rgb = np.zeros((4, 8, 8, 3), np.float32)
+    out = UnrollImage().set(inputCol="i", outputCol="u",
+                            nChannels=3).transform(Dataset({"i": rgb}))
+    assert out["u"].shape == (4, 8 * 8 * 3)
+    with pytest.raises(ValueError, match="channels"):
+        UnrollImage().set(inputCol="i", nChannels=1).transform(
+            Dataset({"i": rgb}))
+    with pytest.raises(ValueError, match="channels"):
+        ResizeImageTransformer().set(inputCol="i", outputCol="r",
+                                     height=4, width=4,
+                                     nChannels=1).transform(
+            Dataset({"i": [rgb[0]]}))
