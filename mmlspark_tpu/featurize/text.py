@@ -41,16 +41,24 @@ class Tokenizer(Transformer, HasInputCol, HasOutputCol):
     toLowercase = Param("toLowercase", "lowercase first", True, TypeConverters.to_bool)
     minTokenLength = Param("minTokenLength", "drop shorter tokens", 1,
                            TypeConverters.to_int)
+    gaps = Param("gaps", "True (default): the regex matches the GAPS "
+                 "between tokens (split); False: it matches the tokens "
+                 "themselves (findall) — Spark RegexTokenizer semantics "
+                 "(reference: TextFeaturizer tokenizerGaps)", True,
+                 TypeConverters.to_bool)
 
     def transform(self, dataset: Dataset) -> Dataset:
         pat = re.compile(self.get_or_default("pattern"))
         lower = self.get_or_default("toLowercase")
         mtl = self.get_or_default("minTokenLength")
+        gaps = self.get_or_default("gaps")
         col = dataset[self.get_or_default("inputCol")]
         out = []
         for s in col:
             s = str(s).lower() if lower else str(s)
-            out.append([t for t in pat.split(s) if len(t) >= mtl])
+            toks = (pat.split(s) if gaps
+                    else [m.group(0) for m in pat.finditer(s)])
+            out.append([t for t in toks if len(t) >= mtl])
         return dataset.with_column(self.get_or_default("outputCol"), out)
 
 
@@ -159,6 +167,8 @@ class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
     useTokenizer = Param("useTokenizer", "tokenize input", True, TypeConverters.to_bool)
     tokenizerPattern = Param("tokenizerPattern", "split regex", r"\W+",
                              TypeConverters.to_string)
+    tokenizerGaps = Param("tokenizerGaps", "regex matches gaps (split) vs "
+                          "tokens (findall)", True, TypeConverters.to_bool)
     toLowercase = Param("toLowercase", "lowercase", True, TypeConverters.to_bool)
     minTokenLength = Param("minTokenLength", "min token length", 0,
                            TypeConverters.to_int)
@@ -186,6 +196,7 @@ class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
             stages.append(Tokenizer(
                 inputCol=cur, outputCol="__tokens",
                 pattern=self.get_or_default("tokenizerPattern"),
+                gaps=self.get_or_default("tokenizerGaps"),
                 toLowercase=self.get_or_default("toLowercase"),
                 minTokenLength=max(1, self.get_or_default("minTokenLength"))))
             cur = "__tokens"

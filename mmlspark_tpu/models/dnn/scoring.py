@@ -57,6 +57,11 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
         "batchInput", "Accepted for reference parity; scoring always "
         "micro-batches to the static compiled shape", True,
         TypeConverters.to_bool)
+    shapeOutput = Param(
+        "shapeOutput", "Accepted for reference parity: outputs keep the "
+        "model's natural [n, ...] array shape (the reference's flag "
+        "reshaped CNTK's flattened outputs)", False,
+        TypeConverters.to_bool)
 
     def __init__(self, params: Any = None, apply_fn: Callable = None,
                  apply_spec: Optional[Dict[str, Any]] = None, **kwargs):

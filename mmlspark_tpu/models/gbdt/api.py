@@ -544,6 +544,11 @@ class LightGBMClassificationModel(_LightGBMModelBase, HasRawPredictionCol,
     thresholds = Param("thresholds", "Per-class prediction thresholds", None,
                        TypeConverters.to_list_float)
 
+    def get_actual_num_classes(self) -> int:
+        """reference: LightGBMClassificationModel actualNumClasses —
+        the class count the trained booster actually models."""
+        return max(self.booster.num_class, 2)
+
     def transform(self, dataset: Dataset) -> Dataset:
         X = _features_dense(dataset, self.get_or_default("featuresCol"))
         raw = self.booster.predict_raw(X)  # [n, K]
@@ -673,6 +678,10 @@ class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
 
     objective = Param("objective", "ranking objective", "lambdarank",
                       TypeConverters.to_string)
+    labelGain = Param("labelGain", "NDCG gain per relevance grade: grade "
+                      "g scores labelGain[g] (reference: LightGBMRanker "
+                      "labelGain; default 2^g - 1)", None,
+                      TypeConverters.to_list_float)
     maxPosition = Param("maxPosition", "NDCG truncation position "
                         "(reference: TrainParams maxPosition)", 20,
                         TypeConverters.to_int)
@@ -720,6 +729,17 @@ class LightGBMRanker(Estimator, _LightGBMParams, HasGroupCol):
                       max_position=self.get_or_default("maxPosition"),
                       sigma=self.get_or_default("sigma"),
                       eval_at=int(max(eval_at)) if eval_at else 0)
+        lg = self.get_or_default("labelGain")
+        if lg is not None:
+            # LightGBM fails fast when a label grade exceeds the gain
+            # table; silent clamping would train against wrong gains
+            max_grade = int(np.nanmax(yp)) if len(yp) else 0
+            if max_grade >= len(lg):
+                raise ValueError(
+                    f"labelGain has {len(lg)} entries but the data "
+                    f"contains relevance grade {max_grade}")
+            # tuple: objective_kwargs flow into hashed program-cache keys
+            kwargs["label_gain"] = tuple(float(g) for g in lg)
         booster = train_booster(
             Xp, yp, wp,
             objective="lambdarank", num_class=1,

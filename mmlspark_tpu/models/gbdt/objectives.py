@@ -150,7 +150,19 @@ def _multiclass(num_class: int):
                      lambda y, w: jnp.float32(0.0))
 
 
-def _lambdarank(group_size: int, max_position: int = 20, sigma: float = 1.0):
+def _label_gains(yy, label_gain):
+    """Per-item NDCG gains: LightGBM's default 2^label - 1, or the explicit
+    ``label_gain`` table (reference LightGBMRanker labelGain: gain of grade
+    g is label_gain[g])."""
+    if label_gain is None:
+        return jnp.exp2(yy) - 1.0
+    table = jnp.asarray(label_gain, jnp.float32)
+    idx = jnp.clip(yy.astype(jnp.int32), 0, table.shape[0] - 1)
+    return table[idx]
+
+
+def _lambdarank(group_size: int, max_position: int = 20, sigma: float = 1.0,
+                label_gain=None):
     """LambdaRank pairwise gradients over fixed-size padded query groups.
 
     TPU-native formulation of the reference's lambdarank objective
@@ -181,7 +193,7 @@ def _lambdarank(group_size: int, max_position: int = 20, sigma: float = 1.0):
         s = score.reshape(-1, S)
         yy = y.reshape(-1, S)
         mask = (w.reshape(-1, S) > 0)
-        gains = (jnp.exp2(yy) - 1.0) * mask
+        gains = _label_gains(yy, label_gain) * mask
         _, disc = _ranks_and_discounts(s, mask)
         maxdcg = _max_dcg(gains, mask)
 
@@ -204,13 +216,14 @@ def _lambdarank(group_size: int, max_position: int = 20, sigma: float = 1.0):
     return Objective("lambdarank", grad_hess, lambda sc: sc, 1, init_score)
 
 
-def _ndcg_metric(scores, y, w, S: int, max_position: int):
+def _ndcg_metric(scores, y, w, S: int, max_position: int,
+                 label_gain=None):
     """Per-row NDCG@max_position of each row's group (weighted mean by caller:
     pass w = 1/group_size on valid rows to get the mean over groups)."""
     s = scores.reshape(-1, S)
     yy = y.reshape(-1, S)
     mask = (w.reshape(-1, S) > 0)
-    gains = (jnp.exp2(yy) - 1.0) * mask
+    gains = _label_gains(yy, label_gain) * mask
     sm = jnp.where(mask, s, -jnp.inf)
     order = jnp.argsort(-sm, axis=1)
     ranks = jnp.argsort(order, axis=1)
@@ -229,7 +242,7 @@ def get_objective(name: str, num_class: int = 1, alpha: float = 0.9,
                   tweedie_variance_power: float = 1.5,
                   pos_weight: float = 1.0, group_size: int = 0,
                   max_position: int = 20, sigma: float = 1.0,
-                  **_metric_only) -> Objective:
+                  label_gain=None, **_metric_only) -> Objective:
     name = (name or "").lower()
     if name in ("binary", "logistic"):
         return _binary(pos_weight)
@@ -254,7 +267,7 @@ def get_objective(name: str, num_class: int = 1, alpha: float = 0.9,
     if name == "lambdarank":
         if group_size <= 0:
             raise ValueError("lambdarank requires group_size (padded group width)")
-        return _lambdarank(group_size, max_position, sigma)
+        return _lambdarank(group_size, max_position, sigma, label_gain)
     raise ValueError(f"unknown objective {name!r}")
 
 
@@ -278,7 +291,7 @@ SUPPORTED_EVAL_METRICS = {
 def eval_metric(objective: Objective, scores, y, w,
                 group_size: int = 0, max_position: int = 20,
                 eval_at: int = 0, metric: str = None,
-                **_unused) -> Tuple[str, jnp.ndarray]:
+                label_gain=None, **_unused) -> Tuple[str, jnp.ndarray]:
     """Per-objective eval metric (higher_is_better handled by caller).
 
     ``metric`` overrides the objective's default with another supported
@@ -315,7 +328,8 @@ def eval_metric(objective: Objective, scores, y, w,
         S = int(group_size)
         if scores.shape[0] < S or scores.shape[0] % S != 0:
             return "ndcg", jnp.float32(0.0)  # shape probe only
-        vals = _ndcg_metric(scores, y, w, S, eval_at or max_position)
+        vals = _ndcg_metric(scores, y, w, S, eval_at or max_position,
+                            label_gain)
         return "ndcg", jnp.sum(vals * w) / jnp.maximum(jnp.sum(w), 1e-12)
     if name == "binary":
         p = jnp.clip(jax.nn.sigmoid(scores), 1e-15, 1 - 1e-15)

@@ -78,6 +78,11 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
     useBarrierExecutionMode = Param(
         "useBarrierExecutionMode", "Ignored: SPMD gang scheduling is "
         "inherent on the mesh", False, TypeConverters.to_bool)
+    performanceStatistics = Param(
+        "performanceStatistics", "Accepted for reference parity: the "
+        "fitted model's get_performance_statistics() returns the same "
+        "TrainingStats table the reference stored under this param", None,
+        is_complex=True)
 
     def _parse_args(self) -> dict:
         """Map the supported subset of VW command-line args onto config."""
@@ -293,8 +298,22 @@ class VowpalWabbitClassifier(Estimator, _VowpalWabbitBaseParams,
 
     lossFunction = Param("lossFunction", "logistic or hinge", "logistic",
                          TypeConverters.to_string)
+    labelConversion = Param(
+        "labelConversion", "True (default): labels arrive as 0/1 and are "
+        "converted to VW's convention internally (reference: "
+        "VowpalWabbitClassifier labelConversion). False: labels are "
+        "already -1/+1", True, TypeConverters.to_bool)
 
     def fit(self, dataset: Dataset) -> "VowpalWabbitClassificationModel":
+        if not self.get_or_default("labelConversion"):
+            lab = self.get_or_default("labelCol")
+            y = np.asarray(dataset[lab], np.float32)
+            vals = set(np.unique(y).tolist())
+            if not vals <= {-1.0, 1.0}:
+                raise ValueError(
+                    "labelConversion=False expects -1/+1 labels; got "
+                    f"values {sorted(vals)[:5]}")
+            dataset = dataset.with_column(lab, (y + 1.0) / 2.0)
         cfg = self._sgd_config(self.get_or_default("lossFunction"))
         weights, stats = self._fit_weights(dataset, cfg)
         model = VowpalWabbitClassificationModel(weights, stats)

@@ -159,9 +159,27 @@ def _vote_policy(greedy_choices, mask, n_policies, smooth=0.0):
 
 class _ContextualBanditParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
     sharedCol = Param("sharedCol", "column of shared-context vectors", "shared")
+    additionalSharedFeatures = Param(
+        "additionalSharedFeatures", "Extra shared-context vector columns "
+        "concatenated onto sharedCol (reference: VowpalWabbitContextualBandit "
+        "additionalSharedFeatures)", None, TypeConverters.to_list_string)
     chosenActionCol = Param("chosenActionCol",
                             "1-based index of the logged action",
                             "chosenAction")
+
+    def _shared_block(self, dataset) -> np.ndarray:
+        """Shared-context matrix: sharedCol plus any
+        additionalSharedFeatures columns, concatenated feature-wise."""
+        cols = [self.get_or_default("sharedCol")]
+        cols += list(self.get_or_default("additionalSharedFeatures") or [])
+        blocks = []
+        for c in cols:
+            b = np.asarray(dataset[c], dtype=np.float32)
+            if b.ndim == 1:
+                b = b[:, None]
+            blocks.append(b)
+        return blocks[0] if len(blocks) == 1 else np.concatenate(blocks,
+                                                                 axis=1)
     probabilityCol = Param("probabilityCol",
                            "logged probability of the chosen action",
                            "probability")
@@ -230,10 +248,7 @@ class VowpalWabbitContextualBandit(Estimator, _ContextualBanditParams):
         from jax import lax
 
         self._validate(dataset)
-        shared = np.asarray(dataset[self.get_or_default("sharedCol")],
-                            dtype=np.float32)
-        if shared.ndim == 1:
-            shared = shared[:, None]
+        shared = self._shared_block(dataset)
         actions, mask = _stack_actions(
             dataset[self.get_or_default("featuresCol")])
         chosen = dataset.array(self.get_or_default("chosenActionCol")
@@ -429,10 +444,7 @@ class VowpalWabbitContextualBanditModel(Model, _ContextualBanditParams):
         wa = np.asarray(self.get_or_default("actionWeights"))
         if ws.ndim == 1:      # models saved before the ensemble layout
             ws, wa = ws[None, :], wa[None, :]
-        shared = np.asarray(dataset[self.get_or_default("sharedCol")],
-                            dtype=np.float32)
-        if shared.ndim == 1:
-            shared = shared[:, None]
+        shared = self._shared_block(dataset)
         actions, mask = _stack_actions(
             dataset[self.get_or_default("featuresCol")])
         policy = self.get_or_default("explorationPolicy")

@@ -43,6 +43,12 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
                      "--hash_seed; reference: VowpalWabbitBase hashSeed). "
                      "Train and score featurizers must agree", 0,
                      TypeConverters.to_int)
+    preserveOrderNumBits = Param(
+        "preserveOrderNumBits", "Reserve this many top bits to encode the "
+        "input column's position, so features of different columns cannot "
+        "collide and column order is recoverable from indices (reference: "
+        "VowpalWabbitFeaturizer preserveOrderNumBits; 0 = off)", 0,
+        TypeConverters.to_int)
 
     def _row_features(self, name: str, value, ns_hash: int, num_bits: int,
                       split: bool, prefix: bool) -> List[Tuple[int, float]]:
@@ -89,16 +95,34 @@ class VowpalWabbitFeaturizer(Transformer, HasInputCols, HasOutputCol):
         sum_coll = self.get_or_default("sumCollisions")
         # default namespace, seeded by hashSeed (VW --hash_seed)
         ns_hash = hash_namespace("", self.get_or_default("hashSeed"))
+        pon = int(self.get_or_default("preserveOrderNumBits") or 0)
+        if pon:
+            if pon >= num_bits:
+                raise ValueError(
+                    f"preserveOrderNumBits={pon} must be < numBits="
+                    f"{num_bits}")
+            if len(in_cols) > (1 << pon):
+                raise ValueError(
+                    f"preserveOrderNumBits={pon} encodes at most "
+                    f"{1 << pon} columns; got {len(in_cols)}")
+        low_bits = num_bits - pon
 
         n = len(dataset)
         per_row: List[List[Tuple[int, float]]] = [[] for _ in range(n)]
-        for col in in_cols:
+        for ci, col in enumerate(in_cols):
             data = dataset[col]
             is_split = col in split_cols
+            prefix_bits = ci << low_bits
             for i in range(n):
                 v = data[i] if not isinstance(data, np.ndarray) else data[i]
-                per_row[i].extend(self._row_features(col, v, ns_hash, num_bits,
-                                                     is_split, prefix))
+                feats = self._row_features(col, v, ns_hash, num_bits,
+                                           is_split, prefix)
+                if pon:
+                    # top bits carry the column position; hashes fold into
+                    # the remaining low bits
+                    feats = [(prefix_bits | (idx & ((1 << low_bits) - 1)),
+                              val) for idx, val in feats]
+                per_row[i].extend(feats)
 
         # collapse collisions, then pad to the max active-feature count
         nnz_max = 1

@@ -31,6 +31,16 @@ def _per_user_lists(ds: Dataset, userCol: str, itemCol: str,
     return out
 
 
+def _filter_min_counts(dataset, col: str, lo) -> "Dataset":
+    """Drop rows whose ``col`` value occurs fewer than ``lo`` times."""
+    if not lo or lo <= 1:
+        return dataset
+    vals = np.asarray(dataset[col])
+    uniq, counts = np.unique(vals, return_counts=True)
+    mask = np.isin(vals, uniq[counts >= lo])
+    return dataset.filter(mask) if not mask.all() else dataset
+
+
 class RankingEvaluator(Transformer):
     """Computes ranking metrics from (recommendations, ground-truth) datasets
     (reference: recommendation/RankingEvaluator.scala:15-152).
@@ -112,13 +122,29 @@ class RankingAdapter(Estimator):
     ratingCol = Param("ratingCol", "rating column", "rating", TypeConverters.to_string)
     minRatingsPerUser = Param("minRatingsPerUser", "drop users below this", 1,
                               TypeConverters.to_int)
+    minRatingsPerItem = Param("minRatingsPerItem", "drop items below this "
+                              "(reference: RankingAdapter "
+                              "minRatingsPerItem)", 1,
+                              TypeConverters.to_int)
 
     def __init__(self, recommender=None, **kwargs):
         super().__init__(**kwargs)
         if recommender is not None:
             self.set(recommender=recommender)
 
+    def _filtered(self, dataset: Dataset) -> Dataset:
+        # sequential: item counts are recomputed AFTER cold users leave,
+        # so surviving items honor their stated minimum on the rows that
+        # actually remain
+        dataset = _filter_min_counts(
+            dataset, self.get_or_default("userCol"),
+            self.get_or_default("minRatingsPerUser"))
+        return _filter_min_counts(
+            dataset, self.get_or_default("itemCol"),
+            self.get_or_default("minRatingsPerItem"))
+
     def fit(self, dataset: Dataset) -> "RankingAdapterModel":
+        dataset = self._filtered(dataset)
         fitted = self.get_or_default("recommender").fit(dataset)
         model = RankingAdapterModel(recommenderModel=fitted)
         self._copy_params_to(model)
@@ -170,7 +196,13 @@ class RankingTrainValidationSplit(Estimator):
     ratingCol = Param("ratingCol", "rating column", "rating", TypeConverters.to_string)
     minRatingsPerUser = Param("minRatingsPerUser", "drop users below this", 2,
                               TypeConverters.to_int)
+    minRatingsPerItem = Param("minRatingsPerItem", "drop items below this "
+                              "before splitting", 1, TypeConverters.to_int)
     seed = Param("seed", "random seed", 0, TypeConverters.to_int)
+    validationMetrics = Param("validationMetrics", "metrics of the fitted "
+                              "candidate on the validation split, set by "
+                              "fit() (reference: RankingTrainValidationSplit "
+                              "validationMetrics)", None, is_complex=True)
 
     def __init__(self, estimator=None, **kwargs):
         super().__init__(**kwargs)
@@ -180,6 +212,9 @@ class RankingTrainValidationSplit(Estimator):
     def split(self, dataset: Dataset):
         """Per-user stratified (train, validation) datasets."""
         ucol = self.get_or_default("userCol")
+        dataset = _filter_min_counts(
+            dataset, self.get_or_default("itemCol"),
+            self.get_or_default("minRatingsPerItem"))
         users = np.asarray(dataset[ucol])
         rng = np.random.default_rng(self.get_or_default("seed"))
         ratio = self.get_or_default("trainRatio")
@@ -203,4 +238,18 @@ class RankingTrainValidationSplit(Estimator):
         train, valid = self.split(dataset)
         fitted = self.get_or_default("estimator").fit(train)
         self.validation = valid  # exposed for evaluation
+        try:
+            # validationMetrics parity: when the candidate is a
+            # RankingAdapter, its model emits the (recommendations,
+            # labels) rows the evaluator consumes — score the held-out
+            # split with NDCG like the reference's default metric
+            scored = fitted.transform(valid)
+            k = (fitted.get_or_default("k")
+                 if any(p.name == "k" for p in fitted.params()) else 10)
+            self.set(validationMetrics=[float(RankingEvaluator(
+                metricName="ndcgAt", k=int(k)).evaluate(scored))])
+        except Exception:
+            # metric capture is best-effort (non-adapter candidates have
+            # no standard eval shape); fitting must not fail on it
+            self.set(validationMetrics=None)
         return fitted

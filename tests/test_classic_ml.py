@@ -350,3 +350,23 @@ def test_train_classifier_explicit_labels():
     acc = (np.asarray(out["prediction"]).astype(int)
            == np.asarray([0 if v > 0 else 1 for v in X[:, 0]])).mean()
     assert acc > 0.9, acc
+
+
+def test_tokenizer_gaps_and_actual_num_classes():
+    from mmlspark_tpu.featurize.text import Tokenizer
+    from mmlspark_tpu.models.gbdt.api import LightGBMClassifier
+
+    ds = Dataset({"t": ["a1b22c333"]})
+    gaps = Tokenizer(inputCol="t", outputCol="o", pattern=r"[0-9]+",
+                     gaps=True).transform(ds)
+    assert gaps["o"][0] == ["a", "b", "c"]
+    toks = Tokenizer(inputCol="t", outputCol="o", pattern=r"[0-9]+",
+                     gaps=False).transform(ds)
+    assert toks["o"][0] == ["1", "22", "333"]
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 3)).astype(np.float32)
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0)).astype(np.float64)
+    m = LightGBMClassifier(numIterations=3, numLeaves=7, maxBin=31).fit(
+        Dataset({"features": X, "label": y}))
+    assert m.get_actual_num_classes() == 3

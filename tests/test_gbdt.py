@@ -910,3 +910,23 @@ class TestBinnedDatasetCache:
         assert len(constructs) == n_after_direct + 2
         gbdt_api.clear_binned_dataset_cache()
         assert len(gbdt_api._BINNED_CACHE) == 0
+
+
+def test_ranker_label_gain():
+    """labelGain (reference LightGBMRanker labelGain): custom NDCG gains
+    train and evaluate; grades beyond the table fail fast (LightGBM
+    parity), and the tuple-ized kwargs stay program-cache hashable."""
+    rng = np.random.default_rng(0)
+    n = 400
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    rel = np.clip((X[:, 0] * 2 + rng.normal(size=n)).astype(int), 0, 2)
+    g = np.repeat(np.arange(n // 8), 8).astype(np.int64)
+    ds = _to_ds(X, rel.astype(np.float64), group=g)
+    from mmlspark_tpu.models.gbdt.api import LightGBMRanker
+    m = LightGBMRanker(numIterations=5, numLeaves=7, maxBin=31,
+                       groupCol="group",
+                       labelGain=[0.0, 1.0, 10.0]).fit(ds)
+    assert np.isfinite(m.booster.predict_raw(X)).all()
+    with pytest.raises(ValueError, match="relevance grade"):
+        LightGBMRanker(numIterations=2, groupCol="group",
+                       labelGain=[0.0]).fit(ds)

@@ -133,3 +133,27 @@ class TestRankingPipeline:
         users_valid = set(valid["user_idx"].tolist())
         # every user appears on both sides (stratified)
         assert users_train == users_valid == set(range(30))
+
+
+def test_split_validation_metrics_and_item_filter():
+    """Round-4 params: validationMetrics captured on fit with an adapter
+    candidate; minRatingsPerItem drops cold items before splitting."""
+    ds = _interactions()
+    split = RankingTrainValidationSplit(
+        estimator=RankingAdapter(recommender=SAR(supportThreshold=1), k=5),
+        trainRatio=0.7, seed=1)
+    split.fit(ds)
+    vm = split.get_or_default("validationMetrics")
+    assert vm is not None and len(vm) == 1 and 0.0 <= vm[0] <= 1.0
+
+    items = np.asarray(ds["item_idx"])
+    rare = items[0]
+    counts = {v: int((items == v).sum()) for v in set(items.tolist())}
+    lo = counts[rare] + 1
+    filt = RankingTrainValidationSplit(
+        estimator=RankingAdapter(recommender=SAR(supportThreshold=1), k=5),
+        trainRatio=0.7, seed=1, minRatingsPerItem=lo)
+    tr, va = filt.split(ds)
+    left = set(np.asarray(tr["item_idx"]).tolist()) | set(
+        np.asarray(va["item_idx"]).tolist())
+    assert all(counts[v] >= lo for v in left)

@@ -521,3 +521,59 @@ class TestNamespaceParams:
                                     ).transform(ds)
         VowpalWabbitClassifier(numPasses=1,
                                useBarrierExecutionMode=True).fit(ds)
+
+
+class TestRound4TailParams:
+    def test_label_conversion_false_accepts_pm1(self):
+        ds = _text_data()
+        feat = VowpalWabbitFeaturizer(inputCols=["text"],
+                                      stringSplitInputCols=["text"])
+        y01 = ds.array("label")
+        pm1 = Dataset({"text": list(ds["text"]),
+                       "label": y01 * 2.0 - 1.0})
+        m = VowpalWabbitClassifier(numPasses=3, labelConversion=False).fit(
+            feat.transform(pm1))
+        acc = (np.asarray(m.transform(feat.transform(pm1))["prediction"])
+               == y01).mean()
+        assert acc > 0.95
+        with pytest.raises(ValueError, match="-1"):
+            VowpalWabbitClassifier(labelConversion=False).fit(
+                feat.transform(ds))      # 0/1 labels under the pm1 contract
+
+    def test_preserve_order_num_bits(self):
+        ds = Dataset({"a": ["x", "y"], "b": ["x", "y"]})
+        f = VowpalWabbitFeaturizer(inputCols=["a", "b"],
+                                   prefixStringsWithColumnName=False,
+                                   numBits=18, preserveOrderNumBits=2)
+        out = f.transform(ds)
+        idx = out.array("features_indices")
+        shift = 18 - 2
+        # same token in different columns lands in different partitions
+        parts = idx >> shift
+        assert set(parts[:, 0].tolist()) | set(parts[:, 1].tolist()) == {0, 1}
+        with pytest.raises(ValueError, match="at most"):
+            VowpalWabbitFeaturizer(inputCols=["a", "b"],
+                                   preserveOrderNumBits=0).set(
+                preserveOrderNumBits=1, inputCols=["a", "b", "c"]).transform(
+                Dataset({"a": ["x"], "b": ["x"], "c": ["x"]}))
+
+    def test_bandit_additional_shared_features(self):
+        from mmlspark_tpu.models.vw.bandit import (
+            VowpalWabbitContextualBandit)
+
+        rng = np.random.default_rng(0)
+        n, k, d = 200, 3, 4
+        shared = rng.normal(size=(n, d)).astype(np.float32)
+        extra = rng.normal(size=(n, 2)).astype(np.float32)
+        actions = [np.eye(k, d, dtype=np.float32) for _ in range(n)]
+        chosen = rng.integers(1, k + 1, n)
+        cost = rng.random(n).astype(np.float32)
+        prob = np.full(n, 1.0 / k, np.float32)
+        ds = Dataset({"shared": shared, "extra": extra,
+                      "features": actions, "chosenAction": chosen,
+                      "label": cost.astype(np.float64),
+                      "probability": prob.astype(np.float64)})
+        m = VowpalWabbitContextualBandit(
+            additionalSharedFeatures=["extra"]).fit(ds)
+        out = m.transform(ds)
+        assert len(out["prediction"]) == n
