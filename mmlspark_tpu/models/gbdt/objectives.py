@@ -266,7 +266,16 @@ def get_objective(name: str, num_class: int = 1, alpha: float = 0.9,
         return _mape()
     if name == "lambdarank":
         if group_size <= 0:
-            raise ValueError("lambdarank requires group_size (padded group width)")
+            # scoring-only objective: a ranker model loaded from its text
+            # dump predicts raw scores without the training-time group
+            # layout; only an attempt to TRAIN with it errors
+            def _no_train(*_a, **_k):
+                raise ValueError(
+                    "lambdarank training requires group_size (padded "
+                    "group width); this objective instance is "
+                    "scoring-only")
+            return Objective("lambdarank", _no_train, lambda sc: sc, 1,
+                             lambda y, w: jnp.float32(0.0))
         return _lambdarank(group_size, max_position, sigma, label_gain)
     raise ValueError(f"unknown objective {name!r}")
 
@@ -304,6 +313,10 @@ def eval_metric(objective: Objective, scores, y, w,
     independently of the lambdarank training truncation ``max_position``.
     """
     name = objective.name
+    if name == "lambdarank" and int(group_size) <= 0:
+        raise ValueError(
+            "lambdarank training/evaluation requires group_size (padded "
+            "group width); a model loaded for scoring cannot train")
     if metric:
         if name == "binary" and metric == "binary_error":
             miss = ((scores > 0.0) != (y > 0.5)).astype(jnp.float32)

@@ -930,3 +930,14 @@ def test_ranker_label_gain():
     with pytest.raises(ValueError, match="relevance grade"):
         LightGBMRanker(numIterations=2, groupCol="group",
                        labelGain=[0.0]).fit(ds)
+
+
+def test_lambdarank_without_group_size_raises_clearly():
+    """A direct train_booster('lambdarank') without group_size must fail
+    with the actionable error, not a ZeroDivisionError from the metric
+    probe (scoring-only loaded rankers still predict fine)."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 3)).astype(np.float32)
+    y = rng.integers(0, 3, 64).astype(np.float32)
+    with pytest.raises(ValueError, match="group_size"):
+        train_booster(X, y, objective="lambdarank", num_iterations=2)

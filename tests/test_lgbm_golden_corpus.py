@@ -49,7 +49,7 @@ def test_golden_load_and_predict(name):
 
 def test_corpus_complete():
     assert set(NAMES) >= {"binary", "regression", "dart", "multiclass",
-                          "categorical"}, NAMES
+                          "categorical", "ranker"}, NAMES
 
 
 def test_emitted_models_reload_in_stock_lightgbm():

@@ -178,6 +178,13 @@ def main():
                  {"objective": "multiclass", "boosting": "gbdt"}),
           X, raw_sum(trees_mc, ntpi=3), softmax)
 
+    # lambdarank: same tree mechanics, ranking objective line — loaders
+    # must carry the objective through (raw scores only; no transform)
+    _emit("ranker",
+          _model("lambdarank", 1, 1, 1, [t0, t1],
+                 {"objective": "lambdarank", "boosting": "gbdt"}),
+          X, raw_sum([t0, t1]), lambda r: r[:, 0])
+
     # categorical: root split is a category-set membership (decision_type
     # bit 0), left set {1, 3, 34} across two 32-bit words
     tc = _tree(3, [0, 1], [8.0, 3.0], [0, 0.25], [1, 2], [-1, -2], [1, -3],
