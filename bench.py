@@ -267,13 +267,16 @@ def _run_leg(on_tpu: bool) -> None:
         "vs_baseline": round(trees_per_sec / BASELINE_TREES_PER_SEC, 3),
         "platform": "tpu" if on_tpu else "cpu-fallback",
     }
+    def _partial(note: str, **extra) -> None:
+        # snapshot lines share the primary dict and the last-line-wins
+        # convention; the full line at the end supersedes them all
+        print(json.dumps(dict(primary, **extra, partial=note)), flush=True)
+
     # Publish the primary-only line IMMEDIATELY: if this leg is killed
     # while a secondary compiles (cold cache on a slow box — the shape of
-    # two lost rounds), the real headline number still stands. The full
-    # line printed at the end supersedes it (last line wins).
-    print(json.dumps(dict(primary, partial="primary only; superseded by "
-                          "the full line when all secondaries finish")),
-          flush=True)
+    # two lost rounds), the real headline number still stands.
+    _partial("primary only; superseded by the full line when all "
+             "secondaries finish")
 
     # secondary GBDT configs (fewer iterations: they share the warm compile
     # cache and only need a rate, not a long soak):
@@ -314,6 +317,13 @@ def _run_leg(on_tpu: bool) -> None:
     leafwise_best63_tps = _rate(ds63, cfg_over=dict(
         growth_policy="leafwise", hist_subtraction=True,
         quantized_grad=True))
+    # second snapshot: the leafwise-vs-depthwise story is the round's
+    # acceptance criterion — publish it the moment it exists so a timeout
+    # in the remaining secondaries cannot lose it
+    _partial("primary + leafwise; superseded by the full line",
+             leafwise_trees_per_sec=leafwise_tps,
+             leafwise_best_trees_per_sec=leafwise_best_tps,
+             leafwise_best63_trees_per_sec=leafwise_best63_tps)
     maxbin63_tps = _rate(ds63)
     # int8 quantized-gradient histograms (2x-rate MXU path) at both widths
     quant_tps = _rate(ds, cfg_over=dict(quantized_grad=True))
