@@ -323,7 +323,24 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         in_col = self.get_or_default("inputCol")
         out_col = self.get_or_default("outputCol") or "features"
         imgs = dataset[in_col]
-        keep = np.asarray([i for i, v in enumerate(imgs) if v is not None],
+
+        def _present(v) -> bool:
+            # Missing = None (DecodeImage's failure value) OR a decoded-but-
+            # garbage array: empty, or containing non-finite pixels (any NaN
+            # or inf pixel propagates through the conv stack and poisons the
+            # whole feature vector, so partially-bad counts as missing too).
+            # Without this a NaN-filled array would bypass dropNa and be
+            # featurized as garbage.
+            if v is None:
+                return False
+            a = np.asarray(v)
+            if a.size == 0:
+                return False
+            if a.dtype.kind == "f" and not np.isfinite(a).all():
+                return False
+            return True
+
+        keep = np.asarray([i for i, v in enumerate(imgs) if _present(v)],
                           dtype=np.int64)
         if len(keep) == 0:
             # nothing featurizable: empty dataset under dropNa, or
@@ -331,19 +348,13 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
             if self.get_or_default("dropNa"):
                 return dataset.take(keep).with_column(out_col, [])
             return dataset.with_column(out_col, [None] * len(dataset))
-        if len(keep) != len(dataset):
-            if self.get_or_default("dropNa"):
-                # reference ImageFeaturizer dropNa: undecodable rows leave
-                # the dataset entirely
-                dataset = dataset.take(keep)
-            else:
-                # keep row alignment: featurize the valid rows, reinsert
-                # None outputs at the missing positions
-                feats = self.transform(dataset.take(keep))[out_col]
-                outs: List[Any] = [None] * len(dataset)
-                for j, i in enumerate(keep):
-                    outs[int(i)] = feats[j]
-                return dataset.with_column(out_col, outs)
+        missing = len(keep) != len(dataset)
+        if missing and self.get_or_default("dropNa"):
+            # reference ImageFeaturizer dropNa: undecodable rows leave
+            # the dataset entirely
+            dataset = dataset.take(keep)
+            missing = False
+        valid = dataset.take(keep) if missing else dataset
         h, w = self.input_hw
         prep = (ImageTransformer()
                 .set(inputCol=in_col, outputCol="_img_prepped")
@@ -364,7 +375,16 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         dnn = self._dnn_clone.set(
             inputCol="_img_prepped", outputCol=out_col, outputNode=node,
             miniBatchSize=self.get_or_default("miniBatchSize"))
-        return dnn.transform(prep.transform(dataset)).drop("_img_prepped")
+        out = dnn.transform(prep.transform(valid)).drop("_img_prepped")
+        if not missing:
+            return out
+        # dropNa=False with gaps: featurized the valid subset once (no
+        # re-scan), reinsert None outputs at the missing positions
+        feats = out[out_col]
+        outs: List[Any] = [None] * len(dataset)
+        for j, i in enumerate(keep):
+            outs[int(i)] = feats[j]
+        return dataset.with_column(out_col, outs)
 
     def _save_extra(self, path: str) -> None:
         from ...core.pipeline import save_stage

@@ -213,7 +213,7 @@ class LightGBMDataset:
                   bin_sample_count: int = 200_000, seed: int = 0,
                   categorical_features=(), mesh: Optional[Mesh] = None,
                   row_valid: Optional[np.ndarray] = None,
-                  bin_dtype="int32", path=None, label_path=None,
+                  bin_dtype=None, path=None, label_path=None,
                   weight_path=None, chunk_rows: Optional[int] = None,
                   max_bin_by_feature=None,
                   _timer: Optional[_PhaseTimer] = None) -> "LightGBMDataset":
@@ -237,11 +237,11 @@ class LightGBMDataset:
                 raise ValueError("row_valid is not supported with path= "
                                  "(ranker group padding is in-memory only)")
             from .ingest import construct_from_files
-            # out-of-core is the large-n regime: narrow the default bin
-            # storage to uint8 when max_bin allows (explicit non-default
-            # bin_dtype is honored as given)
-            if bin_dtype == "int32" and max_bin <= 256:
-                bin_dtype = "uint8"
+            # out-of-core is the large-n regime: default bin storage narrows
+            # to uint8 when max_bin allows; an explicit bin_dtype (including
+            # 'int32') is honored as given.
+            if bin_dtype is None:
+                bin_dtype = "uint8" if max_bin <= 256 else "int32"
             _validate_bin_dtype(bin_dtype, max_bin)
             return construct_from_files(
                 path, label_path, weight_path, max_bin=max_bin,
@@ -265,7 +265,8 @@ class LightGBMDataset:
             raise ValueError(
                 f"categorical_features indexes {bad_cats} out of range for "
                 f"{F} features")
-        bd = _validate_bin_dtype(bin_dtype, max_bin)
+        bd = _validate_bin_dtype("int32" if bin_dtype is None else bin_dtype,
+                                 max_bin)
         binner = QuantileBinner(max_bin, bin_sample_count, seed,
                                 categorical_features,
                                 max_bin_by_feature).fit(X)
@@ -825,7 +826,7 @@ def train_booster(
     checkpoint_dir: Optional[str] = None,
     checkpoint_period: int = 10,
     categorical_features=(),
-    bin_dtype="int32",
+    bin_dtype=None,
     pos_bagging_fraction: float = 1.0,
     neg_bagging_fraction: float = 1.0,
     early_stopping_tolerance: float = 0.0,

@@ -55,9 +55,13 @@ class _NpyShard:
             if version == (1, 0):
                 shape, fortran, dtype = \
                     np.lib.format.read_array_header_1_0(f)
-            else:
+            elif version in ((2, 0), (3, 0)):
+                # 2.0 and 3.0 share the header layout (3.0 = utf8 names)
                 shape, fortran, dtype = \
                     np.lib.format.read_array_header_2_0(f)
+            else:
+                raise ValueError(
+                    f"{path}: unsupported .npy format version {version}")
             self.data_offset = f.tell()
         if fortran:
             raise ValueError(f"{path}: Fortran-order .npy not supported")
@@ -237,6 +241,11 @@ def csv_to_shards(csv_path: PathLike, out_dir: PathLike, *,
     """
     from ...native import csv_read_floats
 
+    if weight_col is not None and weight_col == label_col:
+        raise ValueError(
+            f"weight_col ({weight_col}) must differ from label_col: the "
+            "shared column would be dropped from features once and written "
+            "to both y/ and w/, silently training with weights == labels")
     out_dir = os.fspath(out_dir)
     xdir = os.path.join(out_dir, "x")
     ydir = os.path.join(out_dir, "y")

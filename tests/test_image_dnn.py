@@ -361,6 +361,13 @@ def test_image_featurizer_drop_na(tiny_cnn):
     assert list(all_none["f"]) == [None, None]
     np.testing.assert_allclose(np.asarray(kept["f"][0]),
                                np.asarray(dropped["f"][0]), rtol=1e-5)
+    # decoded-but-garbage arrays (NaN pixels, empty) count as missing too:
+    # they must not slip past dropNa and be featurized as garbage
+    nan_img = np.full((16, 16, 3), np.nan, dtype=np.float32)
+    weird = [good, nan_img, np.zeros((0, 0, 3), np.float32)]
+    assert len(feat.set(dropNa=True).transform(Dataset({"img": weird}))) == 1
+    kept2 = feat.set(dropNa=False).transform(Dataset({"img": weird}))
+    assert kept2["f"][1] is None and kept2["f"][2] is None
 
 
 def test_unroll_and_resize_nchannels():

@@ -256,10 +256,39 @@ class TestCsvToShards:
         assert src.n == 2 and src.num_features == 2 and wdir is None
         with pytest.raises(ValueError, match="out of range"):
             csv_to_shards(p, tmp_path / "s2", label_col=5)
+        with pytest.raises(ValueError, match="must differ"):
+            csv_to_shards(p, tmp_path / "s4", label_col=2, weight_col=2)
         empty = tmp_path / "empty.csv"
         empty.write_text("a,b,c\n")
         with pytest.raises(ValueError, match="no data rows"):
             csv_to_shards(empty, tmp_path / "s3", label_col=0)
+
+    def test_npy_unknown_version_rejected(self, tmp_path):
+        from mmlspark_tpu.models.gbdt.ingest import _NpyShard
+
+        good = tmp_path / "a.npy"
+        np.save(good, np.zeros((3, 2), dtype=np.float32))
+        raw = bytearray(good.read_bytes())
+        raw[6:8] = bytes([9, 0])              # forge format version 9.0
+        bad = tmp_path / "bad.npy"
+        bad.write_bytes(bytes(raw))
+        with pytest.raises(ValueError, match="unsupported .npy format"):
+            _NpyShard(str(bad))
+
+    def test_explicit_int32_bin_dtype_honored_out_of_core(self, tmp_path):
+        xdir = tmp_path / "x"; ydir = tmp_path / "y"
+        xdir.mkdir(); ydir.mkdir()
+        rng = np.random.default_rng(0)
+        np.save(xdir / "part-0.npy",
+                rng.normal(size=(64, 3)).astype(np.float32))
+        np.save(ydir / "part-0.npy",
+                rng.integers(0, 2, 64).astype(np.float32))
+        ds = LightGBMDataset.construct(path=xdir, label_path=ydir,
+                                       max_bin=15, bin_dtype="int32")
+        assert np.asarray(ds.Xbt_d).dtype == np.int32
+        ds8 = LightGBMDataset.construct(path=xdir, label_path=ydir,
+                                        max_bin=15)
+        assert np.asarray(ds8.Xbt_d).dtype == np.uint8   # default narrows
 
     def test_rerun_clears_stale_shards_and_exact_shard_rows(self, tmp_path):
         from mmlspark_tpu.models.gbdt.ingest import csv_to_shards
