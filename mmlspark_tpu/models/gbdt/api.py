@@ -213,6 +213,17 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
         "useQuantizedGrad", "Quantized-gradient histograms (LightGBM "
         "use_quantized_grad): int8 grad/hess with stochastic rounding ride "
         "the 2x-rate int8 MXU path", False, TypeConverters.to_bool)
+    quantRenewLeaf = Param(
+        "quantRenewLeaf", "With useQuantizedGrad: renew leaf outputs from "
+        "the original f32 grad/hess after each quantized tree (LightGBM "
+        "quant_train_renew_leaf) so leaf values carry no int8 error",
+        True, TypeConverters.to_bool)
+    quantWarmupIters = Param(
+        "quantWarmupIters", "With useQuantizedGrad: run the first k "
+        "boosting iterations at full precision before switching to int8 "
+        "histograms — stabilizes early split selection on targets whose "
+        "root-level gains are near zero (pure interactions)", 2,
+        TypeConverters.to_int)
     binDtype = Param(
         "binDtype", "Storage dtype of the device-resident binned matrix: "
         "int32 (default), int16 or uint8. Bin ids are < maxBin, so narrow "
@@ -303,6 +314,8 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
             growth_policy=self.get_or_default("growthPolicy"),
             leaf_batch=self.get_or_default("leafBatch"),
             quantized_grad=self.get_or_default("useQuantizedGrad"),
+            quant_renew_leaf=self.get_or_default("quantRenewLeaf"),
+            quant_warmup_iters=self.get_or_default("quantWarmupIters"),
             hist_subtraction=self.get_or_default("histSubtraction"),
             compact_selector=self.get_or_default("compactSelector"),
             max_delta_step=self.get_or_default("maxDeltaStep"),

@@ -781,6 +781,30 @@ def _fused_es_scan(one_iter, state0, num_iterations: int,
     return buf, mbuf, it, best_it
 
 
+def _grow_with_warmup(grow, it_scalar, cfg, qk, binned_t, grad_k, hess_k,
+                      row_mask, fmask, *, axis_name, is_cat):
+    """Dispatch one tree growth honoring ``quant_warmup_iters``: iterations
+    below the warmup count grow at full precision, later ones ride the int8
+    quantized-histogram path (GrowConfig.quant_warmup_iters rationale). Both
+    variants live in ONE ``lax.cond`` so the fused scans and the
+    early-stopping while_loop keep their traced iteration index; the
+    predicate derives from the replicated scan counter, so the branch cannot
+    diverge across shards."""
+    if not cfg.quantized_grad:
+        return grow(binned_t, grad_k, hess_k, row_mask, fmask, cfg,
+                    axis_name=axis_name, is_cat=is_cat, qkey=None)
+    if cfg.quant_warmup_iters <= 0:
+        return grow(binned_t, grad_k, hess_k, row_mask, fmask, cfg,
+                    axis_name=axis_name, is_cat=is_cat, qkey=qk)
+    fp_cfg = cfg._replace(quantized_grad=False)
+    return lax.cond(
+        it_scalar < cfg.quant_warmup_iters,
+        lambda: grow(binned_t, grad_k, hess_k, row_mask, fmask, fp_cfg,
+                     axis_name=axis_name, is_cat=is_cat, qkey=None),
+        lambda: grow(binned_t, grad_k, hess_k, row_mask, fmask, cfg,
+                     axis_name=axis_name, is_cat=is_cat, qkey=qk))
+
+
 def _grow_axis_for(mesh, cfg) -> "str | None":
     """Collective axis for tree growth: None on a single-shard data axis so
     depthwise histogram subtraction (single-device only) can engage — psum
@@ -1125,8 +1149,9 @@ def train_booster(
                    vscores, key, bag_key, it_f):
         """One boosting iteration on local shard rows (inside shard_map).
 
-        ``it_f``: f32 iteration index — used only by rf, whose validation
-        metric evaluates the *average* of the trees grown so far.
+        ``it_f``: f32 iteration index — gates the quantized-gradient warmup
+        cond (``_grow_with_warmup``), and rf's validation metric evaluates
+        the *average* of the trees grown so far.
         """
         if K > 1:
             grad, hess = obj.grad_hess(scores, yl, wl)
@@ -1178,11 +1203,10 @@ def train_booster(
         grow = (grow_tree_depthwise if cfg.growth_policy == "depthwise"
                 else grow_tree)
         for k in range(K):
-            tree, row_node = grow(binned_t, grad[:, k], hess[:, k], row_mask,
-                                  fmask, cfg, axis_name=grow_axis,
-                                  is_cat=is_cat_j,
-                                  qkey=(jax.random.fold_in(key, 13 + k)
-                                        if cfg.quantized_grad else None))
+            tree, row_node = _grow_with_warmup(
+                grow, it_f, cfg, jax.random.fold_in(key, 13 + k),
+                binned_t, grad[:, k], hess[:, k], row_mask, fmask,
+                axis_name=grow_axis, is_cat=is_cat_j)
             if not is_rf:
                 # rf: trees are independent (gradients stay at the base
                 # score); gbdt/goss: boost on the updated margin
@@ -1570,11 +1594,10 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
             fmask = (u < feature_fraction).at[jnp.argmin(u)].set(True)
         trees_out, new_contrib = [], []
         for k in range(K):
-            tree, row_node = grow(binned_t, grad[:, k], hess[:, k], row_mask,
-                                  fmask, cfg, axis_name=grow_axis,
-                                  is_cat=is_cat_j,
-                                  qkey=(jax.random.fold_in(key, 13 + k)
-                                        if cfg.quantized_grad else None))
+            tree, row_node = _grow_with_warmup(
+                grow, it_i, cfg, jax.random.fold_in(key, 13 + k),
+                binned_t, grad[:, k], hess[:, k], row_mask, fmask,
+                axis_name=grow_axis, is_cat=is_cat_j)
             new_contrib.append(tree.leaf_value[row_node])
             trees_out.append(tree)
         nc = jnp.stack(new_contrib, axis=1)                # [n_local, K]
