@@ -22,6 +22,22 @@ from ..core.dataset import Dataset
 from ..core.params import Param, TypeConverters
 from ..core.pipeline import Estimator, Model
 
+# Above this many user x item cells, fit() switches from the dense in-memory
+# formulation to sparse CSR (the reference stays sparse in DataFrames
+# throughout — SAR.scala:38-258; the dense path is kept below the threshold
+# because it rides single device matmuls with zero indexing overhead).
+# ~50M f32 cells = 200 MB per matrix.
+DENSE_CELLS_MAX = 50_000_000
+
+
+def _sparse():
+    import scipy.sparse as sp
+    return sp
+
+
+def _is_sparse_mat(x) -> bool:
+    return x is not None and not isinstance(x, np.ndarray) and hasattr(x, "tocsr")
+
 
 class RecommendationIndexer(Estimator):
     """String user/item ids -> dense indices and back
@@ -120,6 +136,17 @@ class SAR(Estimator):
             half_life_s = self.get_or_default("timeDecayCoeff") * 86400.0
             decay = np.exp2(-(t_ref - t) / half_life_s).astype(np.float32)
             r = r * decay
+        thresh = self.get_or_default("supportThreshold")
+        sim_fn = self.get_or_default("similarityFunction")
+        if sim_fn not in ("cooccurrence", "jaccard", "lift"):
+            raise ValueError(f"unknown similarityFunction {sim_fn!r}")
+
+        if n_users * n_items > DENSE_CELLS_MAX:
+            model = self._fit_sparse(u, it, r, n_users, n_items, sim_fn,
+                                     thresh)
+            self._copy_params_to(model)
+            return model
+
         affinity = np.zeros((n_users, n_items), np.float32)
         np.add.at(affinity, (u, it), r)
 
@@ -130,23 +157,51 @@ class SAR(Estimator):
         cooc = np.asarray(seen_d.T @ seen_d)  # [I, I]
         occ = np.diag(cooc).copy()
 
-        thresh = self.get_or_default("supportThreshold")
-        sim_fn = self.get_or_default("similarityFunction")
         if sim_fn == "cooccurrence":
             sim = cooc.copy()
         elif sim_fn == "jaccard":
             denom = occ[:, None] + occ[None, :] - cooc
             sim = cooc / np.maximum(denom, 1e-9)
-        elif sim_fn == "lift":
+        else:  # lift
             sim = cooc / np.maximum(occ[:, None] * occ[None, :], 1e-9)
-        else:
-            raise ValueError(f"unknown similarityFunction {sim_fn!r}")
         sim = np.where(cooc >= thresh, sim, 0.0).astype(np.float32)
 
         model = SARModel(itemSimilarity=sim, userAffinity=affinity,
                          seen=seen.astype(bool))
         self._copy_params_to(model)
         return model
+
+    def _fit_sparse(self, u, it, r, n_users: int, n_items: int,
+                    sim_fn: str, thresh: float) -> "SARModel":
+        """CSR formulation for beyond-RAM-dense scales: affinity and seen
+        stay sparse, the co-occurrence is one SpGEMM (S^T S), and the
+        similarity transform runs on the nonzero COO entries only. Matches
+        the dense path exactly on shared cells (pinned in tests); cells
+        the dense path stores as explicit 0 simply don't exist here."""
+        sp = _sparse()
+        aff = sp.coo_matrix((r, (u, it)), shape=(n_users, n_items),
+                            dtype=np.float32).tocsr()
+        ones = np.ones(len(u), np.float32)
+        seen = sp.coo_matrix((ones, (u, it)), shape=(n_users, n_items),
+                             dtype=np.float32).tocsr()
+        seen.data[:] = 1.0                       # binarize duplicate events
+        cooc = (seen.T @ seen).tocoo()           # [I, I], sparse SpGEMM
+        occ = np.zeros(n_items, np.float32)
+        diag = cooc.row == cooc.col
+        occ[cooc.row[diag]] = cooc.data[diag]
+
+        data, row, col = cooc.data, cooc.row, cooc.col
+        keep = data >= thresh
+        data, row, col = data[keep], row[keep], col[keep]
+        if sim_fn == "cooccurrence":
+            sim_data = data
+        elif sim_fn == "jaccard":
+            sim_data = data / np.maximum(occ[row] + occ[col] - data, 1e-9)
+        else:  # lift
+            sim_data = data / np.maximum(occ[row] * occ[col], 1e-9)
+        sim = sp.csr_matrix((sim_data.astype(np.float32), (row, col)),
+                            shape=(n_items, n_items))
+        return SARModel(itemSimilarity=sim, userAffinity=aff, seen=seen)
 
 
 class SARModel(Model):
@@ -180,15 +235,53 @@ class SARModel(Model):
                 f"{int(bad_u.sum())} users / {int(bad_i.sum())} items are "
                 f"outside the trained range ({n_users} users, {n_items} "
                 "items); index with the same RecommendationIndexer used for fit")
+        out_col = self.get_or_default("predictionCol")
+        if _is_sparse_mat(self.userAffinity):
+            # sparse scale: per-pair dot = elementwise product of the user's
+            # affinity row and the item's similarity column, both sparse.
+            # The CSC view is cached — rebuilding it is O(nnz) and would
+            # dominate small-batch scoring.
+            if getattr(self, "_sim_csc", None) is None:
+                self._sim_csc = self.itemSimilarity.tocsc()
+            aff_rows = self.userAffinity[u]                       # [n, I]
+            sim_cols = self._sim_csc[:, it].T                     # [n, I]
+            scores = np.asarray(
+                aff_rows.multiply(sim_cols).sum(axis=1)).ravel()
+            return dataset.with_column(out_col, scores.astype(np.float64))
         aff = jnp.asarray(self.userAffinity)[jnp.asarray(u)]        # [n, I]
         sim = jnp.asarray(self.itemSimilarity)[:, jnp.asarray(it)]  # [I, n]
         scores = jnp.sum(aff * sim.T, axis=1)
-        return dataset.with_column(self.get_or_default("predictionCol"),
-                                   np.asarray(scores, np.float64))
+        return dataset.with_column(out_col, np.asarray(scores, np.float64))
 
     def recommend_for_all_users(self, k: int) -> Dataset:
         """Top-k unseen items per user (reference: SARModel.scala:23-169).
-        One device matmul + top_k."""
+        Dense: one device matmul + top_k. Sparse scale: user-blocked
+        SpGEMM (aff_block @ sim stays sparse) with per-block device top_k
+        on the densified [block, I] result — HBM holds one block, never
+        the users x items matrix."""
+        ucol = self.get_or_default("userCol")
+        if _is_sparse_mat(self.userAffinity):
+            n_users, n_items = self.userAffinity.shape
+            k = min(k, n_items)
+            remove = self.get_or_default("removeSeenItems")
+            block = max(1, min(n_users, 33_554_432 // max(n_items, 1)))
+            ids_out, vals_out = [], []
+            for lo in range(0, n_users, block):
+                hi = min(lo + block, n_users)
+                sb = (self.userAffinity[lo:hi] @ self.itemSimilarity)
+                dense = np.asarray(sb.todense(), np.float32)
+                if remove:
+                    seen_b = self.seen[lo:hi].tocoo()
+                    dense[seen_b.row, seen_b.col] = -np.inf
+                vals, ids = jax.lax.top_k(jnp.asarray(dense), k)
+                ids_out.append(np.asarray(ids))
+                vals_out.append(np.asarray(vals))
+            return Dataset({
+                ucol: np.arange(n_users, dtype=np.int32),
+                "recommendations": list(np.concatenate(ids_out)),
+                "ratings": list(
+                    np.concatenate(vals_out).astype(np.float64)),
+            })
         aff = jnp.asarray(self.userAffinity)
         sim = jnp.asarray(self.itemSimilarity)
         scores = aff @ sim
@@ -197,7 +290,7 @@ class SARModel(Model):
         k = min(k, scores.shape[1])
         vals, ids = jax.lax.top_k(scores, k)
         return Dataset({
-            self.get_or_default("userCol"): np.arange(scores.shape[0], dtype=np.int32),
+            ucol: np.arange(scores.shape[0], dtype=np.int32),
             "recommendations": list(np.asarray(ids)),
             "ratings": list(np.asarray(vals).astype(np.float64)),
         })
@@ -206,12 +299,39 @@ class SARModel(Model):
 
     def _save_extra(self, path):
         import os
+        # clear the OTHER format's files: saving over a directory that held
+        # the previous format must not leave a stale model that _load_extra
+        # would prefer
+        sparse_files = ("sar_sim.npz", "sar_aff.npz", "sar_seen.npz")
+        if _is_sparse_mat(self.userAffinity):
+            dense_f = os.path.join(path, "sar.npz")
+            if os.path.exists(dense_f):
+                os.unlink(dense_f)
+            sp = _sparse()
+            sp.save_npz(os.path.join(path, "sar_sim.npz"),
+                        self.itemSimilarity.tocsr())
+            sp.save_npz(os.path.join(path, "sar_aff.npz"),
+                        self.userAffinity.tocsr())
+            sp.save_npz(os.path.join(path, "sar_seen.npz"),
+                        self.seen.tocsr())
+            return
+        for f in sparse_files:
+            if os.path.exists(os.path.join(path, f)):
+                os.unlink(os.path.join(path, f))
         np.savez_compressed(os.path.join(path, "sar.npz"),
                             sim=self.itemSimilarity, aff=self.userAffinity,
                             seen=self.seen)
 
     def _load_extra(self, path):
         import os
-        z = np.load(os.path.join(path, "sar.npz"))
-        self.itemSimilarity, self.userAffinity = z["sim"], z["aff"]
-        self.seen = z["seen"]
+        dense = os.path.join(path, "sar.npz")
+        if os.path.exists(dense):
+            z = np.load(dense)
+            self.itemSimilarity, self.userAffinity = z["sim"], z["aff"]
+            self.seen = z["seen"]
+            return
+        sp = _sparse()
+        self.itemSimilarity = sp.load_npz(os.path.join(path, "sar_sim.npz"))
+        self.userAffinity = sp.load_npz(os.path.join(path, "sar_aff.npz"))
+        self.seen = sp.load_npz(os.path.join(path, "sar_seen.npz"))
+        self._sim_csc = None            # invalidate any cached CSC view
