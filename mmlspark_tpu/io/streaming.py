@@ -1,0 +1,176 @@
+"""Streamed scoring over disk shards — larger-than-RAM inference.
+
+Generalizes the out-of-core ingest recipe (``models/gbdt/ingest.py``) to the
+scoring direction: bounded host chunks → device batches → streamed output
+shards. On Spark every reference stage streams partitions for free
+(reference: io/binary/BinaryFileReader.scala:20 streamed reads feeding
+mapPartitions scorers); here the streaming is an explicit loop and the device
+math is unchanged — each chunk is scored by the SAME transform/predict code
+the in-memory path uses, so streamed outputs are pinned equal to in-memory
+outputs by construction.
+
+Entry points:
+- :func:`stream_apply` — the generic bounded-chunk map over a
+  :class:`~mmlspark_tpu.models.gbdt.ingest.ShardedMatrixSource`.
+- :meth:`Booster.predict_streamed <mmlspark_tpu.models.gbdt.booster.Booster>`
+  (defined here, attached there) — GBDT scoring from ``.npy`` shards.
+- :func:`stream_transform` — any single-input column Transformer
+  (DNNModel, ImageFeaturizer on decoded arrays) over array shards.
+- :func:`stream_featurize_images` — ImageFeaturizer over a directory of
+  encoded image files, batched through host decode.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Union
+
+import numpy as np
+
+from ..models.gbdt.ingest import PathLike, ShardedMatrixSource
+
+
+def _as_source(source) -> ShardedMatrixSource:
+    if isinstance(source, ShardedMatrixSource):
+        return source
+    return ShardedMatrixSource(source)
+
+
+def stream_apply(source: Union[PathLike, ShardedMatrixSource],
+                 fn: Callable[[np.ndarray], np.ndarray], *,
+                 chunk_rows: int = 65_536,
+                 out_dir: Optional[PathLike] = None,
+                 prefix: str = "part") -> Union[np.ndarray, List[str]]:
+    """Apply ``fn(chunk [m, ...]) -> [m, ...]`` over a sharded source in
+    bounded row chunks (offset reads — one chunk resident at a time).
+
+    With ``out_dir`` each chunk's output is written as one ``.npy`` shard
+    (a valid source for further streamed stages) and the shard paths are
+    returned; without it, outputs are concatenated — appropriate when the
+    output is much smaller than the input (e.g. ``[n]`` scores from
+    ``[n, F]`` features).
+    """
+    if chunk_rows <= 0:
+        raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
+    src = _as_source(source)
+    paths: List[str] = []
+    outs: List[np.ndarray] = []
+    if out_dir is not None:
+        out_dir = os.fspath(out_dir)
+        src_dirs = {os.path.realpath(os.path.dirname(p))
+                    for p in src.paths}
+        if os.path.realpath(out_dir) in src_dirs:
+            raise ValueError(
+                f"out_dir {out_dir!r} contains the input shards — the "
+                "stale-shard cleanup would delete the source before it is "
+                "read; write outputs to a separate directory")
+        os.makedirs(out_dir, exist_ok=True)
+        for stale in os.listdir(out_dir):
+            # a previous run's shards must not mix into this run's output
+            if stale.startswith(f"{prefix}-") and stale.endswith(".npy"):
+                os.unlink(os.path.join(out_dir, stale))
+    for i, lo in enumerate(range(0, src.n, chunk_rows)):
+        out = np.asarray(fn(src.read(lo, min(lo + chunk_rows, src.n))))
+        if out_dir is not None:
+            p = os.path.join(out_dir, f"{prefix}-{i:05d}.npy")
+            np.save(p, out)
+            paths.append(p)
+        else:
+            outs.append(out)
+    if out_dir is not None:
+        return paths
+    return (np.concatenate(outs, axis=0) if outs
+            else np.zeros((0,), np.float32))
+
+
+def stream_transform(stage, source: Union[PathLike, ShardedMatrixSource], *,
+                     chunk_rows: int = 8_192,
+                     out_dir: Optional[PathLike] = None,
+                     input_col: Optional[str] = None,
+                     output_col: Optional[str] = None):
+    """Run a single-input-column Transformer (DNNModel, ImageFeaturizer on
+    decoded arrays, ...) over array shards in bounded chunks.
+
+    Each chunk is wrapped as a one-column Dataset and scored by the stage's
+    own ``transform`` — streamed outputs equal in-memory outputs by
+    construction. Returns concatenated outputs, or shard paths with
+    ``out_dir``.
+    """
+    from ..core.dataset import Dataset
+
+    in_col = input_col or stage.get_or_default("inputCol")
+    out_col = (output_col or stage.get_or_default("outputCol")
+               or "output")
+
+    def score(chunk: np.ndarray) -> np.ndarray:
+        scored = stage.transform(Dataset({in_col: chunk}))[out_col]
+        return scored if isinstance(scored, np.ndarray) else np.stack(
+            [np.asarray(v) for v in scored])
+
+    return stream_apply(source, score, chunk_rows=chunk_rows,
+                        out_dir=out_dir)
+
+
+def stream_featurize_images(featurizer, image_dir: str, *,
+                            batch_files: int = 256,
+                            out_dir: Optional[PathLike] = None,
+                            recursive: bool = True,
+                            sample_ratio: float = 1.0, seed: int = 0):
+    """ImageFeaturizer over a DIRECTORY of encoded images, never holding
+    more than ``batch_files`` decoded images: files stream through the host
+    decoder (reference: BinaryFileReader.scala:20 / ImageReader) in bounded
+    batches, each batch rides the featurizer's device path.
+
+    Returns ``(paths, features)`` — or ``(paths, shard_paths)`` with
+    ``out_dir``. Undecodable files are skipped (dropNa semantics) and do
+    not appear in ``paths``.
+    """
+    from ..core.dataset import Dataset
+    from ..image.ops import decode_image
+    from .binary import _iter_files, read_binary_file
+
+    if batch_files <= 0:
+        raise ValueError(f"batch_files must be positive, got {batch_files}")
+    featurizer = featurizer.copy({}).set(dropNa=True, inputCol="_img")
+    out_col = featurizer.get_or_default("outputCol") or "features"
+    shard_paths: List[str] = []
+    feats: List[np.ndarray] = []
+    kept_paths: List[str] = []
+    if out_dir is not None:
+        out_dir = os.fspath(out_dir)
+        os.makedirs(out_dir, exist_ok=True)
+
+    def flush(batch, idx):
+        if not batch:
+            return
+        paths_b = [p for p, _ in batch]
+        imgs = [decode_image(b) for _, b in batch]
+        ds = Dataset({"_img": imgs, "_path": np.asarray(paths_b)})
+        scored = featurizer.transform(ds)
+        if len(scored) == 0:
+            return                 # whole batch undecodable: nothing to emit
+        block = np.stack([np.asarray(v) for v in scored[out_col]])
+        kept_paths.extend(scored["_path"])
+        if out_dir is not None:
+            p = os.path.join(out_dir, f"part-{idx:05d}.npy")
+            np.save(p, block)
+            shard_paths.append(p)
+        else:
+            feats.append(block)
+
+    # lazy file walk (read_binary_files materializes every blob up front —
+    # exactly what streaming must avoid); zip members are not expanded here
+    rng = np.random.default_rng(seed)
+    batch, idx = [], 0
+    for f in _iter_files(image_dir, recursive):
+        if sample_ratio < 1.0 and rng.random() >= sample_ratio:
+            continue
+        batch.append(read_binary_file(f))
+        if len(batch) >= batch_files:
+            flush(batch, idx)
+            batch, idx = [], idx + 1
+    flush(batch, idx)
+    if out_dir is not None:
+        return kept_paths, shard_paths
+    return kept_paths, (np.concatenate(feats, axis=0) if feats
+                        else np.zeros((0,), np.float32))

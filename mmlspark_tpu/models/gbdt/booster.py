@@ -470,6 +470,28 @@ class Booster:
             return np.asarray(jax.nn.softmax(raw, axis=-1))
         return np.asarray(obj.transform(jnp.asarray(raw[:, 0])))
 
+    def predict_streamed(self, source, *, chunk_rows: int = 262_144,
+                         out_dir=None, num_iteration: int = -1,
+                         raw: bool = False):
+        """Score ``.npy`` feature shards in bounded row chunks —
+        larger-than-RAM inference. Each chunk runs exactly
+        :meth:`predict` / :meth:`predict_raw`, so streamed outputs equal
+        in-memory outputs bit-for-bit. The reference gets this shape for
+        free from Spark partition streaming
+        (io/binary/BinaryFileReader.scala:20 feeding the native scorer,
+        lightgbm/LightGBMBooster.scala:250); here it is an explicit
+        bounded-chunk loop (io/streaming.py). Returns concatenated scores,
+        or output shard paths with ``out_dir``.
+        """
+        from ...io.streaming import stream_apply
+
+        if raw:
+            fn = lambda c: self.predict_raw(c, num_iteration)   # noqa: E731
+        else:
+            fn = lambda c: self.predict(c, num_iteration)       # noqa: E731
+        return stream_apply(source, fn, chunk_rows=chunk_rows,
+                            out_dir=out_dir)
+
     def predict_contrib(self, X: np.ndarray,
                         method: str = "treeshap") -> np.ndarray:
         """Per-feature contributions ([n, (F+1) * num_class]; the last slot

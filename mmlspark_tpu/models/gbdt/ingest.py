@@ -99,16 +99,17 @@ class ShardedMatrixSource:
                 paths = [p]
         self.paths: List[str] = [os.fspath(p) for p in paths]
         self._shards = [_NpyShard(p) for p in self.paths]
-        ndims = {len(s.shape) for s in self._shards}
-        if len(ndims) != 1 or ndims.pop() not in (1, 2):
+        zero_d = [s.path for s in self._shards if len(s.shape) == 0]
+        if zero_d:
             raise ValueError(
-                f"shards must all be 1-D or all 2-D, got shapes "
-                f"{[s.shape for s in self._shards]}")
-        if len(self._shards[0].shape) == 2:
-            widths = {s.shape[1] for s in self._shards}
-            if len(widths) != 1:
-                raise ValueError(
-                    f"inconsistent feature counts across shards: {widths}")
+                f"0-D .npy shards have no row axis: {zero_d[:3]}")
+        trailing = {s.shape[1:] for s in self._shards}
+        if len(trailing) != 1:
+            raise ValueError(
+                f"inconsistent per-row shapes across shards: "
+                f"{sorted(trailing)}")
+        # GBDT ingest consumes 1-D/2-D; N-D shards (e.g. image batches)
+        # serve the streamed-scoring path (io/streaming.py)
         self._lengths = np.array([s.shape[0] for s in self._shards],
                                  dtype=np.int64)
         self._offsets = np.concatenate(
@@ -126,6 +127,10 @@ class ShardedMatrixSource:
     def num_features(self) -> int:
         return int(self._shards[0].shape[1]) if self.ndim == 2 else 1
 
+    @property
+    def row_shape(self) -> tuple:
+        return tuple(self._shards[0].shape[1:])
+
     def _read_shard_rows(self, s: int, lo: int, hi: int) -> np.ndarray:
         sh = self._shards[s]
         raw = np.fromfile(sh.path, dtype=sh.dtype,
@@ -138,11 +143,8 @@ class ShardedMatrixSource:
         """Rows [start, stop) as float32, crossing shard boundaries."""
         start, stop = int(start), int(min(stop, self.n))
         if stop <= start:
-            shape = (0, self.num_features) if self.ndim == 2 else (0,)
-            return np.empty(shape, np.float32)
-        out = np.empty((stop - start,) + ((self.num_features,)
-                                          if self.ndim == 2 else ()),
-                       np.float32)
+            return np.empty((0,) + self.row_shape, np.float32)
+        out = np.empty((stop - start,) + self.row_shape, np.float32)
         self.read_into(out, start, stop)
         return out
 
@@ -184,9 +186,7 @@ class ShardedMatrixSource:
         """
         idx = np.asarray(idx, dtype=np.int64)
         shard = np.searchsorted(self._offsets, idx, side="right") - 1
-        out_shape = ((idx.size, self.num_features) if self.ndim == 2
-                     else (idx.size,))
-        out = np.empty(out_shape, np.float32)
+        out = np.empty((idx.size,) + self.row_shape, np.float32)
         for s in np.unique(shard):
             sel = np.flatnonzero(shard == s)
             sh = self._shards[s]
@@ -197,7 +197,7 @@ class ShardedMatrixSource:
                            + (int(idx[j]) - base) * sh.row_bytes)
                     row = np.frombuffer(f.read(sh.row_bytes),
                                         dtype=sh.dtype)
-                    out[j] = row.astype(np.float32)
+                    out[j] = row.astype(np.float32).reshape(self.row_shape)
         return out
 
 

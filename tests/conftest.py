@@ -46,6 +46,17 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 @pytest.fixture(scope="session")
+def cpu_subprocess_env():
+    """Environment for subprocess tests (RSS measurement, multi-process):
+    relay-safe CPU jax on the 8-device virtual mesh. One definition — the
+    CPU-fallback env must not diverge across test files."""
+    env = dict(os.environ)
+    env.update({"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    return env
+
+
+@pytest.fixture(scope="session")
 def mesh8():
     from mmlspark_tpu.parallel.mesh import make_mesh
 

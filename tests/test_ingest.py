@@ -67,7 +67,7 @@ class TestShardedSource:
     def test_inconsistent_shards_rejected(self, tmp_path):
         np.save(tmp_path / "a.npy", np.zeros((3, 2), np.float32))
         np.save(tmp_path / "b.npy", np.zeros((3, 5), np.float32))
-        with pytest.raises(ValueError, match="feature counts"):
+        with pytest.raises(ValueError, match="per-row shapes"):
             ShardedMatrixSource(str(tmp_path))
 
 
@@ -160,7 +160,7 @@ class TestOutOfCoreConstruct:
                                       categorical_features=(99,))
 
     @pytest.mark.slow
-    def test_host_memory_stays_bounded(self, tmp_path):
+    def test_host_memory_stays_bounded(self, tmp_path, cpu_subprocess_env):
         """Ingest must not materialize the raw matrix on host. Measured in
         a fresh subprocess (ru_maxrss is a monotonic high-water mark, so an
         in-suite measurement inherits earlier tests' peaks). 320 MB raw
@@ -196,10 +196,8 @@ after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
 assert np.asarray(ds.Xbt_d).dtype == np.uint8
 print(json.dumps({{"grew": after - before}}))
 """
-        env = dict(__import__("os").environ)
-        env.update({"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
-                    "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
-        r = subprocess.run([sys.executable, "-c", script], env=env,
+        r = subprocess.run([sys.executable, "-c", script],
+                           env=cpu_subprocess_env,
                            capture_output=True, text=True, timeout=300)
         assert r.returncode == 0, r.stderr[-2000:]
         grew = __import__("json").loads(r.stdout.splitlines()[-1])["grew"]

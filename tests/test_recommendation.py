@@ -14,14 +14,6 @@ from mmlspark_tpu.recommendation.sar import (SAR, RecommendationIndexer,
                                              SARModel)
 
 
-def _cpu_env():
-    import os
-    env = dict(os.environ)
-    env.update({"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
-                "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
-    return env
-
-
 def _interactions(seed=0, n_users=30, n_items=20):
     """Two taste clusters: users 0..14 like items 0..9, rest like 10..19."""
     rng = np.random.default_rng(seed)
@@ -133,7 +125,7 @@ class TestSAR:
             np.asarray(loaded.itemSimilarity.todense()),
             np.asarray(sparse_m.itemSimilarity.todense()))
 
-    def test_sparse_scale_1m_users_100k_items(self):
+    def test_sparse_scale_1m_users_100k_items(self, cpu_subprocess_env):
         """The capability claim the dense path could never meet: 1M users x
         100k items x 10M events fits on this host (dense affinity alone
         would be 400 GB). Run in a subprocess so peak RSS is attributable
@@ -166,7 +158,7 @@ print("OK", round(gb, 2))
 """
         r = subprocess.run([sys.executable, "-c", script],
                            capture_output=True, text=True, timeout=600,
-                           env=_cpu_env())
+                           env=cpu_subprocess_env)
         assert r.returncode == 0, r.stderr[-2000:]
         assert r.stdout.startswith("OK")
 
