@@ -363,6 +363,12 @@ class Booster:
         self.best_iteration = int(best_iteration)
         self.eval_history = eval_history or {}
         self._predict_fn = None
+        # Per-node LightGBM decision_type bytes [T, M] (missing-value
+        # routing: bit 1 default-left, bits 2-3 missing type), set only by
+        # the native-model import path. None = the framework's own training
+        # semantics (NaN routes left — decision_type 10), which the fast
+        # `~(x > thr)` routing implements directly.
+        self.missing_dec: Optional[np.ndarray] = None
 
     # -- inference -------------------------------------------------------------
     @property
@@ -425,9 +431,11 @@ class Booster:
             depth_cap = self.depth_cap
             is_cat = self._is_cat()
             cat_max_bin = self.binner_state.get("max_bin") or 0
+            mdec = (None if self.missing_dec is None
+                    else jnp.asarray(self.missing_dec[:t_end]))
             fn = jax.jit(lambda X: predict_forest_raw(
                 trees, thr, X, depth_cap, is_cat=is_cat,
-                cat_max_bin=cat_max_bin))
+                cat_max_bin=cat_max_bin, missing_dec=mdec))
             # keyed by t_end: services alternate full-model and
             # best_iteration scoring; both must stay cached executables.
             # Bounded LRU: each entry pins a device tree-slice, so a
@@ -492,6 +500,31 @@ class Booster:
         return stream_apply(source, fn, chunk_rows=chunk_rows,
                             out_dir=out_dir)
 
+    def _check_missing_routing(self, X: np.ndarray) -> None:
+        """The SHAP/leaf paths route NaN left unconditionally. For imported
+        models storing different missing handling (missing_dec set), inputs
+        that would hit those rules must not silently diverge from the
+        decision_type-aware predict() path."""
+        if self.missing_dec is None:
+            return
+        # check the float32 view the SHAP/leaf paths actually traverse:
+        # f64 values that underflow to 0.0 in f32 must not slip the guard
+        X = np.asarray(X, dtype=np.float32)
+        mt = (self.missing_dec >> 2) & 3
+        internal = ~np.asarray(self.trees.is_leaf)
+        if (bool(((mt == 1) & internal).any())
+                and (np.abs(X) <= 1e-35).any()):
+            raise NotImplementedError(
+                "predict_contrib/predict_leaf do not implement "
+                "zero-as-missing routing for imported models; use "
+                "predict()/predict_raw()")
+        if np.isnan(X).any():
+            raise NotImplementedError(
+                "predict_contrib/predict_leaf route NaN left "
+                "unconditionally, but this imported model stores different "
+                "missing handling; impute NaNs or use "
+                "predict()/predict_raw()")
+
     def predict_contrib(self, X: np.ndarray,
                         method: str = "treeshap") -> np.ndarray:
         """Per-feature contributions ([n, (F+1) * num_class]; the last slot
@@ -512,6 +545,7 @@ class Booster:
         split feature. Sums to the same prediction but is NOT Shapley on
         correlated features; kept as the throughput option.
         """
+        self._check_missing_routing(X)
         if method == "treeshap":
             # default by backend: the fixed-shape device program is built
             # for TPU (tiny fused VPU/MXU ops, one scanned executable);
@@ -585,7 +619,9 @@ class Booster:
     def predict_leaf(self, X: np.ndarray) -> np.ndarray:
         """Per-tree leaf index for each row: [n, T] (predLeaf parity,
         reference: lightgbm/LightGBMBooster.scala:250-269)."""
-        X = jnp.asarray(np.asarray(X, dtype=np.float32))
+        X32 = np.asarray(X, dtype=np.float32)
+        self._check_missing_routing(X32)
+        X = jnp.asarray(X32)
         trees = jax.tree_util.tree_map(jnp.asarray, self.trees)
         n = X.shape[0]
 
@@ -635,6 +671,8 @@ class Booster:
         arrays["thr_raw"] = self.thr_raw
         arrays["base_score"] = self.base_score
         arrays["binner_upper_bounds"] = self.binner_state["upper_bounds"]
+        if self.missing_dec is not None:
+            arrays["missing_dec"] = self.missing_dec
         meta = dict(
             num_class=self.num_class, objective=self.objective,
             objective_kwargs=self.objective_kwargs, depth_cap=self.depth_cap,
@@ -665,11 +703,14 @@ class Booster:
             {k: z[f"tree_{k}"] for k in Tree._fields if f"tree_{k}" in z}))
         binner_state = dict(meta["binner"])
         binner_state["upper_bounds"] = z["binner_upper_bounds"]
-        return Booster(
+        b = Booster(
             trees, z["thr_raw"], meta["num_class"], z["base_score"],
             meta["objective"], meta["depth_cap"], binner_state,
             meta["best_iteration"], meta["eval_history"],
             meta.get("objective_kwargs") or {})
+        if "missing_dec" in z:
+            b.missing_dec = z["missing_dec"]
+        return b
 
     def to_lightgbm_string(self) -> str:
         """Stock-LightGBM ``tree`` v3 text model string — loads in any
@@ -685,15 +726,17 @@ class Booster:
         score into the first iteration's leaves."""
         from .lgbm_format import parse_lightgbm_string
         (trees, thr_raw, K, objective, kwargs, F,
-         cat_features) = parse_lightgbm_string(s)
+         cat_features, missing_dec) = parse_lightgbm_string(s)
         M = trees.feat.shape[1]
         depth_cap = max(1, (M + 1) // 2 - 1)
         binner_state = dict(upper_bounds=np.zeros((F, 1), np.float32),
                             max_bin=0, sample_count=0, seed=0,
                             num_features=F,
                             categorical_features=list(cat_features))
-        return Booster(trees, thr_raw, K, np.zeros(K, np.float32), objective,
-                       depth_cap, binner_state, objective_kwargs=kwargs)
+        b = Booster(trees, thr_raw, K, np.zeros(K, np.float32), objective,
+                    depth_cap, binner_state, objective_kwargs=kwargs)
+        b.missing_dec = missing_dec
+        return b
 
     def model_string(self) -> str:
         """Portable JSON model string (the framework's internal format:
@@ -713,6 +756,8 @@ class Booster:
             "binner": {k: (v.tolist() if isinstance(v, np.ndarray) else v)
                        for k, v in self.binner_state.items()},
         }
+        if self.missing_dec is not None:
+            d["missing_dec"] = self.missing_dec.tolist()
         return json.dumps(d)
 
     @staticmethod
@@ -725,10 +770,13 @@ class Booster:
         binner_state = dict(d["binner"])
         binner_state["upper_bounds"] = np.asarray(
             binner_state["upper_bounds"], dtype=np.float32)
-        return Booster(trees, np.asarray(d["thr_raw"], np.float32), d["num_class"],
-                       np.asarray(d["base_score"], np.float32), d["objective"],
-                       d["depth_cap"], binner_state, d["best_iteration"],
-                       objective_kwargs=d.get("objective_kwargs") or {})
+        b = Booster(trees, np.asarray(d["thr_raw"], np.float32), d["num_class"],
+                    np.asarray(d["base_score"], np.float32), d["objective"],
+                    d["depth_cap"], binner_state, d["best_iteration"],
+                    objective_kwargs=d.get("objective_kwargs") or {})
+        if d.get("missing_dec") is not None:
+            b.missing_dec = np.asarray(d["missing_dec"], np.uint8)
+        return b
 
 
 # ---------------------------------------------------------------------------
@@ -1867,9 +1915,12 @@ def _finalize_trees(trees_list: List[Tree], binner, max_bin: int, K: int,
 def _truncate_booster(b: Booster, num_iterations: int) -> Booster:
     t_end = num_iterations * b.num_class
     trees = jax.tree_util.tree_map(lambda a: a[:t_end], b.trees)
-    return Booster(trees, b.thr_raw[:t_end], b.num_class, b.base_score,
-                   b.objective, b.depth_cap, b.binner_state, b.best_iteration,
-                   b.eval_history, b.objective_kwargs)
+    out = Booster(trees, b.thr_raw[:t_end], b.num_class, b.base_score,
+                  b.objective, b.depth_cap, b.binner_state, b.best_iteration,
+                  b.eval_history, b.objective_kwargs)
+    if b.missing_dec is not None:
+        out.missing_dec = b.missing_dec[:t_end]
+    return out
 
 
 def _pad_tree_slots(trees: Tree, thr: np.ndarray, M: int):
@@ -1918,6 +1969,17 @@ def _merge_boosters(first: Booster, second: Booster) -> Booster:
         lambda a, c: np.concatenate([np.asarray(a), np.asarray(c)], axis=0),
         t1, t2)
     thr = np.concatenate([thr1, thr2], axis=0)
-    return Booster(trees, thr, first.num_class, first.base_score, second.objective,
-                   max(first.depth_cap, second.depth_cap), second.binner_state,
-                   second.best_iteration, second.eval_history, second.objective_kwargs)
+    out = Booster(trees, thr, first.num_class, first.base_score, second.objective,
+                  max(first.depth_cap, second.depth_cap), second.binner_state,
+                  second.best_iteration, second.eval_history, second.objective_kwargs)
+    if first.missing_dec is not None or second.missing_dec is not None:
+        # absent side = the framework's own semantics (decision_type 10)
+        def _md(b, t):
+            if b.missing_dec is not None:
+                md = b.missing_dec
+                return np.pad(md, ((0, 0), (0, M - md.shape[1])),
+                              constant_values=10)
+            return np.full((t.feat.shape[0], M), 10, np.uint8)
+        out.missing_dec = np.concatenate([_md(first, t1), _md(second, t2)],
+                                         axis=0)
+    return out

@@ -948,7 +948,9 @@ def cat_member(bits_rows: jnp.ndarray, x: jnp.ndarray, max_bin_idx: int,
 def predict_forest_raw(trees, thr_raw, features: jnp.ndarray,
                        depth_cap: int,
                        is_cat: Optional[jnp.ndarray] = None,
-                       cat_max_bin: int = 0) -> jnp.ndarray:
+                       cat_max_bin: int = 0,
+                       missing_dec: Optional[jnp.ndarray] = None
+                       ) -> jnp.ndarray:
     """Evaluate a stacked forest on RAW float features.
 
     trees: Tree of arrays stacked on a leading [T] axis; thr_raw: [T, M] f32 raw
@@ -956,10 +958,18 @@ def predict_forest_raw(trees, thr_raw, features: jnp.ndarray,
     convention of NaN -> bin 0). Categorical features (``is_cat``) route by
     bitset membership of the rounded category id. features: [n, F].
     Returns [T, n].
+
+    ``missing_dec`` ([T, M] per-node LightGBM decision_type bytes) switches
+    numerical routing to stock LightGBM's NumericalDecision semantics
+    (lightgbm tree.h): NaN maps to 0.0 unless the node's missing type is
+    NaN; zero-as-missing and NaN-missing route to the stored default side;
+    everything else compares ``x <= thr``. Needed for imported models —
+    the framework's own training always writes decision_type 10
+    (default-left, NaN missing), which equals the fast default path.
     """
     n = features.shape[0]
 
-    def one_tree(tree_slice, thr):
+    def one_tree(tree_slice, thr, mdec):
         node = jnp.zeros(n, dtype=jnp.int32)
         # clip to the BINNER's last bin (the training-time catch-all), not
         # the bitset word boundary — out-of-range ids must route exactly as
@@ -973,7 +983,19 @@ def predict_forest_raw(trees, thr_raw, features: jnp.ndarray,
             f = tree_slice.feat[node]
             t = thr[node]
             x = jnp.take_along_axis(features, f[:, None], axis=1)[:, 0]
-            go_left = ~(x > t)  # NaN compares false -> goes left
+            if mdec is None:
+                go_left = ~(x > t)  # NaN compares false -> goes left
+            else:
+                md = mdec[node]
+                mt = (md >> 2) & 3          # 0 none, 1 zero, 2 NaN
+                dl = (md & 2) != 0          # default-left
+                x_nan = jnp.isnan(x)
+                xv = jnp.where(x_nan & (mt != 2), 0.0, x)
+                # stock Tree::IsZero: |x| <= kZeroThreshold (1e-35), not
+                # exact equality
+                is_zero = jnp.abs(xv) <= jnp.float32(1e-35)
+                use_default = (((mt == 1) & is_zero) | ((mt == 2) & x_nan))
+                go_left = jnp.where(use_default, dl, ~(xv > t))
             if is_cat is not None:
                 go_left = jnp.where(
                     is_cat[f],
@@ -986,4 +1008,7 @@ def predict_forest_raw(trees, thr_raw, features: jnp.ndarray,
         node = lax.fori_loop(0, depth_cap, body, node)
         return tree_slice.leaf_value[node]
 
-    return jax.vmap(one_tree)(trees, thr_raw)
+    if missing_dec is None:
+        return jax.vmap(lambda ts, th: one_tree(ts, th, None))(
+            trees, thr_raw)
+    return jax.vmap(one_tree)(trees, thr_raw, missing_dec)

@@ -24,7 +24,7 @@ Format notes (LightGBM C++ ``GBDT::SaveModelToString`` / ``Tree::ToString``):
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -95,7 +95,8 @@ def _fmt(x: float) -> str:
 
 def _tree_to_string(tree: Tree, thr_raw: np.ndarray, idx: int,
                     add_bias: float, shrinkage: float,
-                    catchall_bin: int = -1) -> str:
+                    catchall_bin: int = -1,
+                    missing_dec: Optional[np.ndarray] = None) -> str:
     """One ``Tree=i`` block from the fixed-shape slot arrays.
 
     Categorical splits emit LightGBM's bitset encoding: decision_type bit 0
@@ -134,7 +135,9 @@ def _tree_to_string(tree: Tree, thr_raw: np.ndarray, idx: int,
         feats = [int(np.asarray(tree.feat)[s]) for s in internal_slots]
         # decision_type: numerical splits are default-left w/ missing=NaN
         # (our binning sends NaN to bin 0, i.e. left); categorical splits
-        # set bit 0 and route by bitset membership
+        # set bit 0 and route by bitset membership. Imported models carry
+        # their original per-node bytes (missing_dec) — re-emission must
+        # preserve their missing-value routing, not overwrite it.
         dt_num = 2 | (_KNOWN_MISSING_NAN << 2)
         dts, thrs = [], []
         cat_boundaries = [0]
@@ -161,7 +164,8 @@ def _tree_to_string(tree: Tree, thr_raw: np.ndarray, idx: int,
                 cat_words.extend(words)
                 cat_boundaries.append(len(cat_words))
             else:
-                dts.append(dt_num)
+                dts.append(dt_num if missing_dec is None
+                           else int(missing_dec[s_]))
                 thrs.append(_fmt(thr_raw[s_]))
         lines += [
             "split_feature=" + " ".join(str(f) for f in feats),
@@ -231,9 +235,12 @@ def to_lightgbm_string(booster) -> str:
         # base score folds into the first iteration's trees (LightGBM rule)
         bias = float(booster.base_score[t % K]) if t < K else 0.0
         mb = booster.binner_state.get("max_bin") or 0
+        mdec = (None if getattr(booster, "missing_dec", None) is None
+                else np.asarray(booster.missing_dec[t]))
         blocks.append(_tree_to_string(tree, np.asarray(booster.thr_raw[t]),
                                       t, bias, 1.0,
-                                      catchall_bin=mb - 1 if mb else -1))
+                                      catchall_bin=mb - 1 if mb else -1,
+                                      missing_dec=mdec))
     importances = booster.feature_importances("split")
     imp_lines = [f"{fnames[i]}={int(importances[i])}"
                  for i in np.argsort(-importances) if importances[i] > 0]
@@ -260,7 +267,10 @@ def parse_lightgbm_string(s: str):
     """Parse a LightGBM text model into Booster constructor pieces.
 
     Returns (trees: Tree stacked [T, M], thr_raw [T, M], num_class,
-    objective, objective_kwargs, num_features, categorical_features).
+    objective, objective_kwargs, num_features, categorical_features,
+    missing_dec). ``missing_dec`` is a [T, M] uint8 of per-node
+    decision_type bytes when any split stores missing handling other than
+    the framework's default-left/NaN encoding, else None (fast path).
     The parsed model predicts with base_score = 0: LightGBM folds any init
     score into tree leaves. Categorical splits (decision_type bit 0) load
     their cat_threshold bitsets; the features they split on are returned so
@@ -298,6 +308,11 @@ def parse_lightgbm_string(s: str):
 
     stacked = {k: [] for k in Tree._fields}
     thr_all = []
+    mdec_all = []
+    # the framework's own emit: default-left + NaN missing (see _tree_lines
+    # dt_num) — the fast `~(x > thr)` predictor implements exactly this
+    _DT_NATIVE = 2 | (_KNOWN_MISSING_NAN << 2)
+    exotic_missing = False
     for blk in tree_blocks:
         fields = _parse_block("idx=" + blk)
         nl = int(fields["num_leaves"][0])
@@ -306,6 +321,7 @@ def parse_lightgbm_string(s: str):
         is_leaf = np.ones(M, bool)
         leaf_value, node_value = zeros_f(), zeros_f()
         node_hess, node_cnt, gain = zeros_f(), zeros_f(), zeros_f()
+        mdec = np.full(M, _DT_NATIVE, np.uint8)
         cat_bits = np.zeros((M, BW), np.uint32)
         cat_boundaries = [int(x) for x in fields.get("cat_boundaries", [])]
         cat_words = [int(x) for x in fields.get("cat_threshold", [])]
@@ -355,21 +371,13 @@ def parse_lightgbm_string(s: str):
                     gain[i] = sg[i] if i < len(sg) else 0.0
                     cat_features.add(sf[i])
                     continue
-                # This predictor always routes NaN left (`~(x > thr)`).
-                # A split whose stored missing handling differs would
-                # silently mispredict: default-right with NaN missing type,
-                # or zero-as-missing (zeros rerouted to the default side).
-                missing_type = (dts[i] >> 2) & 3
-                default_left = bool(dts[i] & 2)
-                if missing_type == 1:
-                    raise NotImplementedError(
-                        "zero_as_missing LightGBM models are not supported "
-                        "(this predictor treats 0.0 as a regular value)")
-                if missing_type == 2 and not default_left:
-                    raise NotImplementedError(
-                        "default-right missing handling is not supported "
-                        "(this predictor routes NaN left); re-train with "
-                        "NaN-free data or default-left splits")
+                # Stock missing-value routing (NumericalDecision, lightgbm
+                # tree.h): recorded per node; anything other than the
+                # framework's own default-left/NaN-missing encoding flips
+                # the predictor onto the decision_type-aware path.
+                mdec[i] = dts[i] & 0xFF
+                if (dts[i] & 0x0E) != _DT_NATIVE:
+                    exotic_missing = True
                 is_leaf[i] = False
                 feat[i] = sf[i]
                 thr[i] = th[i]
@@ -394,8 +402,10 @@ def parse_lightgbm_string(s: str):
         stacked["cat_bitset"].append(cat_bits)
         thr_leaf = np.where(is_leaf, np.float32(np.inf), thr)
         thr_all.append(thr_leaf.astype(np.float32))
+        mdec_all.append(mdec)
 
     trees = Tree(**{k: np.stack(v) for k, v in stacked.items()})
     thr_raw = np.stack(thr_all)
+    missing_dec = np.stack(mdec_all) if exotic_missing else None
     return (trees, thr_raw, num_class, objective, obj_kwargs, F,
-            sorted(cat_features))
+            sorted(cat_features), missing_dec)

@@ -213,7 +213,21 @@ class TestEmitParseRoundTrip:
 
 
 class TestUnsupportedStockVariants:
-    """Unsupported stock-LightGBM variants fail loudly, never mispredict."""
+    """Stock variants either load with exact stock semantics (missing-value
+    routing, via per-node missing_dec) or fail loudly — never mispredict."""
+
+    def test_missing_dec_persists_through_all_formats(self, tmp_path):
+        s = GOLDEN.replace("decision_type=2 2", "decision_type=8 2")
+        b = Booster.from_string(s)
+        assert b.missing_dec is not None
+        Xq = np.array([[0.0, np.nan], [1.0, 2.0]], np.float32)
+        expect = b.predict_raw(Xq)
+        b.save(str(tmp_path / "m"))
+        b2 = Booster.load(str(tmp_path / "m"))
+        assert b2.missing_dec is not None
+        np.testing.assert_array_equal(b2.predict_raw(Xq), expect)
+        b3 = Booster.from_string(b.model_string())
+        np.testing.assert_array_equal(b3.predict_raw(Xq), expect)
 
     def test_multiclassova_rejected(self):
         s = GOLDEN.replace("objective=binary sigmoid:1",
@@ -227,26 +241,54 @@ class TestUnsupportedStockVariants:
         with pytest.raises(NotImplementedError, match="sigmoid"):
             Booster.from_string(s)
 
-    def test_zero_as_missing_rejected(self):
-        # decision_type 6 = numerical, default-left, missing=zero
+    def test_zero_as_missing_routes_default_side(self):
+        # decision_type 6 = numerical, default-LEFT, missing=zero: a zero
+        # (and NaN, which maps to 0.0 first) takes the default side instead
+        # of the threshold compare
         s = GOLDEN.replace("decision_type=2 2", "decision_type=6 2")
-        with pytest.raises(NotImplementedError, match="zero_as_missing"):
-            Booster.from_string(s)
+        b = Booster.from_string(s)
+        assert b.missing_dec is not None
+        # f1=0 is missing -> default left -> T0 leaf0; T1 (dt=2): 0<=1.25
+        np.testing.assert_allclose(
+            b.predict_raw(np.array([[0.0, 0.0]], np.float32))[0, 0],
+            0.25 - 0.0625, rtol=1e-6)
+        # decision_type 4 = default-RIGHT: the same zero now routes right
+        s4 = GOLDEN.replace("decision_type=2 2", "decision_type=4 2")
+        b4 = Booster.from_string(s4)
+        # T0: f1=0 missing -> right -> node1: f0=0, 0<=-1 false -> leaf2
+        np.testing.assert_allclose(
+            b4.predict_raw(np.array([[0.0, 0.0]], np.float32))[0, 0],
+            0.0625 - 0.0625, rtol=1e-6)
+        # SHAP/leaf paths don't implement zero-as-missing: loud error, not
+        # a silent mispredict
+        with pytest.raises(NotImplementedError, match="zero-as-missing"):
+            b.predict_contrib(np.array([[0.0, 0.0]], np.float32))
 
-    def test_default_right_nan_rejected(self):
+    def test_default_right_nan_routes_right(self):
         # decision_type 8 = numerical, default-right, missing=NaN
         s = GOLDEN.replace("decision_type=2 2", "decision_type=8 2")
-        with pytest.raises(NotImplementedError, match="default-right"):
-            Booster.from_string(s)
-
-    def test_default_right_missing_none_accepted(self):
-        # decision_type 0 = numerical, default-right, missing=none: NaN never
-        # occurs in such models, so NaN-left prediction is equivalent
-        s = GOLDEN.replace("decision_type=2 2", "decision_type=0 0")
         b = Booster.from_string(s)
-        X = np.array([[0.0, 0.0]], dtype=np.float32)
-        np.testing.assert_allclose(b.predict_raw(X)[0, 0], 0.25 - 0.0625,
-                                   rtol=1e-6)
+        # T0: f1=NaN -> default RIGHT -> node1: f0=0, 0<=-1 false -> leaf2
+        np.testing.assert_allclose(
+            b.predict_raw(np.array([[0.0, np.nan]], np.float32))[0, 0],
+            0.0625 - 0.0625, rtol=1e-6)
+        with pytest.raises(NotImplementedError, match="NaN left"):
+            b.predict_leaf(np.array([[0.0, np.nan]], np.float32))
+        # NaN-free inputs keep the SHAP/leaf paths available
+        assert b.predict_leaf(
+            np.array([[0.0, 0.0]], np.float32)).shape == (1, 2)
+
+    def test_missing_none_nan_maps_to_zero(self):
+        # decision_type 0/2 = missing type NONE: stock maps NaN to 0.0 and
+        # compares — with a negative threshold NaN therefore goes RIGHT
+        # (an unconditional NaN-goes-left reading gets this wrong)
+        s = GOLDEN.replace("threshold=0.5 -1.0", "threshold=-0.5 -1.0")
+        b = Booster.from_string(s)
+        # T0 node0: f1=NaN -> 0.0; 0 <= -0.5 false -> right -> node1:
+        # f0=2.0 > -1.0 -> leaf2; T1: f0=2.0 > 1.25 -> leaf1
+        np.testing.assert_allclose(
+            b.predict_raw(np.array([[2.0, np.nan]], np.float32))[0, 0],
+            0.0625 + 0.1875, rtol=1e-6)
 
     def test_rf_dart_num_batches_rejected_upfront(self):
         ds, _ = _ds()

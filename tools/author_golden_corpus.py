@@ -97,8 +97,22 @@ def _route(tree_lines, x):
                 words = cat_t[cat_b[ci]:cat_b[ci + 1]]
                 go_left = (c < 32 * len(words)
                            and (words[c // 32] >> (c % 32)) & 1 == 1)
-        else:                              # numerical, default-left (bit 3=0)
-            go_left = math.isnan(xv) or not (xv > thr[j])
+        else:
+            # stock NumericalDecision (lightgbm include/LightGBM/tree.h):
+            # missing type bits 2-3 (0 none, 1 zero, 2 NaN), default-left
+            # bit 1. NaN maps to 0.0 unless the missing type is NaN; the
+            # missing value routes to the stored default side; everything
+            # else compares x <= threshold.
+            mt = (dt[j] >> 2) & 3
+            default_left = bool(dt[j] & 2)
+            if math.isnan(xv) and mt != 2:
+                xv = 0.0
+            # Tree::IsZero: |x| <= kZeroThreshold (1e-35)
+            if ((mt == 1 and abs(xv) <= 1e-35)
+                    or (mt == 2 and math.isnan(xv))):
+                go_left = default_left
+            else:
+                go_left = xv <= thr[j]
         j = left[j] if go_left else right[j]
         if j < 0:
             return leaf[-j - 1]
@@ -197,6 +211,94 @@ def main():
           _model("binary sigmoid:1", 1, 1, 1, [tc],
                  {"objective": "binary", "boosting": "gbdt"}),
           Xc, raw_sum([tc]), lambda r: sig(r[:, 0]))
+
+    # ---- dark corners (round-5 hardening) --------------------------------
+
+    # missing_nan_right: NaN missing type with default-RIGHT at the root
+    # (decision_type 8) and default-left at the child (10) — a loader that
+    # hardcodes NaN->left mispredicts row [nan, *] at the root
+    tnr = _tree(3, [0, 1], [5.0, 2.0], [0.5, -1.5], [8, 10], [1, -2],
+                [-1, -3], [0.3, -0.2, 0.1], [9, 10, 9], [0.01, -0.02],
+                [28, 19], 0.1)
+    Xn = np.array([[0.0, 0.0], [np.nan, 0.0], [0.2, np.nan], [0.2, -2.0],
+                   [2.0, 5.0], [np.nan, np.nan]], np.float64)
+    _emit("missing_nan_right",
+          _model("binary sigmoid:1", 1, 1, 1, [tnr],
+                 {"objective": "binary", "boosting": "gbdt"}),
+          Xn, raw_sum([tnr]), lambda r: sig(r[:, 0]))
+
+    # missing_zero: zero-as-missing (bits 2-3 = 1). x == 0 AND NaN (which
+    # maps to 0.0 first) route to the default side: left at the root
+    # (dt 6), right at the child (dt 4)
+    tz = _tree(3, [0, 1], [4.0, 1.5], [-0.5, 0.75], [6, 4], [1, -2],
+               [-1, -3], [0.25, -0.1, 0.05], [8, 11, 9], [0.0, 0.01],
+               [28, 19], 0.1)
+    Xz = np.array([[0.0, 0.0], [0.0, 0.75], [0.0, 2.0], [np.nan, 0.0],
+                   [-1.0, 0.0], [1.0, np.nan], [-0.4, 0.8]], np.float64)
+    _emit("missing_zero",
+          _model("regression", 1, 1, 1, [tz],
+                 {"objective": "regression", "boosting": "gbdt"}),
+          Xz, raw_sum([tz]), lambda r: r[:, 0])
+
+    # missing_none_negative_threshold: missing type None (dt 2) with a
+    # NEGATIVE threshold — stock maps NaN to 0.0 and compares (0 <= -0.7
+    # is false, NaN goes RIGHT); a NaN-always-left reading gets this wrong
+    tneg = _tree(2, [0], [3.0], [-0.7], [2], [-1], [-2], [0.4, -0.3],
+                 [12, 16], [0.0], [28], 0.1)
+    Xneg = np.array([[-1.0, 0.0], [np.nan, 0.0], [0.0, 0.0], [-0.7, 0.0],
+                     [-0.69, 0.0]], np.float64)
+    _emit("missing_none_negative_threshold",
+          _model("regression", 1, 1, 1, [tneg],
+                 {"objective": "regression", "boosting": "gbdt"}),
+          Xneg, raw_sum([tneg]), lambda r: r[:, 0])
+
+    # single_leaf: a zero-gain iteration emits a constant tree with NO
+    # split arrays at all (stock writes only the leaf lines); mixed with a
+    # normal tree so slot-width padding across the pair is exercised
+    t_single = "\n".join([
+        "num_leaves=1", "num_cat=0", "leaf_value=0.0625",
+        "leaf_weight=28", "leaf_count=28", "shrinkage=0.1"])
+    _emit("single_leaf",
+          _model("regression", 1, 1, 1, [t0, t_single, t1],
+                 {"objective": "regression", "boosting": "gbdt"}),
+          X, raw_sum([t0, t_single, t1]), lambda r: r[:, 0])
+
+    # deep_chain: a strictly unbalanced 13-leaf chain — every left child is
+    # a leaf, every right child the next split, 12 levels deep. Loaders
+    # with a too-shallow traversal cap truncate the tail leaves.
+    D = 12
+    t_chain = _tree(
+        D + 1, [0] * D, [1.0] * D, [float(6 - i) for i in range(D)],
+        [2] * D,
+        [-(i + 1) for i in range(D)],
+        [i + 1 for i in range(D - 1)] + [-(D + 1)],
+        [round(0.01 * (i + 1) * (-1) ** i, 6) for i in range(D + 1)],
+        [2] * (D + 1),
+        [0.0] * D, [2 * (D - i) + 2 for i in range(D)], 0.1)
+    Xd = np.array([[float(v), 0.0] for v in
+                   [7.0, 6.0, 5.5, 0.0, -4.5, -5.0, -6.0, np.nan]],
+                  np.float64)
+    _emit("deep_chain",
+          _model("regression", 1, 1, 1, [t_chain],
+                 {"objective": "regression", "boosting": "gbdt"}),
+          Xd, raw_sum([t_chain]), lambda r: r[:, 0])
+
+    # categorical_multiword: membership sets spanning THREE 32-bit words
+    # ({1, 40, 75} and {5, 94}), two categorical splits sharing one
+    # cat_boundaries table — indexing bugs between cat_idx and word offsets
+    # surface here
+    tcm = _tree(3, [0, 0], [6.0, 2.5], [0, 1], [1, 1], [1, -2], [-1, -3],
+                [0.2, -0.15, 0.1], [9, 10, 9], [0.0, 0.01], [28, 19],
+                0.1, num_cat=2, cat_boundaries=[0, 3, 6],
+                cat_threshold=[(1 << 1), (1 << 8), (1 << 11),
+                               (1 << 5), 0, (1 << 30)])
+    Xcm = np.array([[1.0, 0.0], [40.0, 0.0], [75.0, 0.0], [5.0, 0.0],
+                    [94.0, 0.0], [96.0, 0.0], [np.nan, 0.0], [2.0, 0.0]],
+                   np.float64)
+    _emit("categorical_multiword",
+          _model("binary sigmoid:1", 1, 1, 1, [tcm],
+                 {"objective": "binary", "boosting": "gbdt"}),
+          Xcm, raw_sum([tcm]), lambda r: sig(r[:, 0]))
 
 
 if __name__ == "__main__":
