@@ -287,6 +287,70 @@ class TestTrainedFixture:
         with pytest.raises(IOError, match="hash mismatch"):
             d.download_model(schema)
 
+    def test_transfer_learning_accuracy_pinned(self, tmp_path):
+        """The ImageFeaturizer layer-cutting QUALITY anchor (reference:
+        image/ImageFeaturizer.scala:96-141 + notebook sample 9): pooled
+        features from the genuinely-pretrained checkpoint, 100 labels, a
+        GBDT head, held-out digits the pretraining never saw. Pinned
+        against both a raw-pixel head (transfer must beat it) and the
+        same featurizer with random-init weights (the trained weights —
+        not the architecture — must carry the win). Measured [builder-cpu]
+        0.796 vs pixels 0.696 vs random-init well below."""
+        import jax
+
+        from sklearn.datasets import load_digits
+
+        from mmlspark_tpu.models.dnn.cnn import CNNConfig, init_cnn_params
+        from mmlspark_tpu.models.dnn.digits_fixture import (digits_images,
+                                                            heldout_split)
+        from mmlspark_tpu.models.gbdt.api import LightGBMClassifier
+
+        X, y = load_digits(return_X_y=True)
+        Xtr, Xte, ytr, yte = heldout_split(X, y)
+        Xte, yte = Xte[:250], yte[:250]
+        rng = np.random.default_rng(1)
+        lab = rng.choice(len(Xtr), size=100, replace=False)
+        d = ModelDownloader(str(tmp_path))
+        d.download_model("DigitsConvNet")
+        dnn = DNNModel.from_downloader(str(tmp_path), "DigitsConvNet")
+
+        def head_acc(featurizer):
+            cols_tr = {"img": digits_images(Xtr[lab]),
+                       "pixels": Xtr[lab].astype(np.float32),
+                       "label": ytr[lab].astype(np.float64)}
+            cols_te = {"img": digits_images(Xte),
+                       "pixels": Xte.astype(np.float32)}
+            tr, te = Dataset(cols_tr), Dataset(cols_te)
+            col = "pixels"
+            if featurizer is not None:
+                tr, te = featurizer.transform(tr), featurizer.transform(te)
+                col = "f"
+                tr = tr.with_column(col, np.stack(
+                    [np.asarray(v) for v in tr[col]]))
+                te = te.with_column(col, np.stack(
+                    [np.asarray(v) for v in te[col]]))
+            clf = LightGBMClassifier(numIterations=30, numLeaves=7,
+                                     minDataInLeaf=3,
+                                     featuresCol=col).fit(tr)
+            return float((clf.transform(te).array("prediction")
+                          == yte).mean())
+
+        def featurizer_for(model):
+            return ImageFeaturizer(model, input_hw=(32, 32)).set(
+                inputCol="img", outputCol="f", cutOutputLayers=1)
+
+        acc_trained = head_acc(featurizer_for(dnn))
+        acc_pixels = head_acc(None)
+        # same architecture, random weights: isolates the trained-weight
+        # contribution from the architecture's
+        spec_cfg = CNNConfig(**dnn.apply_spec["config"])
+        rand = DNNModel(init_cnn_params(spec_cfg, jax.random.PRNGKey(3)),
+                        apply_spec=dnn.apply_spec)
+        acc_random = head_acc(featurizer_for(rand))
+        assert acc_trained > 0.75, acc_trained
+        assert acc_trained > acc_pixels + 0.03, (acc_trained, acc_pixels)
+        assert acc_trained > acc_random + 0.1, (acc_trained, acc_random)
+
 
 def test_feed_fetch_dicts(tiny_cnn):
     """CNTKModel feedDict/fetchDict parity: one pass, many outputs;
