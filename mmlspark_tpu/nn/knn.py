@@ -217,30 +217,109 @@ class BallTree:
 
     def query(self, point: np.ndarray, k: int = 1):
         """Returns (indices, distances) of the k nearest points."""
-        point = np.asarray(point, np.float64)
-        best: List = []  # max-heap by -distance, kept sorted small
+        ids, dists = self.query_batch(np.asarray(point)[None, :], k)
+        return [int(i) for i in ids[0]], [float(d) for d in dists[0]]
 
-        def visit(node_id):
+    def query_batch(self, points: np.ndarray, k: int = 1):
+        """k nearest for EVERY query row in one frontier-based traversal.
+
+        The reference answers queries one at a time through a recursive
+        visit (nn/BallTree.scala:99-156) — fine per executor row, a crawl
+        for large host-side query sets. Here the stack holds
+        (node, query-subset) pairs and every step is a vectorized numpy
+        op over the subset: ball-bound pruning against each query's
+        current k-th distance, batched leaf scans merged by argpartition,
+        and per-query nearest-child-first ordering (subsets split by which
+        child is nearer; far halves are pushed below near halves, so each
+        query still visits its nearer child first — the ordering that
+        makes the pruning bound effective).
+
+        Returns ``(indices [Q, k] int64, distances [Q, k] float64)``,
+        each row sorted by distance ascending.
+        """
+        P = np.ascontiguousarray(np.asarray(points, np.float64))
+        Q = len(P)
+        k = min(k, len(self.points))
+        if getattr(self, "_pts_c", None) is None:
+            # centered copy for the BLAS leaf scans: the p2 - 2px + x2
+            # identity cancels catastrophically when the data carries a
+            # large common offset; centering on the root mean removes it
+            mu = self._nodes[0][0]
+            self._pts_c = self.points - mu
+            self._x2c = (self._pts_c ** 2).sum(axis=1)
+        Pc = P - self._nodes[0][0]
+        pc2 = (Pc ** 2).sum(axis=1)
+        best_d = np.full((Q, k), np.inf)
+        best_i = np.full((Q, k), -1, np.int64)
+        # below this subset size, stop per-query child ordering (order by
+        # the subset mean instead): unchecked splitting fragments the
+        # frontier into tiny groups whose per-step numpy overhead swamps
+        # the pruning win
+        split_min = 128
+        stack: List = [(0, np.arange(Q))]
+        while stack:
+            node_id, qs = stack.pop()
             center, radius, start, end, left, right = self._nodes[node_id]
-            d_center = float(np.sqrt(((point - center) ** 2).sum()))
-            if len(best) == k and d_center - radius > best[-1][0]:
-                return  # ball cannot contain anything closer
+            # exact direct diff: the prune bound must not inherit identity
+            # rounding (a deflated d_center could prune the true NN's ball)
+            d_center = np.sqrt(((P[qs] - center) ** 2).sum(axis=1))
+            qs = qs[d_center - radius <= best_d[qs, -1]]
+            if qs.size == 0:
+                continue
             if left < 0:
                 ids = self._idx[start:end]
-                d = np.sqrt(((self.points[ids] - point) ** 2).sum(axis=1))
-                for dist, i in zip(d, ids):
-                    if len(best) < k:
-                        best.append((float(dist), int(i)))
-                        best.sort()
-                    elif dist < best[-1][0]:
-                        best[-1] = (float(dist), int(i))
-                        best.sort()
+                m = len(ids)
+                take = min(k, m)
+                if take < m:
+                    # centered BLAS identity RANKS candidates; the kept
+                    # candidates' distances are then recomputed exactly, so
+                    # identity rounding (~eps x spread^2 after centering)
+                    # can only reorder genuine machine-precision ties
+                    d2a = (pc2[qs, None]
+                           - 2.0 * (Pc[qs] @ self._pts_c[ids].T)
+                           + self._x2c[ids][None])
+                    cand = np.argpartition(d2a, take - 1, axis=1)[:, :take]
+                    cid = ids[cand]                       # [q_sub, take]
+                else:
+                    cid = np.broadcast_to(ids, (len(qs), m))
+                diff = P[qs][:, None, :] - self.points[cid]
+                d = np.sqrt((diff * diff).sum(-1))        # exact
+                all_d = np.concatenate([best_d[qs], d], axis=1)
+                all_i = np.concatenate([best_i[qs], cid], axis=1)
+                rows = np.arange(len(qs))[:, None]
+                sel = np.argpartition(all_d, k - 1, axis=1)[:, :k]
+                bd, bi = all_d[rows, sel], all_i[rows, sel]
+                order = np.argsort(bd, axis=1, kind="stable")
+                best_d[qs] = bd[rows, order]
+                best_i[qs] = bi[rows, order]
             else:
-                children = sorted(
-                    (left, right),
-                    key=lambda c: ((point - self._nodes[c][0]) ** 2).sum())
-                for c in children:
-                    visit(c)
-
-        visit(0)
-        return [i for _, i in best], [d for d, _ in best]
+                # child ordering is a traversal heuristic — identity
+                # rounding cannot affect correctness here
+                dl = (pc2[qs] - 2.0 * (Pc[qs] @ (self._nodes[left][0]
+                                                 - self._nodes[0][0]))
+                      + ((self._nodes[left][0]
+                          - self._nodes[0][0]) ** 2).sum())
+                dr = (pc2[qs] - 2.0 * (Pc[qs] @ (self._nodes[right][0]
+                                                 - self._nodes[0][0]))
+                      + ((self._nodes[right][0]
+                          - self._nodes[0][0]) ** 2).sum())
+                if qs.size < split_min:
+                    # whole subset, majority-nearest child first
+                    first, second = ((left, right)
+                                     if (dl <= dr).mean() >= 0.5
+                                     else (right, left))
+                    stack.append((second, qs))
+                    stack.append((first, qs))
+                    continue
+                near_left = dl <= dr
+                gl, gr = qs[near_left], qs[~near_left]
+                # pushed far-first so near halves pop first
+                if gr.size:
+                    stack.append((left, gr))
+                if gl.size:
+                    stack.append((right, gl))
+                if gr.size:
+                    stack.append((right, gr))
+                if gl.size:
+                    stack.append((left, gl))
+        return best_i, best_d
