@@ -31,9 +31,7 @@ from ..models.gbdt.ingest import PathLike, ShardedMatrixSource
 
 
 def _as_source(source) -> ShardedMatrixSource:
-    if isinstance(source, ShardedMatrixSource):
-        return source
-    return ShardedMatrixSource(source)
+    return ShardedMatrixSource.coerce(source)
 
 
 def stream_apply(source: Union[PathLike, ShardedMatrixSource],

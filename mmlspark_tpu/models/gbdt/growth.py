@@ -120,10 +120,12 @@ class GrowConfig(NamedTuple):
     # so every round subtracts (see the nhist comment in grow_tree).
     # Single-device only: a shard's local membership of the globally-smaller
     # children is unbounded, so sharded fits (axis_name set) keep full-width
-    # passes regardless of this flag. Default off until the selector/gather
-    # costs are validated on TPU hardware (the compaction is a guaranteed
-    # CPU-fallback win but the TPU gather/sort cost is unmeasured through
-    # the relay as of round 3).
+    # passes regardless of this flag. Default off — validated on live TPU
+    # hardware in round 5 (docs/tpu_capture_r05/): the row-compaction
+    # gather/sort costs 3.4-10x the full-width one-hot pass it saves
+    # (depthwise 24.2 -> 7.0 argsort / 2.4 searchsorted trees/sec,
+    # leafwise 16.7 -> 4.9 at 1M x 28), so subtraction stays a
+    # CPU-fallback-only win.
     hist_subtraction: bool = False
     # Row-compaction selector for hist_subtraction: "argsort" (one stable
     # [n] sort) or "searchsorted" (cumsum + binary search, no sort). A

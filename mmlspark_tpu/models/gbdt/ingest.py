@@ -86,6 +86,11 @@ class ShardedMatrixSource:
     process's resident set is just the live chunk.
     """
 
+    @classmethod
+    def coerce(cls, source) -> "ShardedMatrixSource":
+        """Pass through an existing source; wrap a path/list otherwise."""
+        return source if isinstance(source, cls) else cls(source)
+
     def __init__(self, paths: Union[PathLike, Sequence[PathLike]]):
         if isinstance(paths, (str, os.PathLike)):
             p = os.fspath(paths)
@@ -131,20 +136,33 @@ class ShardedMatrixSource:
     def row_shape(self) -> tuple:
         return tuple(self._shards[0].shape[1:])
 
-    def _read_shard_rows(self, s: int, lo: int, hi: int) -> np.ndarray:
+    def _read_shard_rows(self, s: int, lo: int, hi: int,
+                         dtype=np.float32) -> np.ndarray:
         sh = self._shards[s]
         raw = np.fromfile(sh.path, dtype=sh.dtype,
                           count=(hi - lo) * sh.row_items,
                           offset=sh.data_offset + lo * sh.row_bytes)
         raw = raw.reshape((hi - lo,) + sh.shape[1:])
-        return np.asarray(raw, dtype=np.float32)
+        return np.asarray(raw, dtype=dtype or sh.dtype)
 
-    def read(self, start: int, stop: int) -> np.ndarray:
-        """Rows [start, stop) as float32, crossing shard boundaries."""
+    def read(self, start: int, stop: int, dtype=np.float32) -> np.ndarray:
+        """Rows [start, stop) crossing shard boundaries, coerced to
+        ``dtype`` (default float32; ``None`` keeps the stored dtype — the
+        VW streamed path reads int32 index shards this way, since a
+        float32 round-trip corrupts hashes above 2^24)."""
         start, stop = int(start), int(min(stop, self.n))
+        if dtype is None:
+            dts = {np.dtype(s.dtype) for s in self._shards}
+            if len(dts) > 1:
+                raise ValueError(
+                    "dtype=None needs a single stored dtype across shards "
+                    f"but found {sorted(map(str, dts))}; coercing mixed "
+                    "shards silently would reintroduce the float32 "
+                    "round-trip this mode exists to avoid")
+            dtype = self._shards[0].dtype
         if stop <= start:
-            return np.empty((0,) + self.row_shape, np.float32)
-        out = np.empty((stop - start,) + self.row_shape, np.float32)
+            return np.empty((0,) + self.row_shape, dtype)
+        out = np.empty((stop - start,) + self.row_shape, dtype)
         self.read_into(out, start, stop)
         return out
 
@@ -164,14 +182,15 @@ class ShardedMatrixSource:
             take = min(stop - pos, int(self._lengths[s0]) - local)
             sh = self._shards[s0]
             dst = out[pos - start:pos - start + take]
-            if (sh.dtype == np.float32 and dst.flags.c_contiguous):
+            if (sh.dtype == dst.dtype and dst.flags.c_contiguous):
                 with open(sh.path, "rb") as f:
                     f.seek(sh.data_offset + local * sh.row_bytes)
                     got = f.readinto(memoryview(dst).cast("B"))
                 if got != take * sh.row_bytes:
                     raise IOError(f"{sh.path}: short read ({got} bytes)")
             else:
-                dst[...] = self._read_shard_rows(s0, local, local + take)
+                dst[...] = self._read_shard_rows(s0, local, local + take,
+                                                 dtype=dst.dtype)
             pos += take
             s0 += 1
         return rows

@@ -182,15 +182,7 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
             val = np.concatenate([val, np.ones((n, 1), np.float32)], axis=1)
         return idx, val
 
-    def _fit_weights(self, dataset: Dataset, cfg: SGDConfig):
-        idx, val = self._features(dataset)
-        # VW semantics: the weight table masks hashes by 2^numBits (-b at
-        # access time), so a featurizer hashed wider than the learner folds
-        # by masking — never by index clamping
-        idx = idx & ((1 << cfg.num_bits) - 1)
-        y = dataset.array(self.get_or_default("labelCol"), np.float32)
-        wcol = self.get_or_default("weightCol")
-        sw = dataset.array(wcol, np.float32) if wcol else None
+    def _resolve_initial_weights(self, cfg: SGDConfig):
         init = self.get_or_default("initialModel")
         if init is not None and hasattr(init, "weights"):
             # fitted-model warm start: the model carries its constant-feature
@@ -213,6 +205,65 @@ class _VowpalWabbitBaseParams(HasLabelCol, HasFeaturesCol, HasWeightCol,
                 f"initialModel weight table has {len(init)} entries but "
                 f"numBits={cfg.num_bits} implies {1 << cfg.num_bits}; set "
                 "numBits to match the warm-start model's")
+        return init
+
+    def _fit_weights_streamed(self, index_path, value_path, label_path,
+                              weight_path, cfg: SGDConfig,
+                              chunk_rows):  # None -> trainer default
+        """Out-of-core fit: pre-hashed .npy shards -> weights + stats.
+
+        The streamed counterpart of ``_fit_weights`` (reference VW trains
+        from streamed Spark partitions; here the stream is explicit disk
+        shards, mirroring GBDT's ``construct(path=...)``). Shards carry
+        ALREADY-HASHED features — the output of
+        :class:`VowpalWabbitFeaturizer` written chunk-wise — including the
+        constant feature if the estimator expects one (noConstant=False),
+        since hashing happens at write time, not here.
+        """
+        if cfg.optimizer == "bfgs":
+            raise ValueError(
+                "--bfgs is a batch solver over in-memory arrays; the "
+                "streamed path supports the sgd optimizer only")
+        if self.get_or_default("checkpointDir"):
+            raise ValueError(
+                "checkpointDir is not supported with streamed fits yet; "
+                "chunk-level state already bounds re-run cost")
+        if self.get_or_default("weightCol") and weight_path is None:
+            raise ValueError(
+                "weightCol is set but no weight_path was given; streamed "
+                "fits read sample weights from shards — pass weight_path= "
+                "or clear weightCol to train unweighted")
+        from ..gbdt.ingest import ShardedMatrixSource
+        from .sgd import train_sgd_streamed
+        init = self._resolve_initial_weights(cfg)
+        # coerce once; train_sgd_streamed accepts sources, so the shard
+        # headers are parsed a single time and n comes from the same object
+        label_src = ShardedMatrixSource.coerce(label_path)
+        n = label_src.n
+        sw_time = StopWatch()
+        with sw_time:
+            weights = train_sgd_streamed(
+                index_path, value_path, label_src, weight_path,
+                cfg=cfg, initial_weights=init, chunk_rows=chunk_rows)
+        stats = {
+            "numExamples": n,
+            "learnTimeNs": sw_time.elapsed_ns(),
+            "numBits": cfg.num_bits,
+            "numPasses": cfg.num_passes,
+            "numWeights": int((weights != 0).sum()),
+        }
+        return weights, stats
+
+    def _fit_weights(self, dataset: Dataset, cfg: SGDConfig):
+        idx, val = self._features(dataset)
+        # VW semantics: the weight table masks hashes by 2^numBits (-b at
+        # access time), so a featurizer hashed wider than the learner folds
+        # by masking — never by index clamping
+        idx = idx & ((1 << cfg.num_bits) - 1)
+        y = dataset.array(self.get_or_default("labelCol"), np.float32)
+        wcol = self.get_or_default("weightCol")
+        sw = dataset.array(wcol, np.float32) if wcol else None
+        init = self._resolve_initial_weights(cfg)
         ckpt_dir = self.get_or_default("checkpointDir")
         sw_time = StopWatch()
         with sw_time:
@@ -320,6 +371,24 @@ class VowpalWabbitClassifier(Estimator, _VowpalWabbitBaseParams,
         self._copy_params_to(model)
         return model
 
+    def fit_streamed(self, index_path, value_path, label_path,
+                     weight_path=None, *, chunk_rows: int = None
+                     ) -> "VowpalWabbitClassificationModel":
+        """Fit from pre-hashed disk shards with bounded host memory (see
+        ``_fit_weights_streamed``). Label shards must hold 0/1 labels (the
+        in-memory default); labelConversion=False's -1/+1 convention would
+        need a disk rewrite, so it is rejected here."""
+        if not self.get_or_default("labelConversion"):
+            raise ValueError(
+                "labelConversion=False is not supported with fit_streamed; "
+                "store 0/1 labels in the shards (the default convention)")
+        cfg = self._sgd_config(self.get_or_default("lossFunction"))
+        weights, stats = self._fit_weights_streamed(
+            index_path, value_path, label_path, weight_path, cfg, chunk_rows)
+        model = VowpalWabbitClassificationModel(weights, stats)
+        self._copy_params_to(model)
+        return model
+
 
 class VowpalWabbitClassificationModel(_VowpalWabbitModelBase,
                                       HasRawPredictionCol, HasProbabilityCol):
@@ -349,6 +418,18 @@ class VowpalWabbitRegressor(Estimator, _VowpalWabbitBaseParams):
     def fit(self, dataset: Dataset) -> "VowpalWabbitRegressionModel":
         cfg = self._sgd_config(self.get_or_default("lossFunction"))
         weights, stats = self._fit_weights(dataset, cfg)
+        model = VowpalWabbitRegressionModel(weights, stats)
+        self._copy_params_to(model)
+        return model
+
+    def fit_streamed(self, index_path, value_path, label_path,
+                     weight_path=None, *, chunk_rows: int = None
+                     ) -> "VowpalWabbitRegressionModel":
+        """Fit from pre-hashed disk shards with bounded host memory (see
+        ``_fit_weights_streamed``)."""
+        cfg = self._sgd_config(self.get_or_default("lossFunction"))
+        weights, stats = self._fit_weights_streamed(
+            index_path, value_path, label_path, weight_path, cfg, chunk_rows)
         model = VowpalWabbitRegressionModel(weights, stats)
         self._copy_params_to(model)
         return model

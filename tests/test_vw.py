@@ -577,3 +577,164 @@ class TestRound4TailParams:
             additionalSharedFeatures=["extra"]).fit(ds)
         out = m.transform(ds)
         assert len(out["prediction"]) == n
+
+
+class TestStreamedFit:
+    """Out-of-core VW training over .npy shards (train_sgd_streamed /
+    fit_streamed) — the streamed counterpart of the reference's
+    partition-iterator training (vw/VowpalWabbitBase.scala trainRow)."""
+
+    def _write_shards(self, d, name, arr, parts=3):
+        sub = d / name
+        sub.mkdir()
+        cuts = np.linspace(0, len(arr), parts + 1).astype(int)
+        for i, (lo, hi) in enumerate(zip(cuts[:-1], cuts[1:])):
+            np.save(sub / f"part{i:03d}.npy", arr[lo:hi])
+        return str(sub)
+
+    def _data(self, n=512, nnz=4, bits=12, seed=0):
+        rng = np.random.default_rng(seed)
+        idx = rng.integers(0, 1 << bits, size=(n, nnz), dtype=np.int32)
+        val = rng.normal(size=(n, nnz)).astype(np.float32)
+        y = (val[:, 0] > 0).astype(np.float32)
+        return idx, val, y
+
+    def _one_device_mesh(self):
+        import jax
+        from mmlspark_tpu.parallel import mesh as meshlib
+        return meshlib.make_mesh({"data": 1}, devices=jax.devices()[:1])
+
+    def test_bit_identity_aligned_chunks(self, tmp_path):
+        from mmlspark_tpu.models.vw.sgd import (SGDConfig, train_sgd,
+                                                train_sgd_streamed)
+        idx, val, y = self._data()
+        cfg = SGDConfig(num_bits=12, loss="logistic", num_passes=3,
+                        batch_size=64, adaptive=True)
+        mesh = self._one_device_mesh()
+        w_mem = train_sgd(idx, val, y, None, cfg, mesh=mesh)
+        paths = [self._write_shards(tmp_path, k, a) for k, a in
+                 [("idx", idx), ("val", val), ("y", y)]]
+        # chunk_rows=128 is a whole number of 64-row batches, so every
+        # chunk call replays exactly the batches the in-memory scan ran
+        w_st = train_sgd_streamed(*paths, cfg=cfg, mesh=mesh,
+                                  chunk_rows=128)
+        np.testing.assert_array_equal(w_mem, w_st)
+
+    @pytest.mark.parametrize("over", [
+        dict(adaptive=True),
+        dict(adaptive=False, power_t=0.5),   # step clock drives the lr decay
+        dict(adaptive=True, l1=0.01),        # lazy-L1 last-touch clock
+    ])
+    def test_unaligned_request_rounds_to_bit_identity(self, tmp_path, over):
+        # chunk_rows is rounded down to whole device-batch groups, so even
+        # a ragged request (200 -> 192 at batch_size=64) replays exactly
+        # the in-memory batches with pads only at the stream tail — the
+        # step clock sees no phantom steps and every config (AdaGrad,
+        # power_t decay, lazy L1) is bit-identical to in-memory
+        from mmlspark_tpu.models.vw.sgd import (SGDConfig, train_sgd,
+                                                train_sgd_streamed)
+        idx, val, y = self._data(n=500)
+        cfg = SGDConfig(num_bits=12, loss="logistic", num_passes=2,
+                        batch_size=64, **over)
+        mesh = self._one_device_mesh()
+        w_mem = train_sgd(idx, val, y, None, cfg, mesh=mesh)
+        paths = [self._write_shards(tmp_path, k, a) for k, a in
+                 [("idx", idx), ("val", val), ("y", y)]]
+        w_st = train_sgd_streamed(*paths, cfg=cfg, mesh=mesh,
+                                  chunk_rows=200)
+        if over.get("l1"):
+            # the lazy-L1 soft-threshold catch-up composes exactly across
+            # chunk boundaries in real arithmetic (shrink(shrink(w,a),b) ==
+            # shrink(w,a+b)) but not bitwise — (w-x)-y vs w-(x+y)
+            np.testing.assert_allclose(w_mem, w_st, atol=1e-6)
+        else:
+            np.testing.assert_array_equal(w_mem, w_st)
+
+    def test_fit_streamed_matches_fit(self, tmp_path):
+        from mmlspark_tpu.parallel import mesh as meshlib
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(512, 6)).astype(np.float32)
+        y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+        ds = Dataset({"features": [r for r in X], "label": y})
+        dsf = VowpalWabbitFeaturizer(inputCols=["features"],
+                                     outputCol="features").transform(ds)
+        est = VowpalWabbitClassifier(numBits=12, numPasses=2)
+        with meshlib.default_mesh(self._one_device_mesh()):
+            m_mem = est.fit(dsf)
+            idx, val = est._features(dsf)
+            paths = [self._write_shards(tmp_path, k, a) for k, a in
+                     [("idx", idx), ("val", val), ("y", y)]]
+            m_st = VowpalWabbitClassifier(numBits=12, numPasses=2) \
+                .fit_streamed(*paths, chunk_rows=128)
+            np.testing.assert_array_equal(m_mem.weights, m_st.weights)
+            assert m_st.stats["numExamples"] == 512
+            acc = (m_st.transform(dsf).array("prediction") == y).mean()
+            assert acc > 0.9
+
+    def test_streamed_validation_errors(self, tmp_path):
+        from mmlspark_tpu.models.vw.sgd import SGDConfig, train_sgd_streamed
+        idx, val, y = self._data(n=96)
+        paths = [self._write_shards(tmp_path, k, a) for k, a in
+                 [("idx", idx), ("val", val), ("y", y[:64])]]
+        cfg = SGDConfig(num_bits=12, loss="logistic")
+        with pytest.raises(ValueError, match="row counts disagree"):
+            train_sgd_streamed(*paths, cfg=cfg)
+        with pytest.raises(ValueError, match="chunk_rows"):
+            train_sgd_streamed(paths[0], paths[1], paths[0], cfg=cfg,
+                               chunk_rows=0)
+        est = VowpalWabbitClassifier(
+            numBits=12, passThroughArgs="--bfgs")
+        with pytest.raises(ValueError, match="bfgs"):
+            est.fit_streamed(paths[0], paths[1], paths[0])
+        with pytest.raises(ValueError, match="weight_path"):
+            VowpalWabbitClassifier(numBits=12, weightCol="w").fit_streamed(
+                paths[0], paths[1], paths[0])
+        with pytest.raises(ValueError, match="labelConversion"):
+            VowpalWabbitClassifier(labelConversion=False).fit_streamed(
+                paths[0], paths[1], paths[0])
+
+    def test_raw_hash_shards_fold_by_mask(self, tmp_path):
+        # shards may carry raw 32-bit murmur hashes (int64 storage); the
+        # streamed path folds them by 2^num_bits exactly like _fit_weights
+        from mmlspark_tpu.models.vw.sgd import (SGDConfig, train_sgd,
+                                                train_sgd_streamed)
+        idx, val, y = self._data(bits=12)
+        raw = idx.astype(np.int64) + (np.arange(len(idx))[:, None] << 12)
+        cfg = SGDConfig(num_bits=12, loss="logistic", batch_size=64)
+        mesh = self._one_device_mesh()
+        w_mem = train_sgd((raw & 0xFFF).astype(np.int32), val, y, None,
+                          cfg, mesh=mesh)
+        paths = [self._write_shards(tmp_path, k, a) for k, a in
+                 [("idx", raw), ("val", val), ("y", y)]]
+        w_st = train_sgd_streamed(*paths, cfg=cfg, mesh=mesh,
+                                  chunk_rows=128)
+        np.testing.assert_array_equal(w_mem, w_st)
+
+    def test_streamed_review_edges(self, tmp_path):
+        # review findings: zero passes returns the zero vector (train_sgd
+        # parity), 1-D feature shards are rejected clearly, and mixed
+        # stored dtypes are rejected under dtype=None reads
+        from mmlspark_tpu.models.gbdt.ingest import ShardedMatrixSource
+        from mmlspark_tpu.models.vw.sgd import SGDConfig, train_sgd_streamed
+        idx, val, y = self._data(n=128)
+        paths = [self._write_shards(tmp_path, k, a) for k, a in
+                 [("idx", idx), ("val", val), ("y", y)]]
+        cfg = SGDConfig(num_bits=12, loss="logistic", num_passes=0,
+                        batch_size=64)
+        w = train_sgd_streamed(*paths, cfg=cfg,
+                               mesh=self._one_device_mesh())
+        assert w.shape == (4096,) and not w.any()
+
+        flat = self._write_shards(tmp_path, "flat", val[:, 0])
+        with pytest.raises(ValueError, match="2-D"):
+            train_sgd_streamed(flat, flat, paths[2], cfg=cfg)
+
+        mixed = tmp_path / "mixed"
+        mixed.mkdir()
+        np.save(mixed / "a.npy", idx[:64].astype(np.float32))
+        np.save(mixed / "b.npy", idx[64:].astype(np.int64))
+        src = ShardedMatrixSource(str(mixed))
+        with pytest.raises(ValueError, match="single stored dtype"):
+            src.read(0, 128, dtype=None)
+        # float32 coercion across mixed shards stays supported
+        assert src.read(0, 128).dtype == np.float32

@@ -1,0 +1,96 @@
+"""Device TreeSHAP throughput on the live TPU.
+
+The open risk from round 4 (VERDICT "Weak #4"): the fixed-shape device
+TreeSHAP formulation (treeshap_device.py) loses to the host recursion on
+the XLA CPU backend and had never run on real hardware, so the
+``featuresShapCol`` path at reference scale (500 trees through native
+C++ TreeSHAP — lightgbm/LightGBMBooster.scala:250-269) was justified only
+by a design argument. This script measures it: trains a booster at the
+reference-ish explanation shape (100 and 500 trees x 31 leaves, 28
+features), then times
+
+  - device TreeSHAP   (shap_values_device, rows/sec)
+  - host TreeSHAP     (Lundberg Alg. 2 recursion, rows/sec, small sample)
+  - saabas            (the throughput option, rows/sec)
+
+with the tunnel-safe sync discipline (ends in a host download; the
+device path's output IS a host array so the download is inherent).
+
+Prints one JSON line per measurement. Usage:
+    python tools/tpu_treeshap_bench.py [quick]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(quick=False):
+    import jax
+    import numpy as np
+
+    from mmlspark_tpu.models.gbdt.booster import (LightGBMDataset,
+                                                  train_booster)
+    from mmlspark_tpu.models.gbdt.growth import GrowConfig
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+    print(json.dumps({"platform": jax.devices()[0].platform,
+                      "device": str(jax.devices()[0])}), flush=True)
+
+    rng = np.random.default_rng(7)
+    n, F = 200_000, 28
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.1 * rng.normal(size=n)
+         > 0).astype(np.float32)
+    ds = LightGBMDataset.construct(X, y, max_bin=63)
+
+    for n_trees in ([100] if quick else [100, 500]):
+        booster = train_booster(
+            dataset=ds, num_iterations=n_trees, objective="binary",
+            cfg=GrowConfig(num_leaves=31, growth_policy="depthwise"))
+        n_expl = 2048 if quick else 8192
+        Xe = X[:n_expl]
+
+        os.environ["MMLSPARK_TPU_SHAP_DEVICE"] = "1"
+        os.environ.pop("MMLSPARK_TPU_SHAP_HOST", None)
+        booster.predict_contrib(Xe[:256])          # compile
+        best = float("inf")
+        phi_dev = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            phi_dev = booster.predict_contrib(Xe)
+            best = min(best, time.perf_counter() - t0)
+        print(json.dumps({"treeshap_device_rows_per_sec":
+                          round(n_expl / best, 1),
+                          "n_trees": n_trees, "n_rows": n_expl}),
+              flush=True)
+
+        # host recursion on a smaller sample (it is the slow reference)
+        n_host = 512
+        os.environ["MMLSPARK_TPU_SHAP_HOST"] = "1"
+        os.environ.pop("MMLSPARK_TPU_SHAP_DEVICE", None)
+        t0 = time.perf_counter()
+        phi_host = booster.predict_contrib(Xe[:n_host])
+        host_dt = time.perf_counter() - t0
+        os.environ.pop("MMLSPARK_TPU_SHAP_HOST", None)
+        err = float(np.abs(phi_dev[:n_host] - phi_host).max())
+        print(json.dumps({"treeshap_host_rows_per_sec":
+                          round(n_host / host_dt, 1),
+                          "n_trees": n_trees,
+                          "device_vs_host_max_abs_err": err}), flush=True)
+
+        booster.predict_contrib(Xe[:256], method="saabas")   # compile
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            booster.predict_contrib(Xe, method="saabas")
+            best = min(best, time.perf_counter() - t0)
+        print(json.dumps({"saabas_rows_per_sec": round(n_expl / best, 1),
+                          "n_trees": n_trees}), flush=True)
+
+
+if __name__ == "__main__":
+    main(quick="quick" in sys.argv[1:])
