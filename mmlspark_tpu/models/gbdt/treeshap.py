@@ -25,6 +25,8 @@ fixed-shape XLA; the device path keeps the throughput-critical Saabas mode.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 
@@ -142,12 +144,7 @@ def tree_shap_single(feat, left, right, is_leaf, cover, values,
         stack.append((hi, d, z, o, w, float(cover[hi]) / cj * iz,
                       io * (1.0 - gl), f))
 
-    # expected value: cover-weighted mean of leaf values (the value the
-    # contributions sum from: sum(phi) + E[f] == f(x))
-    leaves = is_leaf & (cover > 0)
-    tot = max(float(cover[leaves].sum()), 1e-12)
-    phi[:, n_features] = float(
-        (values[leaves] * cover[leaves]).sum() / tot)
+    phi[:, n_features] = _expected_value(is_leaf, cover, values)
     return phi
 
 
@@ -187,6 +184,14 @@ def shap_values(booster, X: np.ndarray) -> np.ndarray:
             "internal_count/leaf_count fields) — use "
             "predict_contrib(method='saabas') for cover-free attribution")
 
+    # engine: the native C++ per-instance recursion (threaded; the same
+    # role the reference's LGBM_BoosterPredictForMatSingle plays) unless
+    # unavailable or disabled, else this module's vectorized numpy
+    # recursion. Both consume the SAME go_left routing matrix, so split
+    # semantics (thresholds, categoricals, NaN) have one definition.
+    use_native = os.environ.get("MMLSPARK_TPU_SHAP_NATIVE") != "0"
+    if use_native:
+        from ...native import treeshap_tree
     for t in range(booster.num_trees):
         k = t % K
         feat = feat_np[t]
@@ -201,14 +206,33 @@ def shap_values(booster, X: np.ndarray) -> np.ndarray:
                                booster._cat_max_idx(),
                                booster._cat_strict()),
                 gl)
-        phi = tree_shap_single(
-            feat, np.asarray(trees.left[t]),
-            np.asarray(trees.right[t]), np.asarray(trees.is_leaf[t]),
-            np.asarray(trees.node_cnt[t], dtype=np.float64),
-            np.asarray(trees.leaf_value[t], dtype=np.float64), gl, F)
-        out[:, k * (F + 1):k * (F + 1) + F] += phi[:, :F]
-        out[:, k * (F + 1) + F] += phi[:, F]
+        is_leaf = np.asarray(trees.is_leaf[t])
+        cover = np.asarray(trees.node_cnt[t], dtype=np.float64)
+        values = np.asarray(trees.leaf_value[t], dtype=np.float64)
+        phi_f = None
+        if use_native:
+            phi_f = treeshap_tree(
+                feat, np.asarray(trees.left[t]),
+                np.asarray(trees.right[t]), is_leaf, cover, values, gl, F)
+        if phi_f is not None:
+            out[:, k * (F + 1):k * (F + 1) + F] += phi_f
+            out[:, k * (F + 1) + F] += _expected_value(is_leaf, cover,
+                                                       values)
+        else:
+            phi = tree_shap_single(
+                feat, np.asarray(trees.left[t]),
+                np.asarray(trees.right[t]), is_leaf, cover, values, gl, F)
+            out[:, k * (F + 1):k * (F + 1) + F] += phi[:, :F]
+            out[:, k * (F + 1) + F] += phi[:, F]
     return out
+
+
+def _expected_value(is_leaf, cover, values) -> float:
+    """Cover-weighted mean of leaf values — the tree's E[f], the base the
+    contributions sum from (sum(phi) + E[f] == f(x))."""
+    leaves = is_leaf & (cover > 0)
+    tot = max(float(cover[leaves].sum()), 1e-12)
+    return float((values[leaves] * cover[leaves]).sum() / tot)
 
 
 def _has_device_arrays(trees) -> bool:

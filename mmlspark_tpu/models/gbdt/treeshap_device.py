@@ -27,9 +27,10 @@ pinned against it in tests/test_treeshap.py.
 
 Backend choice: this formulation targets the TPU (hundreds of small fused
 VPU/MXU ops per tree, one scanned executable, rows on the lane axis). On
-the XLA **CPU** backend those same small ops lose to the numpy recursion
-(measured 706 vs ~1150 rows/s at 100 trees), so ``predict_contrib``
-defaults to host off-accelerator and device on TPU
+the XLA **CPU** backend those same small ops lose to the host engines
+(measured 706 vs ~1150 rows/s at 100 trees against the numpy recursion,
+and the round-5 native C++ engine runs 4-5x the numpy one on top), so
+``predict_contrib`` defaults to host off-accelerator and device on TPU
 (MMLSPARK_TPU_SHAP_DEVICE=1 / MMLSPARK_TPU_SHAP_HOST=1 override).
 
 Reference parity anchor: lightgbm/LightGBMBooster.scala:250-269

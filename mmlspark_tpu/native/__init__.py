@@ -72,8 +72,8 @@ def _compile() -> Optional[str]:
     for cxx in (os.environ.get("CXX"), "g++", "c++", "clang++"):
         if not cxx:
             continue
-        cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", _SOURCE,
-               "-o", tmp_path]
+        cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               _SOURCE, "-o", tmp_path]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
             os.replace(tmp_path, so_path)
@@ -108,12 +108,22 @@ def get_lib() -> Optional[ctypes.CDLL]:
         return _lib
 
 
+# every symbol _bind wires up: a prebuilt .so from an older source tree
+# (missing a newer symbol) must fall through to a recompile, not latch the
+# whole module to the Python fallback
+_EXPECTED_SYMBOLS = ("mm_murmur3_32", "mm_murmur3_batch", "mm_bin_batch",
+                     "mm_csv_read_floats", "mm_treeshap")
+
+
 def _load() -> Optional[ctypes.CDLL]:
     if os.environ.get("MMLSPARK_TPU_DISABLE_NATIVE"):
         return None
     if os.path.exists(_PREBUILT):
         try:
-            return ctypes.CDLL(_PREBUILT)
+            lib = ctypes.CDLL(_PREBUILT)
+            if all(hasattr(lib, s) for s in _EXPECTED_SYMBOLS):
+                return lib
+            # stale prebuilt (pre-dates a symbol): recompile from source
         except OSError:
             pass  # wrong arch/ABI for this host: recompile from source
     so = _compile()
@@ -143,6 +153,13 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.mm_csv_read_floats.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
         ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+    lib.mm_treeshap.restype = ctypes.c_int64
+    lib.mm_treeshap.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_double)]
 
 
 def native_available() -> bool:
@@ -234,3 +251,47 @@ def csv_read_floats(text: str, ncols: int,
     if n < 0:
         raise ValueError(f"CSV shape mismatch: expected {ncols} columns")
     return out[:n]
+
+
+def treeshap_tree(feat: np.ndarray, left: np.ndarray, right: np.ndarray,
+                  is_leaf: np.ndarray, cover: np.ndarray,
+                  values: np.ndarray, go_left: np.ndarray,
+                  n_features: int,
+                  n_threads: int = 0) -> Optional[np.ndarray]:
+    """Exact TreeSHAP for one tree, all instances: -> float64[n, F].
+
+    ``go_left`` is the [M, n] per-node routing matrix the caller
+    precomputes (thresholds / categorical bitsets / NaN policy stay in
+    models/gbdt/treeshap.py, the single source of split semantics).
+    Returns None when the native library is unavailable — the caller
+    falls back to the vectorized numpy recursion; there is deliberately
+    no Python fallback here because that numpy engine IS the fallback.
+    ``n_threads=0`` uses the hardware concurrency.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    feat = np.ascontiguousarray(feat, dtype=np.int32)
+    left = np.ascontiguousarray(left, dtype=np.int32)
+    right = np.ascontiguousarray(right, dtype=np.int32)
+    is_leaf = np.ascontiguousarray(is_leaf, dtype=np.uint8)
+    cover = np.ascontiguousarray(cover, dtype=np.float64)
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    go_left = np.ascontiguousarray(go_left, dtype=np.uint8)
+    M, n = go_left.shape
+    phi = np.zeros((n, int(n_features)), dtype=np.float64)
+    rc = lib.mm_treeshap(
+        feat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        left.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        right.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        is_leaf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        cover.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        go_left.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        M, n, int(n_features), int(n_threads),
+        phi.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    if rc != 0:
+        # malformed tree (child index out of range): let the Python
+        # engine run instead — it raises a meaningful IndexError
+        return None
+    return phi

@@ -209,3 +209,59 @@ class TestExactTreeSHAP:
         c = b.predict_contrib(X[:50], method="treeshap")
         raw = b.predict_raw(X[:50])[:, 0]
         np.testing.assert_allclose(c.sum(axis=1), raw, atol=2e-3)
+
+
+class TestNativeEngine:
+    """The C++ per-instance recursion (native/mmlspark_native.cpp
+    mm_treeshap — the role LightGBM's native TreeSHAP plays for the
+    reference) must reproduce the vectorized numpy engine bitwise-close
+    on every tree shape; both consume the same go_left routing matrix."""
+
+    def _both_engines(self, booster, X, monkeypatch):
+        from mmlspark_tpu import native
+        if not native.native_available():
+            import pytest as _pytest
+            _pytest.skip("no native toolchain on this host")
+        monkeypatch.setenv("MMLSPARK_TPU_SHAP_HOST", "1")
+        monkeypatch.setenv("MMLSPARK_TPU_SHAP_NATIVE", "0")
+        phi_np = booster.predict_contrib(X)
+        monkeypatch.setenv("MMLSPARK_TPU_SHAP_NATIVE", "1")
+        phi_nat = booster.predict_contrib(X)
+        return phi_np, phi_nat
+
+    def test_matches_numpy_engine(self, monkeypatch):
+        data = load_breast_cancer()
+        X = data.data[:400].astype(np.float32)
+        y = data.target[:400].astype(np.float32)
+        b = train_booster(X, y, objective="binary", num_iterations=20,
+                          cfg=GrowConfig(num_leaves=15,
+                                         growth_policy="leafwise"),
+                          max_bin=63)
+        phi_np, phi_nat = self._both_engines(b, X[:100], monkeypatch)
+        np.testing.assert_allclose(phi_np, phi_nat, atol=1e-10)
+        raw = b.predict_raw(X[:100])[:, 0]
+        np.testing.assert_allclose(phi_nat.sum(axis=1), raw, atol=1e-3)
+
+    def test_deep_chain_arena_depth(self, monkeypatch):
+        # chain-shaped tree (depth ~ num_leaves) stresses the per-level
+        # arena sizing in the C++ engine
+        n = 2000
+        X = np.arange(n, dtype=np.float32)[:, None]
+        y = (np.arange(n) % 5).astype(np.float32)
+        b = train_booster(X, y, objective="regression", num_iterations=1,
+                          cfg=GrowConfig(num_leaves=48, min_data_in_leaf=2,
+                                         leaf_batch=1), max_bin=255)
+        phi_np, phi_nat = self._both_engines(b, X[:32], monkeypatch)
+        np.testing.assert_allclose(phi_np, phi_nat, atol=1e-10)
+
+    def test_multiclass_and_nan(self, monkeypatch):
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(500, 6)).astype(np.float32)
+        y = (rng.integers(0, 3, size=500)).astype(np.float32)
+        b = train_booster(X, y, objective="multiclass", num_class=3,
+                          num_iterations=6,
+                          cfg=GrowConfig(num_leaves=7), max_bin=31)
+        Xq = X[:64].copy()
+        Xq[:8, 2] = np.nan
+        phi_np, phi_nat = self._both_engines(b, Xq, monkeypatch)
+        np.testing.assert_allclose(phi_np, phi_nat, atol=1e-10)
