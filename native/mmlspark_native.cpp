@@ -25,6 +25,108 @@
 #include <thread>
 #include <vector>
 
+#include <unistd.h>
+
+namespace {
+
+// Persistent worker pool shared by every threaded native entry point
+// (TreeSHAP is called once per tree — hundreds of times per explain —
+// and spawning + joining a thread team per call costs tens of
+// microseconds each on many-core hosts). Workers
+// are started once, parked on a condition variable between calls, and
+// handed (job, row-range) work via a shared generation counter; calls are
+// serialized by a dispatch mutex (each call already saturates the cores).
+class WorkPool {
+ public:
+  static WorkPool& instance() {
+    // deliberately leaked: a static-local would run its destructor at
+    // process exit while detached workers still wait on cv_/mu_, which
+    // is undefined behavior (pthread destroy with waiters)
+    static WorkPool* pool = new WorkPool();
+    return *pool;
+  }
+
+  // run fn(r0, r1) over [0, n) split across nt ranges (nt <= size()+1);
+  // the calling thread works too, so nt == 1 never touches the pool
+  void run(int64_t n, int64_t nt,
+           const std::function<void(int64_t, int64_t)>& fn) {
+    const int64_t step = (n + nt - 1) / nt;
+    // fork safety: a child inherits workers_.size() but ZERO live worker
+    // threads (only the forking thread survives fork) — publishing work
+    // to them would block done_cv_.wait forever, so the child runs serial
+    if (nt <= 1 || workers_.empty() || getpid() != owner_pid_) {
+      fn(0, n);
+      return;
+    }
+    std::unique_lock<std::mutex> dispatch(dispatch_mu_);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      job_ = &fn;
+      job_n_ = n;
+      job_step_ = step;
+      job_ranges_ = nt - 1;   // pool handles all but the caller's range
+      next_range_ = 0;
+      done_count_ = 0;
+      generation_++;
+    }
+    cv_.notify_all();
+    fn((nt - 1) * step, std::min(n, nt * step));  // caller's share
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [&] { return done_count_ >= job_ranges_; });
+    // job_ cleared under mu_ AFTER every range completed, so a late-waking
+    // worker can never claim from a stale/dangling job
+    job_ = nullptr;
+  }
+
+  int64_t size() const { return (int64_t)workers_.size(); }
+
+ private:
+  WorkPool() : owner_pid_(getpid()) {
+    unsigned hw = std::thread::hardware_concurrency();
+    const char* cap = std::getenv("MMLSPARK_TPU_NATIVE_THREADS");
+    long want = cap ? std::strtol(cap, nullptr, 10) : (long)hw;
+    want = std::max(1L, std::min(want, (long)(hw ? hw : 1)));
+    for (long t = 0; t + 1 < want; t++) {  // caller thread counts as one
+      workers_.emplace_back([this] { this->loop(); });
+      workers_.back().detach();  // process-lifetime pool
+    }
+  }
+
+  // Range claims happen UNDER mu_ (a handful of claims per call — the
+  // lock is not contended at that granularity), which makes staleness
+  // impossible by construction: a claim observes (job_, generation_)
+  // atomically with the counter it advances.
+  void loop() {
+    uint64_t seen = 0;
+    std::unique_lock<std::mutex> lk(mu_);
+    while (true) {
+      cv_.wait(lk, [&] { return job_ != nullptr && generation_ != seen; });
+      seen = generation_;
+      while (job_ != nullptr && next_range_ < job_ranges_) {
+        const int64_t r = next_range_++;
+        const auto* job = job_;
+        const int64_t n = job_n_, step = job_step_;
+        lk.unlock();
+        (*job)(r * step, std::min(n, (r + 1) * step));
+        lk.lock();
+        if (++done_count_ >= job_ranges_) done_cv_.notify_all();
+      }
+    }
+  }
+
+  std::vector<std::thread> workers_;
+  const pid_t owner_pid_;   // workers die across fork; children go serial
+  std::mutex dispatch_mu_;  // one job in flight at a time
+  std::mutex mu_;
+  std::condition_variable cv_, done_cv_;
+  const std::function<void(int64_t, int64_t)>* job_ = nullptr;
+  int64_t job_n_ = 0, job_step_ = 0, job_ranges_ = 0;
+  int64_t next_range_ = 0, done_count_ = 0;
+  uint64_t generation_ = 0;
+};
+
+}  // namespace
+
 extern "C" {
 
 // ---------------------------------------------------------------------------
@@ -80,13 +182,19 @@ uint32_t mm_murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
 }
 
 // Batch: n strings packed into one utf-8 buffer with offsets[n+1]; one seed
-// per string (the VW namespace hash). Out: n uint32 hashes.
+// per string (the VW namespace hash). Out: n uint32 hashes. Rows are
+// independent — large batches (featurizer transform over a chunk) fan out
+// over the worker pool; the threshold keeps small calls on the caller.
 void mm_murmur3_batch(const uint8_t* buf, const int64_t* offsets,
                       const uint32_t* seeds, int64_t n, uint32_t* out) {
-  for (int64_t i = 0; i < n; i++) {
-    out[i] = mm_murmur3_32(buf + offsets[i], offsets[i + 1] - offsets[i],
-                           seeds[i]);
-  }
+  const int64_t nt =
+      n >= 65536 ? WorkPool::instance().size() + 1 : 1;
+  WorkPool::instance().run(n, nt, [&](int64_t r0, int64_t r1) {
+    for (int64_t i = r0; i < r1; i++) {
+      out[i] = mm_murmur3_32(buf + offsets[i], offsets[i + 1] - offsets[i],
+                             seeds[i]);
+    }
+  });
 }
 
 // ---------------------------------------------------------------------------
@@ -97,25 +205,31 @@ void mm_murmur3_batch(const uint8_t* buf, const int64_t* offsets,
 
 void mm_bin_batch(const float* X, int64_t n, int64_t F, const float* bounds,
                   int64_t B1 /* = max_bin - 1 */, int32_t* out) {
-  for (int64_t r = 0; r < n; r++) {
-    const float* row = X + r * F;
-    int32_t* orow = out + r * F;
-    for (int64_t f = 0; f < F; f++) {
-      float v = row[f];
-      if (std::isnan(v)) {
-        orow[f] = 0;
-        continue;
+  // rows are independent; out-of-core ingest bins millions of rows per
+  // chunk, so large batches fan out over the worker pool
+  const int64_t nt =
+      n * F >= 1 << 20 ? WorkPool::instance().size() + 1 : 1;
+  WorkPool::instance().run(n, nt, [&](int64_t r0, int64_t r1) {
+    for (int64_t r = r0; r < r1; r++) {
+      const float* row = X + r * F;
+      int32_t* orow = out + r * F;
+      for (int64_t f = 0; f < F; f++) {
+        float v = row[f];
+        if (std::isnan(v)) {
+          orow[f] = 0;
+          continue;
+        }
+        const float* ub = bounds + f * B1;
+        // branch-light binary search: first index where ub[i] >= v
+        int64_t lo = 0, hi = B1;
+        while (lo < hi) {
+          int64_t mid = (lo + hi) >> 1;
+          if (ub[mid] < v) lo = mid + 1; else hi = mid;
+        }
+        orow[f] = (int32_t)lo;
       }
-      const float* ub = bounds + f * B1;
-      // branch-light binary search: first index where ub[i] >= v
-      int64_t lo = 0, hi = B1;
-      while (lo < hi) {
-        int64_t mid = (lo + hi) >> 1;
-        if (ub[mid] < v) lo = mid + 1; else hi = mid;
-      }
-      orow[f] = (int32_t)lo;
     }
-  }
+  });
 }
 
 // ---------------------------------------------------------------------------
@@ -339,12 +453,16 @@ int ts_max_depth(const TsTree& T, int64_t M) {
   std::vector<int32_t> stack_node{0};
   std::vector<int32_t> stack_depth{0};
   int maxd = 0;
+  int64_t pops = 0;
   while (!stack_node.empty()) {
     const int32_t j = stack_node.back();
     const int32_t dep = stack_depth.back();
     stack_node.pop_back();
     stack_depth.pop_back();
     if (j < 0 || j >= M) return -1;
+    // a valid M-node tree pops each node once; in-range child indices
+    // forming a CYCLE would walk forever without this bound
+    if (++pops > M) return -1;
     maxd = std::max(maxd, (int)dep);
     if (!T.is_leaf[j]) {
       stack_node.push_back(T.left[j]);
@@ -355,94 +473,6 @@ int ts_max_depth(const TsTree& T, int64_t M) {
   }
   return maxd;
 }
-
-// Persistent worker pool: predict_contrib calls mm_treeshap once per tree
-// (hundreds of times per explain), and spawning + joining a thread team
-// per call costs tens of microseconds each on many-core hosts. Workers
-// are started once, parked on a condition variable between calls, and
-// handed (job, row-range) work via a shared generation counter; calls are
-// serialized by a dispatch mutex (each call already saturates the cores).
-class TsPool {
- public:
-  static TsPool& instance() {
-    static TsPool pool;
-    return pool;
-  }
-
-  // run fn(r0, r1) over [0, n) split across nt ranges (nt <= size()+1);
-  // the calling thread works too, so nt == 1 never touches the pool
-  void run(int64_t n, int64_t nt,
-           const std::function<void(int64_t, int64_t)>& fn) {
-    const int64_t step = (n + nt - 1) / nt;
-    if (nt <= 1 || workers_.empty()) {
-      fn(0, n);
-      return;
-    }
-    std::unique_lock<std::mutex> dispatch(dispatch_mu_);
-    {
-      std::lock_guard<std::mutex> lk(mu_);
-      job_ = &fn;
-      job_n_ = n;
-      job_step_ = step;
-      job_ranges_ = nt - 1;   // pool handles all but the caller's range
-      next_range_ = 0;
-      done_count_ = 0;
-      generation_++;
-    }
-    cv_.notify_all();
-    fn((nt - 1) * step, std::min(n, nt * step));  // caller's share
-    std::unique_lock<std::mutex> lk(mu_);
-    done_cv_.wait(lk, [&] { return done_count_ >= job_ranges_; });
-    // job_ cleared under mu_ AFTER every range completed, so a late-waking
-    // worker can never claim from a stale/dangling job
-    job_ = nullptr;
-  }
-
-  int64_t size() const { return (int64_t)workers_.size(); }
-
- private:
-  TsPool() {
-    unsigned hw = std::thread::hardware_concurrency();
-    const char* cap = std::getenv("MMLSPARK_TPU_SHAP_THREADS");
-    long want = cap ? std::strtol(cap, nullptr, 10) : (long)hw;
-    want = std::max(1L, std::min(want, (long)(hw ? hw : 1)));
-    for (long t = 0; t + 1 < want; t++) {  // caller thread counts as one
-      workers_.emplace_back([this] { this->loop(); });
-      workers_.back().detach();  // process-lifetime pool
-    }
-  }
-
-  // Range claims happen UNDER mu_ (a handful of claims per call — the
-  // lock is not contended at that granularity), which makes staleness
-  // impossible by construction: a claim observes (job_, generation_)
-  // atomically with the counter it advances.
-  void loop() {
-    uint64_t seen = 0;
-    std::unique_lock<std::mutex> lk(mu_);
-    while (true) {
-      cv_.wait(lk, [&] { return job_ != nullptr && generation_ != seen; });
-      seen = generation_;
-      while (job_ != nullptr && next_range_ < job_ranges_) {
-        const int64_t r = next_range_++;
-        const auto* job = job_;
-        const int64_t n = job_n_, step = job_step_;
-        lk.unlock();
-        (*job)(r * step, std::min(n, (r + 1) * step));
-        lk.lock();
-        if (++done_count_ >= job_ranges_) done_cv_.notify_all();
-      }
-    }
-  }
-
-  std::vector<std::thread> workers_;
-  std::mutex dispatch_mu_;  // one job in flight at a time
-  std::mutex mu_;
-  std::condition_variable cv_, done_cv_;
-  const std::function<void(int64_t, int64_t)>* job_ = nullptr;
-  int64_t job_n_ = 0, job_step_ = 0, job_ranges_ = 0;
-  int64_t next_range_ = 0, done_count_ = 0;
-  uint64_t generation_ = 0;
-};
 
 }  // namespace
 
@@ -468,12 +498,12 @@ int64_t mm_treeshap(const int32_t* feat, const int32_t* left,
                    ? n_threads
                    : (int64_t)std::thread::hardware_concurrency();
   nt = std::max<int64_t>(1, std::min(nt, n));
-  nt = std::min(nt, TsPool::instance().size() + 1);
+  nt = std::min(nt, WorkPool::instance().size() + 1);
   // path length <= depth+2 (root sentinel + one per level); one arena row
   // per recursion level, reused across all of a thread's instances
   const int levels = maxd + 2;
 
-  TsPool::instance().run(n, nt, [&](int64_t r0, int64_t r1) {
+  WorkPool::instance().run(n, nt, [&](int64_t r0, int64_t r1) {
     TsArena arena(levels, levels);
     for (int64_t r = r0; r < r1; r++) {
       ts_recurse(T, go_left, n, r, 0, 1.0, 1.0, -1, 0, 0, arena,
