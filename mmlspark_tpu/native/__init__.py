@@ -111,8 +111,21 @@ def get_lib() -> Optional[ctypes.CDLL]:
 # every symbol _bind wires up: a prebuilt .so from an older source tree
 # (missing a newer symbol) must fall through to a recompile, not latch the
 # whole module to the Python fallback
-_EXPECTED_SYMBOLS = ("mm_murmur3_32", "mm_murmur3_batch", "mm_bin_batch",
-                     "mm_csv_read_floats", "mm_treeshap")
+_EXPECTED_SYMBOLS = ("mm_abi_version", "mm_murmur3_32", "mm_murmur3_batch",
+                     "mm_bin_batch", "mm_csv_read_floats", "mm_treeshap")
+# behavioral version (mm_abi_version in mmlspark_native.cpp): symbol
+# presence alone can't catch a prebuilt whose symbols all exist but whose
+# SEMANTICS are stale (e.g. the pre-cycle-guard mm_treeshap); bump both
+# on any native behavior change
+_ABI_VERSION = 2
+
+
+def _prebuilt_current(lib: ctypes.CDLL) -> bool:
+    if not all(hasattr(lib, s) for s in _EXPECTED_SYMBOLS):
+        return False
+    lib.mm_abi_version.restype = ctypes.c_int64
+    lib.mm_abi_version.argtypes = []
+    return int(lib.mm_abi_version()) == _ABI_VERSION
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -121,9 +134,9 @@ def _load() -> Optional[ctypes.CDLL]:
     if os.path.exists(_PREBUILT):
         try:
             lib = ctypes.CDLL(_PREBUILT)
-            if all(hasattr(lib, s) for s in _EXPECTED_SYMBOLS):
+            if _prebuilt_current(lib):
                 return lib
-            # stale prebuilt (pre-dates a symbol): recompile from source
+            # stale prebuilt (old symbols or old behavior): recompile
         except OSError:
             pass  # wrong arch/ABI for this host: recompile from source
     so = _compile()

@@ -129,6 +129,14 @@ class WorkPool {
 
 extern "C" {
 
+// Behavioral ABI version: bump on ANY change to native semantics, not just
+// on new symbols — the loader rejects prebuilt .so files whose version
+// doesn't match and recompiles from source (a stale prebuilt exporting all
+// the same symbols would otherwise silently ship old behavior, e.g. the
+// pre-cycle-guard mm_treeshap). Keep in sync with _ABI_VERSION in
+// mmlspark_tpu/native/__init__.py.
+int64_t mm_abi_version() { return 2; }
+
 // ---------------------------------------------------------------------------
 // MurmurHash3_x86_32 (Austin Appleby, public domain) — must match
 // mmlspark_tpu/ops/murmur.py bit-for-bit: hashing defines feature identity.
@@ -187,14 +195,19 @@ uint32_t mm_murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
 // over the worker pool; the threshold keeps small calls on the caller.
 void mm_murmur3_batch(const uint8_t* buf, const int64_t* offsets,
                       const uint32_t* seeds, int64_t n, uint32_t* out) {
-  const int64_t nt =
-      n >= 65536 ? WorkPool::instance().size() + 1 : 1;
-  WorkPool::instance().run(n, nt, [&](int64_t r0, int64_t r1) {
+  auto body = [&](int64_t r0, int64_t r1) {
     for (int64_t i = r0; i < r1; i++) {
       out[i] = mm_murmur3_32(buf + offsets[i], offsets[i + 1] - offsets[i],
                              seeds[i]);
     }
-  });
+  };
+  // threshold checked BEFORE touching the pool: instance() spawns the
+  // permanent worker threads, which a small batch should never trigger
+  if (n < 65536) {
+    body(0, n);
+    return;
+  }
+  WorkPool::instance().run(n, WorkPool::instance().size() + 1, body);
 }
 
 // ---------------------------------------------------------------------------
@@ -206,10 +219,9 @@ void mm_murmur3_batch(const uint8_t* buf, const int64_t* offsets,
 void mm_bin_batch(const float* X, int64_t n, int64_t F, const float* bounds,
                   int64_t B1 /* = max_bin - 1 */, int32_t* out) {
   // rows are independent; out-of-core ingest bins millions of rows per
-  // chunk, so large batches fan out over the worker pool
-  const int64_t nt =
-      n * F >= 1 << 20 ? WorkPool::instance().size() + 1 : 1;
-  WorkPool::instance().run(n, nt, [&](int64_t r0, int64_t r1) {
+  // chunk, so large batches fan out over the worker pool (whose threads
+  // are only ever spawned past this threshold)
+  auto body = [&](int64_t r0, int64_t r1) {
     for (int64_t r = r0; r < r1; r++) {
       const float* row = X + r * F;
       int32_t* orow = out + r * F;
@@ -229,7 +241,12 @@ void mm_bin_batch(const float* X, int64_t n, int64_t F, const float* bounds,
         orow[f] = (int32_t)lo;
       }
     }
-  });
+  };
+  if (n * F < (int64_t)1 << 20) {
+    body(0, n);
+    return;
+  }
+  WorkPool::instance().run(n, WorkPool::instance().size() + 1, body);
 }
 
 // ---------------------------------------------------------------------------

@@ -136,7 +136,13 @@ def main(quick=False):
                 # relay window decides both the headline and the default
                 ("leafwise", dict(growth_policy="leafwise")),
                 ("leafwise+sub",
-                 dict(growth_policy="leafwise", hist_subtraction=True))]
+                 dict(growth_policy="leafwise", hist_subtraction=True)),
+                # int8 2x-MXU-rate path, both policies: with subtraction a
+                # measured loss on TPU (r5 capture), leafwise+quant is the
+                # bench's leafwise_best candidate — capture it directly
+                ("leafwise+quant",
+                 dict(growth_policy="leafwise", quantized_grad=True)),
+                ("depthwise+quant", dict(quantized_grad=True))]
     if not quick:
         # narrow bin storage: bit-identical by construction; this measures
         # whether the per-block VMEM widening changes TPU pass time
