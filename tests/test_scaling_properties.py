@@ -66,7 +66,7 @@ def _collect_op(dump_dir, op):
     return payloads
 
 
-def _run_src(tmp_path, src, arg, tag):
+def _run_src(tmp_path, src, arg, tag, extra=()):
     dump = tmp_path / f"dump_{tag}"
     env = dict(os.environ)
     env.update({"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"})
@@ -75,7 +75,8 @@ def _run_src(tmp_path, src, arg, tag):
         env["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8").strip()
     r = subprocess.run(
-        [sys.executable, "-c", src, str(arg), str(dump)],
+        [sys.executable, "-c", src, str(arg), str(dump)]
+        + [str(e) for e in extra],
         capture_output=True, text=True, timeout=600, env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     assert "PROBE_DONE" in r.stdout, r.stderr[-2000:]
@@ -146,9 +147,11 @@ mesh = make_mesh({"data": 1, "seq": nd, "model": 1})
 # (B*S*E = 16384 elems) dwarf the fused parameter-gradient all-reduce
 # (~4.4k elems), so an activation-sized collective is unambiguously
 # distinguishable from the legitimate param-grad sync
-cfg = TransformerConfig(vocab_size=32, d_model=16, n_heads=2, d_head=8,
+# 4 heads: ulysses reshards heads<->sequence, so heads must divide by
+# the largest probed seq shard count (4); ring has no head constraint
+cfg = TransformerConfig(vocab_size=32, d_model=16, n_heads=4, d_head=4,
                         n_layers=1, d_ff=32, max_len=512,
-                        seq_attention="ring_zigzag")
+                        seq_attention=sys.argv[3])
 params = shard_params(init_params(cfg, jax.random.PRNGKey(0)), cfg, mesh)
 opt = shard_opt_state(adamw_init(params), cfg, mesh)
 step = make_train_step(cfg, mesh, lr=1e-2)
@@ -186,8 +189,10 @@ def test_ring_attention_permutes_chunks_not_sequences(tmp_path):
     payload shrinks as 1/seq_shards, and nothing ever all-gathers a
     full-sequence tensor (that would be the O(S) memory blowup sequence
     parallelism exists to avoid)."""
-    d2 = _run_src(tmp_path, _RING_PROBE, 2, "ring2")
-    d4 = _run_src(tmp_path, _RING_PROBE, 4, "ring4")
+    d2 = _run_src(tmp_path, _RING_PROBE, 2, "ring2",
+                  extra=["ring_zigzag"])
+    d4 = _run_src(tmp_path, _RING_PROBE, 4, "ring4",
+                  extra=["ring_zigzag"])
     p2 = _collect_op(d2, "collective-permute")
     p4 = _collect_op(d4, "collective-permute")
     assert p2 and p4
@@ -213,3 +218,22 @@ def test_ring_attention_permutes_chunks_not_sequences(tmp_path):
                 assert p <= full_seq // 2, (op, p, full_seq)
     for op in ("all-reduce", "reduce-scatter", "all-to-all"):
         assert sum(_collect_op(d4, op)) <= sum(_collect_op(d2, op)), op
+
+
+@pytest.mark.slow
+def test_ulysses_alltoall_is_chunk_sized(tmp_path):
+    """Ulysses reshards heads<->sequence with all-to-alls whose payload is
+    the LOCAL activation chunk — it shrinks as 1/seq_shards like the ring
+    permutes, never a gathered full sequence."""
+    d2 = _run_src(tmp_path, _RING_PROBE, 2, "uly2", extra=["ulysses"])
+    d4 = _run_src(tmp_path, _RING_PROBE, 4, "uly4", extra=["ulysses"])
+    a2 = _collect_op(d2, "all-to-all")
+    a4 = _collect_op(d4, "all-to-all")
+    assert a2 and a4
+    assert len(a2) == len(a4), (a2, a4)
+    assert sorted(a4) == [p // 2 for p in sorted(a2)], (a2, a4)
+    B, S, E = 2, 512, 16
+    full_seq = B * S * E
+    for d, payloads in ((d2, a2), (d4, a4)):
+        for p in payloads + _collect_op(d, "all-gather"):
+            assert p <= full_seq // 2, (p, full_seq)
