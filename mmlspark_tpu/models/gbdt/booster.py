@@ -500,6 +500,24 @@ class Booster:
         return stream_apply(source, fn, chunk_rows=chunk_rows,
                             out_dir=out_dir)
 
+    def predict_contrib_streamed(self, source, *,
+                                 chunk_rows: int = 16_384, out_dir=None,
+                                 method: str = "treeshap"):
+        """Per-feature contributions over ``.npy`` feature shards in
+        bounded row chunks — larger-than-RAM explanation. Each chunk runs
+        exactly :meth:`predict_contrib` (TreeSHAP is row-independent, so
+        streamed == in-memory bit-for-bit); the output is [n, (F+1)*K],
+        F+1 times wider than the input, hence the smaller default chunk.
+        Reference bar: featuresShapCol over streamed partitions
+        (lightgbm/LightGBMBooster.scala:250-269). Returns concatenated
+        contributions, or output shard paths with ``out_dir``.
+        """
+        from ...io.streaming import stream_apply
+
+        return stream_apply(
+            source, lambda c: self.predict_contrib(c, method=method),
+            chunk_rows=chunk_rows, out_dir=out_dir)
+
     def _check_missing_routing(self, X: np.ndarray) -> None:
         """The SHAP/leaf paths route NaN left unconditionally. For imported
         models storing different missing handling (missing_dec set), inputs

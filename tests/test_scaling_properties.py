@@ -235,5 +235,11 @@ def test_ulysses_alltoall_is_chunk_sized(tmp_path):
     B, S, E = 2, 512, 16
     full_seq = B * S * E
     for d, payloads in ((d2, a2), (d4, a4)):
-        for p in payloads + _collect_op(d, "all-gather"):
+        for p in (payloads + _collect_op(d, "all-gather")
+                  + _collect_op(d, "collective-permute")):
             assert p <= full_seq // 2, (p, full_seq)
+    # same reduce-volume tail guard as the ring test: a full-sequence
+    # leak through the reduce family must not hide behind intact
+    # chunk-sized all-to-alls
+    for op in ("all-reduce", "reduce-scatter"):
+        assert sum(_collect_op(d4, op)) <= sum(_collect_op(d2, op)), op

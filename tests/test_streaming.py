@@ -45,6 +45,18 @@ class TestStreamedBooster:
         raw = b.predict_streamed(xdir, chunk_rows=700, raw=True)
         np.testing.assert_array_equal(raw, b.predict_raw(X))
 
+    def test_predict_contrib_streamed_bit_identical(self,
+                                                    booster_and_shards):
+        b, X, xdir = booster_and_shards
+        streamed = b.predict_contrib_streamed(xdir, chunk_rows=700)
+        np.testing.assert_array_equal(streamed, b.predict_contrib(X))
+        # saabas engine streams through the same path
+        s2 = b.predict_contrib_streamed(xdir, chunk_rows=1100,
+                                        method="saabas")
+        np.testing.assert_array_equal(s2,
+                                      b.predict_contrib(X,
+                                                        method="saabas"))
+
     def test_predict_streamed_to_shards(self, booster_and_shards, tmp_path):
         b, X, xdir = booster_and_shards
         paths = b.predict_streamed(xdir, chunk_rows=1500,
