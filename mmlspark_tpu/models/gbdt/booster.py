@@ -514,6 +514,12 @@ class Booster:
         """
         from ...io.streaming import stream_apply
 
+        if method not in ("treeshap", "saabas"):
+            # validate BEFORE stream_apply clears any existing out_dir
+            # shards: a typo'd method must not destroy a prior run's output
+            raise ValueError(
+                f"unknown contribution method {method!r}; expected "
+                "'treeshap' or 'saabas'")
         return stream_apply(
             source, lambda c: self.predict_contrib(c, method=method),
             chunk_rows=chunk_rows, out_dir=out_dir)

@@ -10,6 +10,7 @@ the supported subset onto SGDConfig (the reference forwards it to C++;
 
 from __future__ import annotations
 
+import os
 import shlex
 import time
 from typing import Optional
@@ -308,6 +309,63 @@ class _VowpalWabbitModelBase(Model, _VowpalWabbitBaseParams):
         # same 2^numBits weight-table mask as training
         idx = idx & (len(self.weights) - 1)
         return predict_sgd(idx, val, self.weights)
+
+    def predict_margin_streamed(self, index_path, value_path, *,
+                                chunk_rows: int = 262_144, out_dir=None):
+        """Margins over pre-hashed ``.npy`` shards in bounded row chunks —
+        the scoring side of the out-of-core story (``fit_streamed`` is
+        the training side). Chunks are independent dot products, so
+        streamed margins equal in-memory margins bit-for-bit. Index
+        shards fold by ``2^numBits`` at read time like ``fit_streamed``.
+        Returns concatenated margins, or shard paths with ``out_dir``.
+        """
+        import jax.numpy as jnp
+
+        from ...io.streaming import stream_apply
+        from ..gbdt.ingest import ShardedMatrixSource
+
+        idx_src = ShardedMatrixSource.coerce(index_path)
+        val_src = ShardedMatrixSource.coerce(value_path)
+        if idx_src.n != val_src.n:
+            raise ValueError(
+                f"index rows {idx_src.n} != value rows {val_src.n}")
+        if idx_src.row_shape != val_src.row_shape:
+            raise ValueError(
+                f"index row shape {idx_src.row_shape} != value row shape "
+                f"{val_src.row_shape}")
+        # swapped-argument guard: both sources are [n, nnz], but float
+        # index shards silently truncate to garbage hashes
+        probe = idx_src.read(0, 1, dtype=None)
+        if probe.size and probe.dtype.kind not in "iu":
+            raise ValueError(
+                f"index shards must be integer dtype, got {probe.dtype} — "
+                "were index_path and value_path swapped?")
+        if out_dir is not None:
+            # stream_apply guards out_dir against the VALUE source only;
+            # overwriting the index shards mid-stream must also be refused
+            out_real = os.path.realpath(os.fspath(out_dir))
+            if any(os.path.realpath(os.path.dirname(p)) == out_real
+                   for p in idx_src.paths):
+                raise ValueError(
+                    "out_dir contains the index shards; writing outputs "
+                    "there would delete inputs mid-stream")
+        mask = len(self.weights) - 1
+        w_dev = jnp.asarray(self.weights)   # one upload for all chunks
+        # stream_apply's contract walks [0, n) in order, one bounded chunk
+        # at a time — the cursor below pairs each value chunk with the
+        # matching index rows without loading the index side whole
+        pos = [0]
+
+        def score(val_chunk: np.ndarray) -> np.ndarray:
+            start = pos[0]
+            stop = start + len(val_chunk)
+            pos[0] = stop
+            idx = (idx_src.read(start, stop, dtype=None)
+                   .astype(np.int64) & mask).astype(np.int32)
+            return predict_sgd(idx, val_chunk, w_dev)
+
+        return stream_apply(val_src, score, chunk_rows=chunk_rows,
+                            out_dir=out_dir)
 
     def get_performance_statistics(self) -> Dataset:
         """Diagnostics DataFrame parity (reference: VowpalWabbitBase.scala:27-46

@@ -540,14 +540,20 @@ def train_sgd_streamed(index_path, value_path, label_path,
     return w
 
 
+@jax.jit
+def _margin_fn(idx, val, w):
+    return jnp.sum(w[idx] * val, axis=1)
+
+
 def predict_sgd(indices: np.ndarray, values: np.ndarray, weights: np.ndarray,
                 loss: str = "squared") -> np.ndarray:
-    """Margin predictions for padded sparse rows."""
-    w = jnp.asarray(weights)
+    """Margin predictions for padded sparse rows.
 
-    @jax.jit
-    def f(idx, val):
-        return jnp.sum(w[idx] * val, axis=1)
-
-    return np.asarray(f(jnp.asarray(indices.astype(np.int32)),
-                        jnp.asarray(values.astype(np.float32))))
+    The jitted kernel is module-level with the weight table as an
+    ARGUMENT: a closure re-jitted per call would re-trace/compile on
+    every chunk of a streamed scoring loop. Callers looping over chunks
+    can pass ``weights`` as a device array to also skip the per-call
+    host->device weight upload."""
+    return np.asarray(_margin_fn(jnp.asarray(indices.astype(np.int32)),
+                                 jnp.asarray(values.astype(np.float32)),
+                                 jnp.asarray(weights)))

@@ -738,3 +738,29 @@ class TestStreamedFit:
             src.read(0, 128, dtype=None)
         # float32 coercion across mixed shards stays supported
         assert src.read(0, 128).dtype == np.float32
+
+    def test_predict_margin_streamed(self, tmp_path):
+        from mmlspark_tpu.models.vw.sgd import SGDConfig, predict_sgd
+        idx, val, y = self._data(n=500)
+        from mmlspark_tpu.models.vw.sgd import train_sgd
+        cfg = SGDConfig(num_bits=12, loss="logistic", num_passes=2,
+                        batch_size=64)
+        mesh = self._one_device_mesh()
+        w = train_sgd(idx, val, y, None, cfg, mesh=mesh)
+        model = VowpalWabbitClassificationModel(w, {})
+        paths = [self._write_shards(tmp_path, k, a) for k, a in
+                 [("idx", idx), ("val", val)]]
+        streamed = model.predict_margin_streamed(*paths, chunk_rows=123)
+        np.testing.assert_array_equal(streamed, predict_sgd(idx, val, w))
+        # shard output round-trips through a further streamed stage
+        out = model.predict_margin_streamed(
+            *paths, chunk_rows=200, out_dir=tmp_path / "margins")
+        from mmlspark_tpu.models.gbdt.ingest import ShardedMatrixSource
+        src = ShardedMatrixSource(tmp_path / "margins")
+        np.testing.assert_array_equal(src.read(0, src.n),
+                                      predict_sgd(idx, val, w))
+        with pytest.raises(ValueError, match="rows"):
+            model.predict_margin_streamed(paths[0],
+                                          self._write_shards(tmp_path,
+                                                             "short",
+                                                             val[:100]))
