@@ -55,13 +55,21 @@ def main():
             numBits=15, numPasses=3).fit_streamed(
                 dirs["idx"], dirs["val"], dirs["y"], chunk_rows=2_048)
 
-        # 3. Score normally (scoring side streams too: io/streaming.py)
+        # 3. Score normally (in-memory)...
         dsf = feat.transform(Dataset({"x": X, "label": y}))
         acc = (np.asarray(model.transform(dsf)["prediction"]) == y).mean()
         stats = model.get_performance_statistics()
         print(f"streamed VW: n={stats['numExamples'][0]}, "
               f"passes={stats['numPasses'][0]}, train acc={acc:.3f}")
         assert acc > 0.93
+
+        # 4. ...or stream the scoring side too — margins over the same
+        #    shards, bounded memory, bit-identical to in-memory scoring
+        margins = model.predict_margin_streamed(dirs["idx"], dirs["val"],
+                                                chunk_rows=2_048)
+        acc_streamed = ((margins > 0) == y).mean()
+        print(f"streamed scoring acc={acc_streamed:.3f}")
+        assert acc_streamed == acc
 
 
 if __name__ == "__main__":
