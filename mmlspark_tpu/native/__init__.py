@@ -117,7 +117,7 @@ _EXPECTED_SYMBOLS = ("mm_abi_version", "mm_murmur3_32", "mm_murmur3_batch",
 # presence alone can't catch a prebuilt whose symbols all exist but whose
 # SEMANTICS are stale (e.g. the pre-cycle-guard mm_treeshap); bump both
 # on any native behavior change
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 
 def _prebuilt_current(lib: ctypes.CDLL) -> bool:

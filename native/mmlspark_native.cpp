@@ -84,8 +84,11 @@ class WorkPool {
   WorkPool() : owner_pid_(getpid()) {
     unsigned hw = std::thread::hardware_concurrency();
     const char* cap = std::getenv("MMLSPARK_TPU_NATIVE_THREADS");
-    long want = cap ? std::strtol(cap, nullptr, 10) : (long)hw;
-    want = std::max(1L, std::min(want, (long)(hw ? hw : 1)));
+    // an EXPLICIT override may exceed the core count (oversubscription is
+    // harmless, and it is the only way tests on a 1-core box can exercise
+    // the pool's parallel paths for real); the default stays at hw
+    long want = cap ? std::strtol(cap, nullptr, 10) : (long)(hw ? hw : 1);
+    want = std::max(1L, std::min(want, 256L));
     for (long t = 0; t + 1 < want; t++) {  // caller thread counts as one
       workers_.emplace_back([this] { this->loop(); });
       workers_.back().detach();  // process-lifetime pool
@@ -135,7 +138,7 @@ extern "C" {
 // the same symbols would otherwise silently ship old behavior, e.g. the
 // pre-cycle-guard mm_treeshap). Keep in sync with _ABI_VERSION in
 // mmlspark_tpu/native/__init__.py.
-int64_t mm_abi_version() { return 2; }
+int64_t mm_abi_version() { return 3; }
 
 // ---------------------------------------------------------------------------
 // MurmurHash3_x86_32 (Austin Appleby, public domain) — must match
@@ -261,55 +264,117 @@ static inline bool is_blank(const char* s, const char* e) {
   return true;
 }
 
-int64_t mm_csv_read_floats(const char* buf, int64_t len, int64_t ncols,
-                           float* out, int64_t max_rows) {
-  // Line-by-line with bounded fields, matching the Python fallback exactly:
-  // blank lines are skipped; fields are trimmed; empty/unparseable -> NaN.
+// One non-blank line [p, eol) -> out_row[0..ncols); false on a
+// column-count mismatch. Fields are trimmed; empty/unparseable -> NaN.
+static inline bool parse_csv_line(const char* p, const char* eol,
+                                  int64_t ncols, float* out_row) {
+  int64_t col = 0;
+  const char* f = p;
+  while (true) {
+    const char* fe = (const char*)memchr(f, ',', eol - f);
+    const char* fend = fe ? fe : eol;
+    if (col >= ncols) return false;
+    // trim surrounding whitespace/CR, parse within the bounded field
+    const char* a = f;
+    const char* b = fend;
+    while (a < b && (*a == ' ' || *a == '\t' || *a == '\r')) a++;
+    while (b > a && (*(b - 1) == ' ' || *(b - 1) == '\t' || *(b - 1) == '\r'))
+      b--;
+    if (a == b) {
+      out_row[col] = NAN;  // empty field
+    } else {
+      // std::from_chars: locale-independent (strtof honors LC_NUMERIC, so
+      // a comma-decimal host locale would silently NaN every field while
+      // the Python fallback parsed fine); bounded by [a, b), and a partial
+      // parse means a bad field -> NaN. from_chars rejects a leading '+'
+      // (Python's float() accepts it) — skip one explicit plus sign.
+      if (*a == '+' && b - a > 1 && *(a + 1) != '-' && *(a + 1) != '+') a++;
+      float v;
+      auto res = std::from_chars(a, b, v);
+      out_row[col] = (res.ec == std::errc() && res.ptr == b) ? v : NAN;
+    }
+    col++;
+    if (!fe) break;
+    f = fe + 1;
+  }
+  return col == ncols;
+}
+
+static int64_t csv_parse_serial(const char* buf, int64_t len, int64_t ncols,
+                                float* out, int64_t max_rows) {
   int64_t row = 0;
   const char* p = buf;
   const char* end = buf + len;
   while (p < end && row < max_rows) {
     const char* eol = (const char*)memchr(p, '\n', end - p);
     if (eol == nullptr) eol = end;
-    if (is_blank(p, eol)) {  // skip blank lines (python: `if not strip()`)
-      p = eol + 1;
-      continue;
+    if (!is_blank(p, eol)) {  // skip blank lines (python: `if not strip()`)
+      if (!parse_csv_line(p, eol, ncols, out + row * ncols)) return -1;
+      row++;
     }
-    int64_t col = 0;
-    const char* f = p;
-    while (true) {
-      const char* fe = (const char*)memchr(f, ',', eol - f);
-      const char* fend = fe ? fe : eol;
-      if (col >= ncols) return -1;
-      // trim surrounding whitespace/CR, parse within the bounded field
-      const char* a = f;
-      const char* b = fend;
-      while (a < b && (*a == ' ' || *a == '\t' || *a == '\r')) a++;
-      while (b > a && (*(b - 1) == ' ' || *(b - 1) == '\t' || *(b - 1) == '\r'))
-        b--;
-      if (a == b) {
-        out[row * ncols + col] = NAN;  // empty field
-      } else {
-        // std::from_chars: locale-independent (strtof honors LC_NUMERIC, so
-        // a comma-decimal host locale would silently NaN every field while
-        // the Python fallback parsed fine); bounded by [a, b), and a partial
-        // parse means a bad field -> NaN. from_chars rejects a leading '+'
-        // (Python's float() accepts it) — skip one explicit plus sign.
-        if (*a == '+' && b - a > 1 && *(a + 1) != '-' && *(a + 1) != '+') a++;
-        float v;
-        auto res = std::from_chars(a, b, v);
-        out[row * ncols + col] =
-            (res.ec == std::errc() && res.ptr == b) ? v : NAN;
-      }
-      col++;
-      if (!fe) break;
-      f = fe + 1;
-    }
-    if (col != ncols) return -1;
-    row++;
     p = eol + 1;
   }
   return row;
+}
+
+int64_t mm_csv_read_floats(const char* buf, int64_t len, int64_t ncols,
+                           float* out, int64_t max_rows) {
+  // Two-pass parallel parse for large buffers (out-of-core CSV ingest
+  // feeds 64 MB chunks): split at line boundaries, count non-blank lines
+  // per span, prefix-sum the row offsets, then parse every span into its
+  // own output slice. Semantics identical to the serial path; a span
+  // that would overflow max_rows falls back to serial (callers size
+  // max_rows from the newline count, so this is the rare path).
+  const int64_t kParThreshold = 4 << 20;
+  // threshold BEFORE instance(): small parses must not spawn the pool
+  if (len < kParThreshold)
+    return csv_parse_serial(buf, len, ncols, out, max_rows);
+  const int64_t nt_avail = WorkPool::instance().size() + 1;
+  if (nt_avail <= 1)
+    return csv_parse_serial(buf, len, ncols, out, max_rows);
+
+  const int64_t nt = std::min<int64_t>(nt_avail, 1 + len / (1 << 20));
+  std::vector<int64_t> start(nt + 1, len);
+  start[0] = 0;
+  for (int64_t t = 1; t < nt; t++) {
+    int64_t pos = len * t / nt;
+    if (pos <= start[t - 1]) pos = start[t - 1];
+    const char* nl = (const char*)memchr(buf + pos, '\n', len - pos);
+    start[t] = nl ? (nl - buf) + 1 : len;
+  }
+  // pass 1: non-blank line count per span
+  std::vector<int64_t> rows(nt, 0);
+  WorkPool::instance().run(nt, nt, [&](int64_t t0, int64_t t1) {
+    for (int64_t t = t0; t < t1; t++) {
+      const char* p = buf + start[t];
+      const char* end = buf + start[t + 1];
+      int64_t r = 0;
+      while (p < end) {
+        const char* eol = (const char*)memchr(p, '\n', end - p);
+        if (eol == nullptr) eol = end;
+        if (!is_blank(p, eol)) r++;
+        p = eol + 1;
+      }
+      rows[t] = r;
+    }
+  });
+  std::vector<int64_t> offset(nt + 1, 0);
+  for (int64_t t = 0; t < nt; t++) offset[t + 1] = offset[t] + rows[t];
+  if (offset[nt] > max_rows)
+    return csv_parse_serial(buf, len, ncols, out, max_rows);
+  // pass 2: parse spans into disjoint output slices
+  std::vector<uint8_t> bad(nt, 0);
+  WorkPool::instance().run(nt, nt, [&](int64_t t0, int64_t t1) {
+    for (int64_t t = t0; t < t1; t++) {
+      const int64_t got = csv_parse_serial(
+          buf + start[t], start[t + 1] - start[t], ncols,
+          out + offset[t] * ncols, rows[t]);
+      if (got != rows[t]) bad[t] = 1;
+    }
+  });
+  for (int64_t t = 0; t < nt; t++)
+    if (bad[t]) return -1;
+  return offset[nt];
 }
 
 }  // extern "C"

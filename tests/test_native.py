@@ -5,6 +5,8 @@ core/env/NativeLoader.java:28-140): compiled on first use, with pure-Python
 fallbacks. Hashing defines feature identity, so parity must be bit-for-bit.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -160,3 +162,81 @@ def test_csv_native_matches_python_fallback(monkeypatch):
     monkeypatch.setattr(nat, "_lib_tried", True)
     py_out = csv_read_floats(text, 4)
     np.testing.assert_allclose(native_out, py_out, rtol=1e-6)
+
+
+def test_worker_pool_paths_match_serial(tmp_path):
+    """The pool's parallel code paths never engage on a 1-core host
+    (hardware_concurrency == 1 -> zero workers), so force a 4-thread pool
+    via the env override in a subprocess and pin every pooled entry point
+    — treeshap, bin_batch, murmur3_batch, csv_read_floats — bitwise equal
+    to this process's serial results. Inputs are built ONCE here and
+    shipped to the subprocess as files, so the two sides cannot drift."""
+    import subprocess
+    import sys
+
+    from mmlspark_tpu import native
+    if not native.native_available():
+        pytest.skip("no native toolchain")
+
+    rng = np.random.default_rng(0)
+    n, F, B = 80_000, 16, 62
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    ub = np.sort(rng.normal(size=(F, B)).astype(np.float32), axis=1)
+    np.save(tmp_path / "X.npy", X)
+    np.save(tmp_path / "ub.npy", ub)
+    strings = [f"w{i % 997}_{i}" for i in range(70_000)]
+    seeds = (np.arange(len(strings)) % 7).astype(np.uint32)
+    np.save(tmp_path / "seeds.npy", seeds)
+    rows = [",".join(f"{v:.5g}" for v in r) for r in X[:50_000]]
+    rows[100] = ""   # blank-line skip crosses span boundaries
+    (tmp_path / "data.csv").write_text("\n".join(rows))
+    # a small booster for the pooled treeshap path (deep enough to be
+    # nontrivial, tiny enough to train fast)
+    from mmlspark_tpu.models.gbdt.booster import train_booster
+    from mmlspark_tpu.models.gbdt.growth import GrowConfig
+    y = (X[:, 0] > 0).astype(np.float32)
+    booster = train_booster(X[:8000], y[:8000], objective="binary",
+                            num_iterations=5,
+                            cfg=GrowConfig(num_leaves=15), max_bin=31)
+    import pickle
+    (tmp_path / "booster.pkl").write_bytes(pickle.dumps(booster))
+
+    script = r"""
+import numpy as np, os, pickle, sys
+from mmlspark_tpu import native
+assert native.native_available()
+d = sys.argv[1]
+X = np.load(d + "/X.npy"); ub = np.load(d + "/ub.npy")
+seeds = np.load(d + "/seeds.npy")
+strings = [f"w{i % 997}_{i}" for i in range(len(seeds))]
+np.save(d + "/bins.npy", native.bin_batch(X, ub))
+np.save(d + "/hash.npy", native.murmur3_batch(strings, seeds))
+np.save(d + "/csv.npy", native.csv_read_floats(
+    open(d + "/data.csv").read(), X.shape[1]))
+booster = pickle.loads(open(d + "/booster.pkl", "rb").read())
+os.environ["MMLSPARK_TPU_SHAP_HOST"] = "1"
+np.save(d + "/shap.npy", booster.predict_contrib(X[:4096]))
+print("SUB_OK")
+"""
+    env = dict(os.environ)
+    env.update({"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+                "MMLSPARK_TPU_NATIVE_THREADS": "4"})
+    r = subprocess.run([sys.executable, "-c", script, str(tmp_path)],
+                       capture_output=True, text=True, timeout=420,
+                       env=env, cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert "SUB_OK" in r.stdout, r.stderr[-2000:]
+
+    np.testing.assert_array_equal(np.load(tmp_path / "bins.npy"),
+                                  native.bin_batch(X, ub))
+    np.testing.assert_array_equal(np.load(tmp_path / "hash.npy"),
+                                  native.murmur3_batch(strings, seeds))
+    np.testing.assert_array_equal(
+        np.load(tmp_path / "csv.npy"),
+        native.csv_read_floats((tmp_path / "data.csv").read_text(), F))
+    os.environ["MMLSPARK_TPU_SHAP_HOST"] = "1"
+    try:
+        np.testing.assert_array_equal(np.load(tmp_path / "shap.npy"),
+                                      booster.predict_contrib(X[:4096]))
+    finally:
+        os.environ.pop("MMLSPARK_TPU_SHAP_HOST", None)
