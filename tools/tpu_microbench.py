@@ -125,24 +125,25 @@ def main(quick=False):
     # train variants: depthwise direct, depthwise + histogram subtraction
     # (both selectors — this measurement decides the hist_subtraction
     # default and selector), and leafwise (the parity default)
+    # ordered by information value per relay minute: the r5 window closed
+    # mid-sweep once, so headline + UNCAPTURED configs come first and the
+    # already-captured subtraction variants (measured 3.4-10x losses,
+    # docs/tpu_capture_r05/) run last
     variants = [("depthwise", dict()),
-                ("depthwise+sub/argsort",
-                 dict(hist_subtraction=True, compact_selector="argsort")),
-                ("depthwise+sub/searchsorted",
-                 dict(hist_subtraction=True,
-                      compact_selector="searchsorted")),
-                # the LightGBM-parity default (batched best-first, the
-                # round-3 leafBatch path) — quick mode includes it so one
-                # relay window decides both the headline and the default
                 ("leafwise", dict(growth_policy="leafwise")),
-                ("leafwise+sub",
-                 dict(growth_policy="leafwise", hist_subtraction=True)),
                 # int8 2x-MXU-rate path, both policies: with subtraction a
                 # measured loss on TPU (r5 capture), leafwise+quant is the
                 # bench's leafwise_best candidate — capture it directly
                 ("leafwise+quant",
                  dict(growth_policy="leafwise", quantized_grad=True)),
-                ("depthwise+quant", dict(quantized_grad=True))]
+                ("depthwise+quant", dict(quantized_grad=True)),
+                ("depthwise+sub/argsort",
+                 dict(hist_subtraction=True, compact_selector="argsort")),
+                ("depthwise+sub/searchsorted",
+                 dict(hist_subtraction=True,
+                      compact_selector="searchsorted")),
+                ("leafwise+sub",
+                 dict(growth_policy="leafwise", hist_subtraction=True))]
     if not quick:
         # narrow bin storage: bit-identical by construction; this measures
         # whether the per-block VMEM widening changes TPU pass time
