@@ -1,0 +1,6 @@
+"""mmlspark_tpu: TPU-native ML framework with MMLSpark's capabilities.
+
+See docs/getting-started.md; version mirrors pyproject.toml.
+"""
+
+__version__ = "0.5.0"
