@@ -1,0 +1,48 @@
+#!/bin/bash
+# Relay watcher: probe the TPU relay; on recovery fire the capture playbook.
+#
+# Checked in from /tmp/relay_watch.sh (round 5): armed at round start so any
+# TPU-relay recovery automatically fires the capture playbook (treeshap
+# rates, full bench TPU leg, full microbench sweep) into
+# docs/tpu_capture_r05/auto/. Markers under /tmp/relay_captures/ make the
+# playbook resumable across relay flaps. See docs/tpu_capture_r05/README.md.
+# Markers in /tmp/relay_captures/ record which captures have landed so a
+# re-wedge mid-playbook resumes where it left off.
+mkdir -p /tmp/relay_captures /root/repo/docs/tpu_capture_r05/auto
+cd /root/repo
+PYBIN=$(command -v python)
+probe() {
+  timeout 50 "$PYBIN" -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | grep -q tpu
+}
+while true; do
+  need=0
+  for m in bench_full treeshap micro_full; do
+    [ -f "/tmp/relay_captures/$m.done" ] || need=1
+  done
+  [ "$need" = 0 ] && { echo "$(date +%T) all captures done" >> /tmp/relay_watch.log; exit 0; }
+  if probe; then
+    echo "$(date +%T) relay UP - firing playbook" >> /tmp/relay_watch.log
+    ts=$(date +%H%M%S)
+    if [ ! -f /tmp/relay_captures/treeshap.done ]; then
+      timeout 1500 "$PYBIN" tools/tpu_treeshap_bench.py quick \
+        > "docs/tpu_capture_r05/auto/treeshap_$ts.jsonl" 2>> /tmp/relay_watch.log \
+        && touch /tmp/relay_captures/treeshap.done
+      echo "$(date +%T) treeshap exited rc=$?" >> /tmp/relay_watch.log
+    elif [ ! -f /tmp/relay_captures/bench_full.done ]; then
+      GRAFT_BENCH_LEG=tpu timeout 2700 "$PYBIN" bench.py \
+        > "docs/tpu_capture_r05/auto/bench_tpu_leg_$ts.jsonl" 2>> /tmp/relay_watch.log \
+        && grep -q '"partial"' "docs/tpu_capture_r05/auto/bench_tpu_leg_$ts.jsonl" \
+        && ! tail -1 "docs/tpu_capture_r05/auto/bench_tpu_leg_$ts.jsonl" | grep -q '"partial"' \
+        && touch /tmp/relay_captures/bench_full.done
+      echo "$(date +%T) bench_full leg exited rc=$?" >> /tmp/relay_watch.log
+    elif [ ! -f /tmp/relay_captures/micro_full.done ]; then
+      timeout 1800 "$PYBIN" tools/tpu_microbench.py \
+        > "docs/tpu_capture_r05/auto/micro_full_$ts.jsonl" 2>> /tmp/relay_watch.log \
+        && touch /tmp/relay_captures/micro_full.done
+      echo "$(date +%T) micro_full exited rc=$?" >> /tmp/relay_watch.log
+    fi
+  else
+    echo "$(date +%T) relay down" >> /tmp/relay_watch.log
+    sleep 60
+  fi
+done
