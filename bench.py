@@ -72,6 +72,28 @@ def _emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
+def _dump_metrics_snapshot(leg: str) -> None:
+    """Opt-in telemetry dump next to the BENCH_*.json line:
+    ``GRAFT_BENCH_METRICS_SNAPSHOT=<path>`` writes the process-wide
+    metrics registry (docs/observability.md) accumulated over the bench —
+    per-stage span histograms, serving counters, device-memory gauges —
+    as JSON, so a round's throughput line comes with its breakdown. Both
+    legs inherit the same env var, so the leg name is spliced into the
+    filename (``m.json`` -> ``m.cpu.json``) — the TPU leg must not
+    silently overwrite the CPU leg's breakdown."""
+    path = os.environ.get("GRAFT_BENCH_METRICS_SNAPSHOT")
+    if not path:
+        return
+    root, ext = os.path.splitext(path)
+    path = f"{root}.{leg}{ext or '.json'}"
+    try:
+        from mmlspark_tpu.observability import metrics as _obs_metrics
+        with open(path, "w") as f:
+            json.dump(_obs_metrics.get_registry().snapshot(), f, indent=2)
+    except Exception as e:  # noqa: BLE001 — telemetry must not fail a bench
+        print(f"metrics snapshot failed: {e!r}", file=sys.stderr)
+
+
 def main() -> None:
     """Orchestrate: CPU leg first (publish early), TPU leg if the relay
     answers within the capped wait (upgrade late). Legs are subprocesses of
@@ -424,6 +446,7 @@ def _run_leg(on_tpu: bool) -> None:
         out[f"imagelime_perturbations_per_sec{sfx}"] = \
             lime_rates["perturbations_per_sec"]
     print(json.dumps(out))
+    _dump_metrics_snapshot("tpu" if on_tpu else "cpu")
 
 
 def _gbdt_roofline(n_rows: int, n_feat: int, max_bin: int,

@@ -10,22 +10,77 @@ name can be restored.
 
 from __future__ import annotations
 
+import functools
 import importlib
 import json
 import os
 import pickle
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..observability import metrics as _metrics
+from ..observability import spans as _spans
 from .dataset import Dataset
 from .params import Param, Params
 
 
+def _row_count(ds: Any) -> Optional[int]:
+    try:
+        return len(ds)
+    except Exception:  # noqa: BLE001 — telemetry must never break a stage
+        return None
+
+
+def _instrumented(method, op: str):
+    """Wrap a stage's ``fit``/``transform`` in a ``{ClassName}.{uid}`` span
+    recording input/output row counts (the TPU analog of the reference's
+    per-scope StopWatch names). Disabled telemetry short-circuits to the
+    raw method — behavior and results are byte-identical either way."""
+
+    @functools.wraps(method)
+    def wrapped(self, dataset, *args, **kwargs):
+        if not _metrics.enabled():
+            return method(self, dataset, *args, **kwargs)
+        cls = type(self).__name__
+        with _spans.span(f"{cls}.{self.uid}", metric_label=cls,
+                         op=op) as sp:
+            rows_in = _row_count(dataset)
+            if rows_in is not None:
+                sp.set(rows_in=rows_in)
+                _metrics.safe_counter("stage_rows_in_total",
+                                      stage=cls, op=op).inc(rows_in)
+            out = method(self, dataset, *args, **kwargs)
+            if op == "transform":
+                rows_out = _row_count(out)
+                if rows_out is not None:
+                    sp.set(rows_out=rows_out)
+                    _metrics.safe_counter("stage_rows_out_total",
+                                          stage=cls, op=op).inc(rows_out)
+        return out
+
+    wrapped._telemetry_wrapped = True
+    return wrapped
+
+
 class PipelineStage(Params):
-    """Common base: anything placeable in a Pipeline."""
+    """Common base: anything placeable in a Pipeline.
+
+    Every subclass's own ``fit`` / ``transform`` is auto-wrapped in a
+    telemetry span at class-creation time (``__init_subclass__``), so all
+    stages — built-in and user-defined — report per-stage timing and row
+    counts without opting in.
+    """
 
     uid_counter = 0
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        for attr, op in (("fit", "fit"), ("transform", "transform")):
+            m = cls.__dict__.get(attr)
+            if callable(m) and not getattr(m, "_telemetry_wrapped", False):
+                setattr(cls, attr, _instrumented(m, op))
 
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
@@ -85,7 +140,13 @@ class Pipeline(Estimator):
     """Sequential stages; estimators are fit then their models transform.
 
     Parity with Spark ML Pipeline semantics used throughout the reference.
+    ``fit`` additionally records a per-stage timing table — the TPU analog
+    of wrapping each stage in the reference's Timer
+    (stages/Timer.scala:57-92) — retrievable via :meth:`last_fit_report`.
     """
+
+    # class-level default: instances restored via load_stage bypass __init__
+    _last_fit_report: List[Dict[str, Any]] = []
 
     def __init__(self, stages: Optional[List[PipelineStage]] = None, **kwargs):
         super().__init__(**kwargs)
@@ -100,20 +161,46 @@ class Pipeline(Estimator):
 
     def fit(self, dataset: Dataset) -> "PipelineModel":
         fitted: List[Transformer] = []
+        report: List[Dict[str, Any]] = []
         current = dataset
         for i, stage in enumerate(self.stages):
+            t0 = time.perf_counter()
+            rows_in = _row_count(current)
             if isinstance(stage, Estimator):
+                op = "fit"
                 model = stage.fit(current)
                 fitted.append(model)
                 if i < len(self.stages) - 1:
+                    op = "fit+transform"
                     current = model.transform(current)
             elif isinstance(stage, Transformer):
+                # the final transformer is only collected during fit (it
+                # first runs at PipelineModel.transform time)
+                op = "transform" if i < len(self.stages) - 1 else "collect"
                 fitted.append(stage)
                 if i < len(self.stages) - 1:
                     current = stage.transform(current)
             else:
                 raise TypeError(f"stage {stage!r} is neither Estimator nor Transformer")
+            report.append({
+                "stage": type(stage).__name__, "uid": stage.uid, "op": op,
+                "seconds": time.perf_counter() - t0,
+                "rows_in": rows_in,
+                # the final stage never transforms during fit ('fit' /
+                # 'collect'), so there is no output to count — reporting
+                # the untouched input's length would claim it emitted rows
+                "rows_out": (_row_count(current)
+                             if i < len(self.stages) - 1 else None),
+            })
+        self._last_fit_report = report
         return PipelineModel(fitted)
+
+    def last_fit_report(self) -> List[Dict[str, Any]]:
+        """Per-stage timing of the most recent :meth:`fit`: one entry per
+        stage with ``stage``/``uid``/``op``/``seconds``/``rows_in``/
+        ``rows_out`` (empty before any fit; ``rows_out`` is None for the
+        final stage, which does not transform during fit)."""
+        return [dict(r) for r in self._last_fit_report]
 
     def _save_extra(self, path: str) -> None:
         _save_stage_list(self.stages, os.path.join(path, "stages"))

@@ -40,7 +40,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional
 
 from ..core.dataset import Dataset
-from .serving import ServingQuery, ServingServer
+from ..observability import metrics as _metrics
+from .serving import (ServingQuery, ServingServer, is_metrics_scrape,
+                      write_metrics_response)
 
 # ---------------------------------------------------------------------------
 # Service registry
@@ -143,9 +145,25 @@ class GatewayServer:
 
         class Handler(BaseHTTPRequestHandler):
             def _handle(self, method):
+                # enabled() gate: same disabled-path contract as
+                # ServingServer — set_enabled(False) restores plain
+                # proxying of GET /metrics to the workers
+                if _metrics.enabled() and \
+                        is_metrics_scrape(method, self.path, outer.api_name):
+                    # the gateway's own registry view: routing counters,
+                    # failovers, live-worker gauge — not proxied to workers
+                    write_metrics_response(self)
+                    return
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
+                t0 = time.perf_counter()
                 status, payload, hdrs = outer._route(method, self.path, body)
+                _metrics.safe_histogram("gateway_request_seconds",
+                                        api=outer.api_name).observe(
+                    time.perf_counter() - t0)
+                _metrics.safe_counter("gateway_responses_total",
+                                      api=outer.api_name,
+                                      code=str(status)).inc()
                 self.send_response(status)
                 for k, v in hdrs.items():
                     self.send_header(k, v)
@@ -195,8 +213,11 @@ class GatewayServer:
         workers = self.registry.workers()
         now = time.monotonic()
         with self._lock:
-            return [w for w in workers
+            live = [w for w in workers
                     if self._dead.get(w.worker_id, 0) < now]
+        _metrics.safe_gauge("gateway_live_workers", api=self.api_name).set(
+                 len(live))
+        return live
 
     def _pick(self, exclude=()) -> Optional[WorkerInfo]:
         workers = [w for w in self._live_workers()
@@ -232,6 +253,13 @@ class GatewayServer:
                            resp.getheader("Content-Type", "text/plain")}
                 conn.close()
                 self.forwarded += 1
+                # labeled by address, not worker_id: ids are minted per
+                # worker start, so churn under failover would grow the
+                # registry (and every scrape) one dead series per
+                # replacement; the host:port slot set is bounded
+                _metrics.safe_counter("gateway_forwarded_total",
+                                      api=self.api_name,
+                                      worker=f"{w.host}:{w.port}").inc()
                 return resp.status, payload, headers
             except (OSError, http.client.HTTPException):
                 # connection-level failure OR a worker dying mid-response
@@ -241,6 +269,8 @@ class GatewayServer:
                     self._dead[w.worker_id] = (time.monotonic()
                                                + 10 * self.health_interval)
                 self.failovers += 1
+                _metrics.safe_counter("gateway_failovers_total",
+                                      api=self.api_name).inc()
             finally:
                 with self._lock:
                     self._inflight[w.worker_id] = max(

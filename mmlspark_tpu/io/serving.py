@@ -44,7 +44,46 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional
 
 from ..core.dataset import Dataset
+from ..observability import metrics as _metrics
 from .http import to_jsonable
+
+#: paths (relative to the server root) answered with the Prometheus text
+#: rendering of the global registry instead of entering the request queue
+METRICS_PATH = "/metrics"
+
+
+def render_metrics() -> bytes:
+    """Prometheus text exposition of the process-wide registry."""
+    return _metrics.get_registry().render_prometheus().encode("utf-8")
+
+
+def is_metrics_scrape(method: str, path: str, api_name: str) -> bool:
+    """True when a request is a ``GET /metrics`` (or
+    ``GET /{api_name}/metrics``) scrape — shared by ``ServingServer`` and
+    the distributed-serving gateway so the path normalization and alias
+    set stay defined in exactly one place."""
+    if method != "GET":
+        return False
+    path_only = path.split("?", 1)[0].rstrip("/") or "/"
+    return path_only in (METRICS_PATH, f"/{api_name}{METRICS_PATH}")
+
+
+def write_metrics_response(handler: BaseHTTPRequestHandler) -> None:
+    """Answer a scrape on any ``BaseHTTPRequestHandler`` in-band — shared
+    by ``ServingServer`` and the distributed-serving gateway so the
+    exposition content type stays defined in exactly one place."""
+    payload = render_metrics()
+    handler.send_response(200)
+    handler.send_header("Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+    handler.send_header("Content-Length", str(len(payload)))
+    handler.end_headers()
+    handler.wfile.write(payload)
+
+
+# power-of-two ladder matching the jit bucket shapes (bucket_size below)
+_BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                       256.0, 512.0, 1024.0)
 
 # ---------------------------------------------------------------------------
 # Request plumbing
@@ -88,32 +127,66 @@ class ServingServer:
 
         class Handler(BaseHTTPRequestHandler):
             def _handle(self, method: str):
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
-                req = ServedRequest(
-                    id=uuid.uuid4().hex, method=method, path=self.path,
-                    headers={k.lower(): v for k, v in self.headers.items()},
-                    body=body)
-                with outer._lock:
-                    outer._inflight[req.id] = req
-                outer._queue.put(req)
-                ok = req.done.wait(outer.request_timeout)
-                with outer._lock:
-                    outer._inflight.pop(req.id, None)
-                if not ok or req.response is None:
-                    self.send_response(504)
-                    self.end_headers()
+                # the enabled() gate keeps the disabled-path contract
+                # (set_enabled(False) restores exactly the uninstrumented
+                # routing) and gives an API that legitimately owns GET
+                # /metrics a way to reclaim the path
+                if _metrics.enabled() and \
+                        is_metrics_scrape(method, self.path, outer.api_name):
+                    # answered in-band, never queued: the scrape must work
+                    # even when the batching worker is wedged
+                    write_metrics_response(self)
                     return
-                resp = req.response
-                self.send_response(int(resp.get("statusCode", 200)))
-                payload = resp.get("entity", b"")
-                if isinstance(payload, str):
-                    payload = payload.encode("utf-8")
-                for k, v in (resp.get("headers") or {}).items():
-                    self.send_header(k, v)
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
+                t0 = time.perf_counter()
+                # captured once so inc/dec hit the same object even if
+                # metrics.set_enabled is toggled while this request is
+                # parked on done.wait() — re-resolving in the finally
+                # would pair a real inc with a no-op dec and skew the
+                # gauge permanently
+                inflight = _metrics.safe_gauge("serving_inflight_requests",
+                                               api=outer.api_name)
+                inflight.inc()
+                status = 504
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else b""
+                    req = ServedRequest(
+                        id=uuid.uuid4().hex, method=method, path=self.path,
+                        headers={k.lower(): v
+                                 for k, v in self.headers.items()},
+                        body=body)
+                    with outer._lock:
+                        outer._inflight[req.id] = req
+                    outer._queue.put(req)
+                    _metrics.safe_gauge("serving_queue_depth",
+                                        api=outer.api_name).set(
+                        outer._queue.qsize())
+                    ok = req.done.wait(outer.request_timeout)
+                    with outer._lock:
+                        outer._inflight.pop(req.id, None)
+                    if not ok or req.response is None:
+                        self.send_response(504)
+                        self.end_headers()
+                        return
+                    resp = req.response
+                    status = int(resp.get("statusCode", 200))
+                    self.send_response(status)
+                    payload = resp.get("entity", b"")
+                    if isinstance(payload, str):
+                        payload = payload.encode("utf-8")
+                    for k, v in (resp.get("headers") or {}).items():
+                        self.send_header(k, v)
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                finally:
+                    inflight.dec()
+                    _metrics.safe_counter("serving_responses_total",
+                                          api=outer.api_name,
+                                          code=str(status)).inc()
+                    _metrics.safe_histogram(
+                        "serving_request_seconds", api=outer.api_name
+                    ).observe(time.perf_counter() - t0)
 
             def do_GET(self):
                 self._handle("GET")
@@ -172,13 +245,14 @@ class ServingServer:
             out.append(self._queue.get(timeout=max_latency))
         except queue.Empty:
             return out
+        t_first = time.monotonic()
         if eager:
             while len(out) < max_batch:
                 try:
                     out.append(self._queue.get_nowait())
                 except queue.Empty:
                     break
-            return out
+            return self._batch_assembled(out, t_first)
         deadline = time.monotonic() + max_latency
         while len(out) < max_batch:
             remaining = deadline - time.monotonic()
@@ -188,6 +262,17 @@ class ServingServer:
                 out.append(self._queue.get(timeout=remaining))
             except queue.Empty:
                 break
+        return self._batch_assembled(out, t_first)
+
+    def _batch_assembled(self, out: List[ServedRequest],
+                         t_first: float) -> List[ServedRequest]:
+        # assembly wait = time after the FIRST arrival spent filling the
+        # batch (0 for an eager lone request; bounded by the deadline)
+        _metrics.safe_histogram("serving_batch_assembly_seconds",
+                                api=self.api_name).observe(
+            time.monotonic() - t_first)
+        _metrics.safe_gauge("serving_queue_depth", api=self.api_name).set(
+            self._queue.qsize())
         return out
 
     def requeue(self, req: ServedRequest) -> bool:
@@ -311,12 +396,17 @@ class ServingQuery:
             time.sleep(0.01)
 
     def _run(self) -> None:
+        api = self.server.api_name
         while not self._stop.is_set():
             batch = self.server.get_batch(self.max_batch, self.max_latency,
                                           self.eager)
             if not batch:
                 continue
+            _metrics.safe_histogram("serving_batch_size", api=api,
+                                    buckets=_BATCH_SIZE_BUCKETS).observe(
+                len(batch))
             ds = requests_to_dataset(batch)
+            t0 = time.perf_counter()
             try:
                 out = self.transform(ds)
                 replies = out[self.reply_col]
@@ -329,8 +419,16 @@ class ServingQuery:
                         self.server.reply(rid, rep)
                 self.batches_served += 1
                 self.requests_served += len(batch)
+                _metrics.safe_counter("serving_batches_total", api=api).inc()
+                _metrics.safe_histogram("serving_transform_seconds",
+                                        api=api).observe(
+                    time.perf_counter() - t0)
             except Exception:
                 survivors = [r for r in batch if self.server.requeue(r)]
+                _metrics.safe_counter("serving_batch_failures_total",
+                                      api=api).inc()
+                _metrics.safe_counter("serving_requeues_total", api=api).inc(
+                    len(survivors))
                 for r in batch:
                     if r not in survivors and not r.done.is_set():
                         self.server.reply(r.id, {"error": "internal"}, 500)

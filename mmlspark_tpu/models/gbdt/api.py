@@ -23,6 +23,8 @@ from ...core.params import (HasFeaturesCol, HasGroupCol, HasInitScoreCol,
                             HasRawPredictionCol, HasValidationIndicatorCol,
                             HasWeightCol, Param, Params, TypeConverters)
 from ...core.pipeline import Estimator, Model
+from ...observability import metrics as _metrics
+from ...observability import spans as _spans
 from .booster import Booster, LightGBMDataset, _densify, train_booster
 from .growth import GrowConfig
 
@@ -349,8 +351,63 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
         mask = dataset.array(vcol).astype(bool)
         return dataset.filter(~mask), dataset.filter(mask)
 
+    def _round_callback(self):
+        """Per-boost-round telemetry callback, or None.
+
+        Opt-in via MMLSPARK_TPU_TELEMETRY_ROUNDS=1: a non-None
+        iteration_callback forces train_booster onto its host loop (one
+        device dispatch per round), so round-level spans must never be the
+        silent default — the fused single-dispatch paths are the product.
+        """
+        if not (_metrics.enabled()
+                and os.environ.get("MMLSPARK_TPU_TELEMETRY_ROUNDS") == "1"):
+            return None
+        cls = type(self).__name__
+
+        def cb(it: int, round_metrics: dict) -> None:
+            vals = {k: float(v) for k, v in round_metrics.items()}
+            _spans.instant("boost_round", model=cls, iteration=it, **vals)
+            _metrics.safe_counter("gbdt_boost_rounds_total", model=cls).inc()
+            for k, v in vals.items():
+                _metrics.safe_gauge("gbdt_round_metric",
+                                    model=cls, metric=k).set(v)
+        return cb
+
+    def _publish_booster_telemetry(self, booster: Booster) -> None:
+        """Registry view of a finished fit: round count, best iteration,
+        final value of each tracked loss/metric series, and a fresh HBM
+        sample (the binned-dataset cache retains device memory across fits
+        — exactly the growth device_memory_bytes should make visible)."""
+        if not _metrics.enabled():
+            return
+        cls = type(self).__name__
+        _metrics.safe_counter("gbdt_fits_total", model=cls).inc()
+        _metrics.safe_gauge("gbdt_trained_iterations",
+                            model=cls).set(booster.num_iterations)
+        if booster.best_iteration is not None and booster.best_iteration >= 0:
+            _metrics.safe_gauge("gbdt_best_iteration",
+                                model=cls).set(booster.best_iteration)
+        for mname, series in (booster.eval_history or {}).items():
+            if series:
+                _metrics.safe_gauge("gbdt_train_metric", model=cls,
+                                    metric=str(mname)).set(float(series[-1]))
+        from ...observability.device import device_memory_gauges
+        device_memory_gauges()
+
     def _fit_booster(self, dataset: Dataset, objective: str, num_class: int,
                      objective_kwargs: Optional[dict] = None) -> Booster:
+        cls = type(self).__name__
+        with _spans.span(f"{self.uid}.train_booster",
+                         metric_label=f"{cls}.train_booster",
+                         objective=objective, num_class=num_class):
+            booster = self._fit_booster_impl(dataset, objective, num_class,
+                                             objective_kwargs)
+        self._publish_booster_telemetry(booster)
+        return booster
+
+    def _fit_booster_impl(self, dataset: Dataset, objective: str,
+                          num_class: int,
+                          objective_kwargs: Optional[dict] = None) -> Booster:
         train_ds, valid_ds = self._split_validation(dataset)
         X, y, w = self._extract_arrays(train_ds)
         valid_set = None
@@ -395,6 +452,9 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
                 "isProvideTrainingMetric"),
             max_bin_by_feature=self.get_or_default("maxBinByFeature"),
             eval_metric_name=self.get_or_default("metric"),
+            # None unless MMLSPARK_TPU_TELEMETRY_ROUNDS=1: a live callback
+            # forces the host loop, so fused dispatch stays the default
+            iteration_callback=self._round_callback(),
         )
         num_iterations = self.get_or_default("numIterations")
         if (num_batches and num_batches > 1

@@ -196,6 +196,18 @@ def shap_values(booster, X: np.ndarray) -> np.ndarray:
         k = t % K
         feat = feat_np[t]
         thr = thr_raw[t]
+        is_leaf = np.asarray(trees.is_leaf[t])
+        # split-feature bounds are validated HERE, before engine dispatch:
+        # the native walk rejects such trees (routing them to this
+        # function), but the numpy engine would wrap feat=-1 to the
+        # last phi column and write feat==F into the expected-value
+        # column — silently corrupted attributions, not an error
+        internal_feat = feat[~is_leaf.astype(bool)]
+        if internal_feat.size and (internal_feat.min() < 0
+                                   or internal_feat.max() >= F):
+            raise ValueError(
+                f"tree {t} has an internal node with split feature "
+                f"outside [0, {F}) — malformed or truncated model")
         # routing decisions for every node at once: [M, n]
         xv = X[:, feat]                              # [n, M] gathered
         gl = (~(xv > thr[None, :])).T                # [M, n]; NaN -> left
@@ -206,7 +218,6 @@ def shap_values(booster, X: np.ndarray) -> np.ndarray:
                                booster._cat_max_idx(),
                                booster._cat_strict()),
                 gl)
-        is_leaf = np.asarray(trees.is_leaf[t])
         cover = np.asarray(trees.node_cnt[t], dtype=np.float64)
         values = np.asarray(trees.leaf_value[t], dtype=np.float64)
         phi_f = None

@@ -116,8 +116,10 @@ _EXPECTED_SYMBOLS = ("mm_abi_version", "mm_murmur3_32", "mm_murmur3_batch",
 # behavioral version (mm_abi_version in mmlspark_native.cpp): symbol
 # presence alone can't catch a prebuilt whose symbols all exist but whose
 # SEMANTICS are stale (e.g. the pre-cycle-guard mm_treeshap); bump both
-# on any native behavior change
-_ABI_VERSION = 3
+# on any native behavior change (v4: mm_treeshap rejects out-of-range
+# split features, cycles, and trees past the 256 MiB arena budget —
+# effective depth cutoff ~3094, with a 4096 structural backstop)
+_ABI_VERSION = 4
 
 
 def _prebuilt_current(lib: ctypes.CDLL) -> bool:
@@ -304,7 +306,10 @@ def treeshap_tree(feat: np.ndarray, left: np.ndarray, right: np.ndarray,
         M, n, int(n_features), int(n_threads),
         phi.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
     if rc != 0:
-        # malformed tree (child index out of range): let the Python
-        # engine run instead — it raises a meaningful IndexError
+        # malformed/degenerate tree (bad child or feature index, cycle,
+        # depth past the native arena budget): route to the Python engine
+        # — shap_values pre-validates split features, bad child indices
+        # raise a meaningful IndexError there, and legitimately deep
+        # chains run on its heap-based stack instead of C recursion
         return None
     return phi

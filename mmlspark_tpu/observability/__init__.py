@@ -1,0 +1,35 @@
+"""Unified telemetry: metrics registry, stage-level spans, device gauges.
+
+Three pieces, one flag:
+
+- :mod:`.metrics` — process-wide ``MetricsRegistry`` (Counter / Gauge /
+  Histogram with labels), snapshot-to-dict, Prometheus text renderer.
+- :mod:`.spans` — nesting wall-time spans that feed the registry AND enter
+  ``utils/profiling.annotate`` so host scopes and XLA device traces share
+  names; exportable as Chrome trace-event JSON.
+- :mod:`.device` — ``device_memory_gauges()`` sampling live HBM stats.
+
+``metrics.set_enabled(False)`` turns every instrumentation site in the
+framework into a cheap no-op (profiling.py's never-break-the-pipeline
+contract). ``ServingServer`` exposes the registry at ``GET /metrics``.
+See docs/observability.md.
+"""
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
+                      counter, enabled, gauge, get_registry, histogram,
+                      reset, safe_counter, safe_gauge, safe_histogram,
+                      set_enabled, set_registry)
+from .spans import (clear_trace, current_span, dump_trace,  # noqa: F401
+                    get_trace_events, instant, set_default_attrs, span,
+                    span_fn)
+from .device import device_memory_gauges  # noqa: F401
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "counter", "gauge", "histogram", "get_registry", "set_registry",
+    "safe_counter", "safe_gauge", "safe_histogram",
+    "reset", "enabled", "set_enabled",
+    "span", "span_fn", "instant", "dump_trace", "get_trace_events",
+    "clear_trace", "set_default_attrs", "current_span",
+    "device_memory_gauges",
+]

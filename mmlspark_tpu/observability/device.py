@@ -1,0 +1,45 @@
+"""Device telemetry: sample live HBM/host memory stats into gauges.
+
+``utils/profiling.device_memory_stats`` gives a point-in-time PJRT view;
+sampling it into the registry turns that into a series an operator can
+watch — HBM growth across boost rounds (the binned-dataset cache's
+documented retention, models/gbdt/api.py) shows up as a rising
+``device_memory_bytes{stat="bytes_in_use"}`` between scrapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from . import metrics as _metrics
+
+__all__ = ["device_memory_gauges"]
+
+# PJRT stat keys worth exporting (others vary by backend and stay in the
+# returned dict for callers that want them).
+_STAT_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+              "largest_free_block_bytes", "pool_bytes")
+
+
+def device_memory_gauges() -> Dict[str, Optional[Dict[str, Any]]]:
+    """Sample per-device memory stats into ``device_memory_bytes`` gauges
+    (labels: ``device``, ``stat``) and return the raw stats dict.
+
+    No-op (returns ``{}``) while telemetry is disabled; devices whose
+    runtime exposes no stats are skipped (profiling already records the
+    reason), so this never breaks the run it observes.
+    """
+    if not _metrics.enabled():
+        return {}
+    from ..utils import profiling  # lazy: jax only touched when sampling
+
+    stats = profiling.device_memory_stats()
+    for dev, ms in stats.items():
+        if not ms:
+            continue
+        for key in _STAT_KEYS:
+            v = ms.get(key)
+            if v is not None:
+                _metrics.safe_gauge("device_memory_bytes",
+                                    device=dev, stat=key).set(float(v))
+    return stats

@@ -55,7 +55,12 @@ def initialize(coordinator_address: Optional[str] = None,
     readable, client = _coordination_client()
     if readable:
         if client is not None:
-            return  # already initialized
+            # already initialized (possibly directly or by another
+            # framework): still stamp the process index, or multi-host
+            # trace events fall back to os.getpid(), which can collide
+            # across hosts and interleave merged dumps into one pid track
+            _tag_spans_with_process_index()
+            return
     elif _initialized_here:
         return  # private state unreadable; trust our own flag
     try:
@@ -70,6 +75,26 @@ def initialize(coordinator_address: Optional[str] = None,
                 "JAX_COORDINATOR_ADDRESS" in os.environ:
             raise  # explicit multi-host request must not be swallowed
         # auto-detection unavailable (single host, no metadata server): fine
+        return
+    _tag_spans_with_process_index()
+
+
+def _tag_spans_with_process_index() -> None:
+    """Stamp this host's process index onto every subsequent telemetry
+    event (observability.spans uses it as the Chrome-trace pid), so merged
+    multi-host trace dumps separate by process. Backend is safe to touch
+    here: jax.distributed.initialize has already run."""
+    try:
+        from ..observability import metrics as _metrics
+        from ..observability import spans as _spans
+        if not _metrics.enabled():
+            # jax.process_index() creates the XLA backend as a side
+            # effect — don't pay (or force) backend startup to stamp an
+            # attribute the disabled telemetry layer will never record
+            return
+        _spans.set_default_attrs(process_index=jax.process_index())
+    except Exception:  # noqa: BLE001 — telemetry must never break init
+        pass
 
 
 def process_index() -> int:
@@ -107,4 +132,6 @@ def barrier(name: str = "barrier") -> None:
         if jax.process_count() == 1:
             return                      # single process: barrier is a no-op
         raise RuntimeError("no distributed client; call initialize() first")
-    client.wait_at_barrier(name, timeout_in_ms=60_000)
+    from ..observability.spans import span as _span
+    with _span(f"barrier.{name}", metric_label="barrier", barrier=name):
+        client.wait_at_barrier(name, timeout_in_ms=60_000)

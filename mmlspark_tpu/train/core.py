@@ -17,6 +17,8 @@ from ..core.dataset import Dataset
 from ..core.params import (HasFeaturesCol, HasLabelCol, Param, TypeConverters)
 from ..core.pipeline import Estimator, Model, Transformer
 from ..featurize.core import Featurize, ValueIndexer
+from ..observability import metrics as _metrics
+from ..observability.spans import span as _span
 
 
 class TrainClassifier(Estimator, HasLabelCol):
@@ -46,46 +48,55 @@ class TrainClassifier(Estimator, HasLabelCol):
         levels = None
         ds = dataset
         if self.get_or_default("reindexLabel"):
-            explicit = self.get_or_default("labels")
-            if explicit:
-                # reference TrainClassifier `labels`: the given ordering IS
-                # the index mapping; values outside it must fail loudly.
-                # Levels must match the column's value domain — numeric
-                # columns index by float, string columns by str (the
-                # Param converter stores the list as strings either way).
-                from ..featurize.core import ValueIndexerModel, _is_numeric
-                col = ds[label]
-                if _is_numeric(col):
-                    levels = [float(v) for v in explicit]
-                    seen = {float(v) for v in np.asarray(col).ravel()
-                            if not (isinstance(v, float) and np.isnan(v))}
-                else:
-                    levels = [str(v) for v in explicit]
-                    seen = {str(v) for v in col if v is not None}
-                extra = sorted(seen - set(levels))
-                if extra:
-                    raise ValueError(
-                        f"label column contains values {extra} not in the "
-                        f"explicit labels list {explicit}")
-                indexer_model = ValueIndexerModel(
-                    levels=levels).set(inputCol=label, outputCol=label)
-                ds = indexer_model.transform(ds)
-            else:
-                indexer = ValueIndexer(inputCol=label,
-                                       outputCol=label).fit(ds)
-                levels = indexer.get_or_default("levels")
-                ds = indexer.transform(ds)
-        feat_model = Featurize(
-            labelCol=label, outputCol=fcol,
-            numberOfFeatures=self.get_or_default("numFeatures")).fit(ds)
-        ds = feat_model.transform(ds)
+            with _span(f"{self.uid}.index_labels",
+                       metric_label="TrainClassifier.index_labels"):
+                ds, levels = self._index_labels(ds, label)
+        with _span(f"{self.uid}.featurize",
+                   metric_label="TrainClassifier.featurize"):
+            feat_model = Featurize(
+                labelCol=label, outputCol=fcol,
+                numberOfFeatures=self.get_or_default("numFeatures")).fit(ds)
+            ds = feat_model.transform(ds)
         inner = self.get_or_default("model").copy(
             {"labelCol": label, "featuresCol": fcol})
-        fitted = inner.fit(ds)
+        with _span(f"{self.uid}.fit_inner",
+                   metric_label="TrainClassifier.fit_inner",
+                   inner=type(inner).__name__):
+            fitted = inner.fit(ds)
         model = TrainedClassifierModel(featurizer=feat_model, inner=fitted,
                                        levels=levels)
         self._copy_params_to(model)
         return model
+
+    def _index_labels(self, ds: Dataset, label: str):
+        """Label indexing phase of fit (explicit `labels` ordering or a
+        fitted ValueIndexer) — returns (indexed dataset, levels)."""
+        explicit = self.get_or_default("labels")
+        if explicit:
+            # reference TrainClassifier `labels`: the given ordering IS
+            # the index mapping; values outside it must fail loudly.
+            # Levels must match the column's value domain — numeric
+            # columns index by float, string columns by str (the
+            # Param converter stores the list as strings either way).
+            from ..featurize.core import ValueIndexerModel, _is_numeric
+            col = ds[label]
+            if _is_numeric(col):
+                levels = [float(v) for v in explicit]
+                seen = {float(v) for v in np.asarray(col).ravel()
+                        if not (isinstance(v, float) and np.isnan(v))}
+            else:
+                levels = [str(v) for v in explicit]
+                seen = {str(v) for v in col if v is not None}
+            extra = sorted(seen - set(levels))
+            if extra:
+                raise ValueError(
+                    f"label column contains values {extra} not in the "
+                    f"explicit labels list {explicit}")
+            indexer_model = ValueIndexerModel(
+                levels=levels).set(inputCol=label, outputCol=label)
+            return indexer_model.transform(ds), levels
+        indexer = ValueIndexer(inputCol=label, outputCol=label).fit(ds)
+        return indexer.transform(ds), indexer.get_or_default("levels")
 
 
 class TrainedClassifierModel(Model, HasLabelCol):
@@ -137,13 +148,19 @@ class TrainRegressor(Estimator, HasLabelCol):
     def fit(self, dataset: Dataset) -> "TrainedRegressorModel":
         label = self.get_or_default("labelCol")
         fcol = self.get_or_default("featuresCol")
-        feat_model = Featurize(
-            labelCol=label, outputCol=fcol,
-            numberOfFeatures=self.get_or_default("numFeatures")).fit(dataset)
-        ds = feat_model.transform(dataset)
+        with _span(f"{self.uid}.featurize",
+                   metric_label="TrainRegressor.featurize"):
+            feat_model = Featurize(
+                labelCol=label, outputCol=fcol,
+                numberOfFeatures=self.get_or_default("numFeatures")).fit(
+                    dataset)
+            ds = feat_model.transform(dataset)
         inner = self.get_or_default("model").copy(
             {"labelCol": label, "featuresCol": fcol})
-        fitted = inner.fit(ds)
+        with _span(f"{self.uid}.fit_inner",
+                   metric_label="TrainRegressor.fit_inner",
+                   inner=type(inner).__name__):
+            fitted = inner.fit(ds)
         model = TrainedRegressorModel(featurizer=feat_model, inner=fitted)
         self._copy_params_to(model)
         return model
@@ -236,6 +253,15 @@ class ComputeModelStatistics(Transformer):
         return len(vals) <= max(20, int(np.sqrt(len(y)))) and \
             np.allclose(vals, vals.astype(int))
 
+    def _publish(self, out: dict) -> Dataset:
+        """Mirror the scalar metric table into registry gauges
+        (``model_statistic{metric=...}``) so evaluation results are
+        scrapeable alongside serving/training telemetry."""
+        for k, v in out.items():
+            _metrics.safe_gauge("model_statistic",
+                                metric=k).set(float(np.asarray(v)[0]))
+        return Dataset(out)
+
     def transform(self, dataset: Dataset) -> Dataset:
         y = dataset.array(self.get_or_default("labelCol"), np.float64)
         pred = dataset.array(self.get_or_default("scoredLabelsCol"), np.float64)
@@ -281,12 +307,12 @@ class ComputeModelStatistics(Transformer):
                     "threshold": thr_c,
                     "precision": prec_c[1:],
                     "recall": rec_c[1:]})
-            return Dataset(out)
+            return self._publish(out)
         # regression
         err = pred - y
         mse = float(np.mean(err ** 2))
         var = float(np.var(y))
-        return Dataset({
+        return self._publish({
             "mean_squared_error": np.asarray([mse]),
             "root_mean_squared_error": np.asarray([mse ** 0.5]),
             "mean_absolute_error": np.asarray([float(np.mean(np.abs(err)))]),

@@ -138,7 +138,7 @@ extern "C" {
 // the same symbols would otherwise silently ship old behavior, e.g. the
 // pre-cycle-guard mm_treeshap). Keep in sync with _ABI_VERSION in
 // mmlspark_tpu/native/__init__.py.
-int64_t mm_abi_version() { return 3; }
+int64_t mm_abi_version() { return 4; }
 
 // ---------------------------------------------------------------------------
 // MurmurHash3_x86_32 (Austin Appleby, public domain) — must match
@@ -526,12 +526,34 @@ void ts_recurse(const TsTree& T, const uint8_t* go, int64_t n, int64_t row,
              level + 1, len, A, phi);
 }
 
-// Iterative max depth (leafwise chains can be ~num_leaves deep). Bounds
-// check BEFORE the is_leaf dereference: a malformed imported tree with a
-// child index of -1 / >= M must not read out of bounds here. Returns -1
-// for such trees so the caller can reject them instead of recursing into
-// the same out-of-bounds walk.
-int ts_max_depth(const TsTree& T, int64_t M) {
+// Structural backstop on tree depth: ts_recurse is true C recursion, so
+// a degenerate chain (huge num_leaves with leaf_batch=1, or an imported
+// deep chain) would overflow the thread stack; past this the tree routes
+// to the heap-stacked numpy engine, which degrades gracefully. NOTE the
+// arena budget below binds FIRST (at 256 MiB it rejects depth > ~3094),
+// so this constant only matters if the budget is raised.
+constexpr int kTsMaxAcceptedDepth = 4096;
+
+// The per-thread TsArena is O(levels^2) cells of one int32 + three
+// doubles, so a depth cap alone does not bound memory (depth 4000 ~=
+// 450 MB per thread). Accepted trees must fit ALL threads' arenas in
+// this budget: the thread count is clamped to it, and a tree whose
+// single arena exceeds it is rejected outright (routed to numpy) — the
+// EFFECTIVE depth cutoff, sqrt(budget/28)-2 ~= 3094 at 256 MiB.
+constexpr int64_t kTsArenaBytesPerCell =
+    sizeof(int32_t) + 3 * sizeof(double);
+constexpr int64_t kTsArenaBudgetBytes = 256ll << 20;
+
+// Iterative validation walk + max depth (leafwise chains can be
+// ~num_leaves deep). Bounds check BEFORE the is_leaf dereference: a
+// malformed imported tree with a child index of -1 / >= M must not read
+// out of bounds here. Internal nodes must also carry a split feature in
+// [0, F): ts_recurse writes phi[feat[j]] for every internal node on a
+// path, so an out-of-range feature is an out-of-bounds heap write (the
+// Python routing build does not catch a negative one — numpy wraps it).
+// Returns -1 for any such tree so the caller can reject it instead of
+// recursing into the same out-of-bounds walk.
+int ts_max_depth(const TsTree& T, int64_t M, int64_t F) {
   std::vector<int32_t> stack_node{0};
   std::vector<int32_t> stack_depth{0};
   int maxd = 0;
@@ -546,7 +568,9 @@ int ts_max_depth(const TsTree& T, int64_t M) {
     // forming a CYCLE would walk forever without this bound
     if (++pops > M) return -1;
     maxd = std::max(maxd, (int)dep);
+    if (maxd > kTsMaxAcceptedDepth) return -1;
     if (!T.is_leaf[j]) {
+      if (T.feat[j] < 0 || T.feat[j] >= F) return -1;
       stack_node.push_back(T.left[j]);
       stack_depth.push_back(dep + 1);
       stack_node.push_back(T.right[j]);
@@ -563,8 +587,10 @@ extern "C" {
 // One tree, all instances: phi[n, F] += per-feature Shapley values.
 // go_left: [M, n] row-major routing (1 = instance follows the left child).
 // The expected-value column is the caller's (pure cover arithmetic).
-// Returns 0, or -1 for a malformed tree (child index out of [0, M) —
-// the caller falls back to the checked Python engine).
+// Returns 0, or -1 for a malformed/degenerate tree (child index out of
+// [0, M), internal-node feature out of [0, F), cycle, or depth past
+// kTsMaxAcceptedDepth) — the caller falls back to the checked Python
+// engine.
 int64_t mm_treeshap(const int32_t* feat, const int32_t* left,
                     const int32_t* right, const uint8_t* is_leaf,
                     const double* cover, const double* values,
@@ -572,9 +598,9 @@ int64_t mm_treeshap(const int32_t* feat, const int32_t* left,
                     int64_t F, int64_t n_threads, double* phi) {
   const TsTree T{feat, left, right, is_leaf, cover, values};
   if (M < 1) return -1;
-  // walks the whole tree: validates every child index before ts_recurse
-  // dereferences any of them
-  const int maxd = ts_max_depth(T, M);
+  // walks the whole tree: validates every child and feature index before
+  // ts_recurse dereferences any of them, and bounds the recursion depth
+  const int maxd = ts_max_depth(T, M, F);
   if (maxd < 0) return -1;
   int64_t nt = n_threads > 0
                    ? n_threads
@@ -584,6 +610,10 @@ int64_t mm_treeshap(const int32_t* feat, const int32_t* left,
   // path length <= depth+2 (root sentinel + one per level); one arena row
   // per recursion level, reused across all of a thread's instances
   const int levels = maxd + 2;
+  const int64_t arena_bytes =
+      (int64_t)levels * levels * kTsArenaBytesPerCell;
+  if (arena_bytes > kTsArenaBudgetBytes) return -1;
+  nt = std::min(nt, std::max<int64_t>(1, kTsArenaBudgetBytes / arena_bytes));
 
   WorkPool::instance().run(n, nt, [&](int64_t r0, int64_t r1) {
     TsArena arena(levels, levels);

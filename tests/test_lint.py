@@ -1,0 +1,155 @@
+"""Fast source-level lint for the telemetry layer.
+
+Two invariants keep the observability subsystem safe to import from every
+other layer:
+
+* **No import cycle.** Every package (core, io, train, models, ...)
+  imports ``mmlspark_tpu.observability`` at module top level, so
+  observability itself must never import those packages back at top level
+  — its only framework dependency (``utils.profiling``) is deferred into
+  function bodies. Enforced by AST walk + a fresh-interpreter import.
+* **Valid metric names.** Every metric name passed as a literal to
+  ``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` must match
+  ``[a-z_]+`` or the Prometheus text rendering stops parsing.
+"""
+
+import ast
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+_PKG_ROOT = os.path.join(os.path.dirname(__file__), "..", "mmlspark_tpu")
+_NAME_RE = re.compile(r"^[a-z_]+$")
+_METRIC_FACTORIES = {"counter", "gauge", "histogram",
+                     "safe_counter", "safe_gauge", "safe_histogram"}
+
+
+def _py_files(root):
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _parse(path):
+    with open(path, encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def _top_level_imports(tree):
+    """(module, level) pairs imported at module scope (not inside defs)."""
+    out = []
+    for node in ast.iter_child_nodes(tree):
+        # top-level try/if wrappers around imports still count
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(n, ast.Import):
+                out.extend((a.name, 0) for a in n.names)
+            elif isinstance(n, ast.ImportFrom):
+                out.append((n.module or "", n.level))
+            else:
+                stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def test_observability_has_no_top_level_framework_imports():
+    """observability/* may import stdlib and its own siblings at top level,
+    nothing else from mmlspark_tpu — that is what makes 'every layer
+    imports observability' cycle-free by construction."""
+    obs_dir = os.path.join(_PKG_ROOT, "observability")
+    offenders = []
+    for path in _py_files(obs_dir):
+        for mod, level in _top_level_imports(_parse(path)):
+            top = mod.split(".")[0]
+            if level >= 2 or top == "mmlspark_tpu":
+                # parent-relative (..) or absolute framework import
+                offenders.append(f"{os.path.basename(path)}: "
+                                 f"{'.' * level}{mod}")
+            elif level == 1 and top not in (
+                    "metrics", "spans", "device", ""):
+                offenders.append(f"{os.path.basename(path)}: .{mod}")
+    assert not offenders, (
+        "observability must defer framework imports into function bodies "
+        f"(import-cycle guard); found top-level: {offenders}")
+
+
+def test_observability_imports_standalone():
+    """A fresh interpreter can import the telemetry layer on its own —
+    the runtime proof of the AST rule above (and it keeps the import
+    cheap: no jax, no framework)."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\n"
+         "import mmlspark_tpu.observability as o\n"
+         "assert 'jax' not in sys.modules, 'observability imported jax'\n"
+         "o.counter('lint_total').inc()\n"
+         "print(o.get_registry().render_prometheus())"],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(_PKG_ROOT))
+    assert proc.returncode == 0, proc.stderr
+    assert "lint_total 1" in proc.stdout
+
+
+def _literal_metric_names():
+    """Every string literal passed as the metric name to a
+    counter/gauge/histogram call anywhere under mmlspark_tpu/."""
+    found = []
+    for path in _py_files(_PKG_ROOT):
+        for node in ast.walk(_parse(path)):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else \
+                fn.id if isinstance(fn, ast.Name) else None
+            if name not in _METRIC_FACTORIES or not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str):
+                found.append((os.path.relpath(path, _PKG_ROOT),
+                              node.lineno, first.value))
+    return found
+
+
+def test_metric_name_literals_are_prometheus_safe():
+    names = _literal_metric_names()
+    # the instrumentation exists: an empty scan would mean this lint is
+    # silently matching nothing
+    assert len(names) >= 10, names
+    bad = [(p, ln, n) for p, ln, n in names if not _NAME_RE.match(n)]
+    assert not bad, f"metric names must match [a-z_]+: {bad}"
+
+
+def test_metric_names_unique_per_kind():
+    """One metric name, one kind — the registry raises at runtime on a
+    kind conflict; catch it at lint time across the whole tree."""
+    kinds = {}
+    conflicts = []
+    for path in _py_files(_PKG_ROOT):
+        for node in ast.walk(_parse(path)):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            kind = fn.attr if isinstance(fn, ast.Attribute) else \
+                fn.id if isinstance(fn, ast.Name) else None
+            if kind not in _METRIC_FACTORIES or not node.args:
+                continue
+            kind = kind.removeprefix("safe_")  # same family either way
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str):
+                prev = kinds.setdefault(first.value, kind)
+                if prev != kind:
+                    conflicts.append((first.value, prev, kind))
+    assert not conflicts, conflicts
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

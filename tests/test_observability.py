@@ -1,0 +1,581 @@
+"""Unified telemetry layer: registry, spans, pipeline report, /metrics.
+
+Covers the observability acceptance surface end to end on CPU:
+
+* registry counter/gauge/histogram semantics, label handling, and the
+  Prometheus text rendering (golden test against the exposition format);
+* span nesting + the Chrome trace-event JSON dump;
+* ``Pipeline.fit`` per-stage timing via ``last_fit_report()``;
+* a live ``GET /metrics`` round-trip against a running ``ServingServer``;
+* degradation: telemetry disabled -> stage results byte-identical and the
+  registry untouched; a monkeypatched failing profiler never breaks a span
+  (profiling.py's never-break-the-pipeline contract, inherited here).
+"""
+
+import http.client
+import json
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataset import Dataset
+from mmlspark_tpu.core.pipeline import Estimator, Model, Pipeline, Transformer
+from mmlspark_tpu.observability import metrics, spans
+from mmlspark_tpu.observability.metrics import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Every test starts enabled with an empty registry and trace buffer."""
+    prev = metrics.set_enabled(True)
+    metrics.reset()
+    spans.clear_trace()
+    yield
+    metrics.set_enabled(prev)
+    metrics.reset()
+    spans.clear_trace()
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_counter_accumulates(self):
+        c = metrics.counter("rows_ingested_total", stage="Featurize")
+        c.inc()
+        c.inc(4)
+        assert c.value == 5.0
+        # same name+labels -> same series
+        assert metrics.counter("rows_ingested_total",
+                               stage="Featurize").value == 5.0
+
+    def test_counter_rejects_negative(self):
+        with pytest.raises(ValueError):
+            metrics.counter("oops_total").inc(-1)
+
+    def test_gauge_set_inc_dec(self):
+        g = metrics.gauge("queue_depth")
+        g.set(7)
+        g.inc(2)
+        g.dec()
+        assert g.value == 8.0
+
+    def test_histogram_buckets_cumulative(self):
+        h = metrics.histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        bc = h.bucket_counts()
+        assert bc[0.1] == 1
+        assert bc[1.0] == 3
+        assert bc[10.0] == 4
+        assert bc[float("inf")] == 5
+        assert h.count == 5
+        assert h.sum == pytest.approx(56.05)
+
+    def test_label_sets_are_distinct_series(self):
+        metrics.counter("stage_rows_total", stage="A").inc(1)
+        metrics.counter("stage_rows_total", stage="B").inc(2)
+        assert metrics.counter("stage_rows_total", stage="A").value == 1.0
+        assert metrics.counter("stage_rows_total", stage="B").value == 2.0
+
+    def test_label_order_is_irrelevant(self):
+        metrics.counter("xy_total", a="1", b="2").inc()
+        assert metrics.counter("xy_total", b="2", a="1").value == 1.0
+
+    def test_kind_conflict_raises(self):
+        metrics.counter("dual_use")
+        with pytest.raises(ValueError):
+            metrics.gauge("dual_use")
+
+    def test_safe_variants_never_raise(self):
+        # framework instrumentation uses safe_* so a user-created family
+        # conflict degrades to a no-op instead of killing a worker thread
+        metrics.counter("clash_total").inc(3)
+        g = metrics.safe_gauge("clash_total")  # kind conflict -> NOOP
+        g.set(99)
+        assert metrics.counter("clash_total").value == 3.0
+        metrics.histogram("clash_seconds", buckets=(1.0,))
+        h = metrics.safe_histogram("clash_seconds", buckets=(2.0,))
+        h.observe(0.5)  # bucket conflict -> NOOP, observation dropped
+        assert metrics.histogram("clash_seconds").count == 0
+        # no conflict: safe_* is a plain passthrough to the registry
+        metrics.safe_counter("fine_total").inc()
+        assert metrics.counter("fine_total").value == 1.0
+
+    def test_bucket_conflict_raises(self):
+        metrics.histogram("bk_seconds", buckets=(0.1, 1.0))
+        with pytest.raises(ValueError, match="buckets"):
+            metrics.histogram("bk_seconds", buckets=(1.0, 2.0), k="v")
+        # same bounds (any order) and bucket-less lookups stay fine
+        metrics.histogram("bk_seconds", buckets=(1.0, 0.1)).observe(0.5)
+        metrics.histogram("bk_seconds").observe(0.5)
+        with pytest.raises(ValueError, match="buckets"):
+            metrics.histogram("span_default_seconds")  # default ladder
+            metrics.histogram("span_default_seconds", buckets=(9.0,))
+
+    def test_invalid_name_rejected(self):
+        for bad in ("Upper", "has-dash", "has.dot", "digits123", ""):
+            with pytest.raises(ValueError):
+                metrics.counter(bad)
+
+    def test_reset_clears_families(self):
+        metrics.counter("ephemeral_total").inc()
+        metrics.reset()
+        assert metrics.get_registry().snapshot() == {}
+
+    def test_snapshot_shape(self):
+        metrics.counter("c_total", k="v").inc(3)
+        metrics.histogram("h_seconds", buckets=(1.0,)).observe(0.5)
+        snap = metrics.get_registry().snapshot()
+        assert snap["c_total"]["type"] == "counter"
+        assert snap["c_total"]["series"][0] == {"labels": {"k": "v"},
+                                                "value": 3.0}
+        hrow = snap["h_seconds"]["series"][0]
+        assert hrow["count"] == 1 and hrow["buckets"]["1"] == 1
+        # JSON-safe (bench.py writes this next to BENCH_*.json)
+        json.dumps(snap)
+
+    def test_set_registry_swaps(self):
+        fresh = MetricsRegistry()
+        prev = metrics.set_registry(fresh)
+        try:
+            metrics.counter("swapped_total").inc()
+            assert fresh.snapshot()["swapped_total"]["series"][0]["value"] == 1
+            assert "swapped_total" not in prev.snapshot()
+        finally:
+            metrics.set_registry(prev)
+
+    def test_thread_safety_under_contention(self):
+        c = metrics.counter("contended_total")
+        h = metrics.histogram("contended_seconds")
+
+        def hammer():
+            for _ in range(1000):
+                c.inc()
+                h.observe(0.001)
+
+        threads = [threading.Thread(target=hammer) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 8000.0
+        assert h.count == 8000
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering
+# ---------------------------------------------------------------------------
+
+
+class TestPrometheusRendering:
+    def test_golden_exposition(self):
+        metrics.counter("requests_total", api="scoring", code="200").inc(3)
+        metrics.gauge("inflight").set(2)
+        metrics.histogram("latency_seconds",
+                          buckets=(0.5, 1.0)).observe(0.25)
+        text = metrics.get_registry().render_prometheus()
+        assert text == (
+            "# TYPE inflight gauge\n"
+            "inflight 2\n"
+            "# TYPE latency_seconds histogram\n"
+            'latency_seconds_bucket{le="0.5"} 1\n'
+            'latency_seconds_bucket{le="1"} 1\n'
+            'latency_seconds_bucket{le="+Inf"} 1\n'
+            "latency_seconds_sum 0.25\n"
+            "latency_seconds_count 1\n"
+            "# TYPE requests_total counter\n"
+            'requests_total{api="scoring",code="200"} 3\n'
+        )
+
+    def test_label_value_escaping(self):
+        metrics.counter("esc_total", path='a"b\\c\nd').inc()
+        text = metrics.get_registry().render_prometheus()
+        assert r'path="a\"b\\c\nd"' in text
+
+    def test_every_line_is_valid_exposition(self):
+        metrics.counter("a_total", x="1").inc()
+        metrics.gauge("b").set(-1.5)
+        metrics.histogram("c_seconds").observe(0.01)
+        line_re = re.compile(
+            r'^(# TYPE [a-z_]+ (counter|gauge|histogram)'
+            r'|[a-z_]+(\{[^{}]*\})? [^ ]+)$')
+        for line in metrics.get_registry().render_prometheus().splitlines():
+            assert line_re.match(line), line
+
+
+# ---------------------------------------------------------------------------
+# Spans + Chrome trace dump
+# ---------------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_nesting_records_parent(self):
+        with spans.span("outer"):
+            assert spans.current_span().name == "outer"
+            with spans.span("inner"):
+                assert spans.current_span().name == "inner"
+            assert spans.current_span().name == "outer"
+        assert spans.current_span() is None
+        ev = {e["name"]: e for e in spans.get_trace_events()}
+        assert ev["inner"]["args"]["parent"] == "outer"
+        assert "parent" not in ev["outer"]["args"]
+        # inner closes first and nests inside outer's window
+        assert ev["outer"]["ts"] <= ev["inner"]["ts"]
+        assert ev["inner"]["dur"] <= ev["outer"]["dur"]
+
+    def test_span_feeds_duration_histogram(self):
+        with spans.span("MyStage.uid_7", metric_label="MyStage"):
+            pass
+        h = metrics.histogram("span_duration_seconds", name="MyStage")
+        assert h.count == 1
+
+    def test_mid_span_attrs_and_exception_still_recorded(self):
+        with pytest.raises(RuntimeError):
+            with spans.span("doomed", phase="x") as sp:
+                sp.set(rows=42)
+                raise RuntimeError("boom")
+        (ev,) = spans.get_trace_events()
+        assert ev["args"]["rows"] == 42 and ev["args"]["phase"] == "x"
+
+    def test_instant_event(self):
+        spans.instant("boost_round", iteration=3)
+        (ev,) = spans.get_trace_events()
+        assert ev["ph"] == "i" and ev["args"]["iteration"] == 3
+
+    def test_span_fn_decorator(self):
+        @spans.span_fn("decorated")
+        def work(x):
+            return x * 2
+
+        assert work(21) == 42
+        assert [e["name"] for e in spans.get_trace_events()] == ["decorated"]
+
+    def test_dump_trace_chrome_format(self, tmp_path):
+        with spans.span("a"):
+            with spans.span("b"):
+                pass
+        path = spans.dump_trace(str(tmp_path / "trace.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        assert {e["name"] for e in doc["traceEvents"]} == {"a", "b"}
+        for e in doc["traceEvents"]:
+            assert e["ph"] == "X"
+            for k in ("ts", "dur", "pid", "tid", "cat"):
+                assert k in e
+        assert doc["otherData"]["dropped_events"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Pipeline instrumentation
+# ---------------------------------------------------------------------------
+
+
+class _DoubleEstimator(Estimator):
+    """Fits a trivial model that doubles column x."""
+
+    def fit(self, dataset):
+        return _DoubleModel()
+
+
+class _DoubleModel(Model):
+    def transform(self, dataset):
+        return dataset.with_column("x", np.asarray(dataset["x"]) * 2)
+
+
+class _AddOne(Transformer):
+    def transform(self, dataset):
+        return dataset.with_column("x", np.asarray(dataset["x"]) + 1)
+
+
+def _ds(n=16):
+    return Dataset({"x": np.arange(n, dtype=np.float64)})
+
+
+class TestPipelineInstrumentation:
+    def test_last_fit_report_one_entry_per_stage(self):
+        pipe = Pipeline(stages=[_AddOne(), _DoubleEstimator(), _AddOne()])
+        assert pipe.last_fit_report() == []
+        model = pipe.fit(_ds())
+        report = pipe.last_fit_report()
+        assert [r["stage"] for r in report] == \
+            ["_AddOne", "_DoubleEstimator", "_AddOne"]
+        assert [r["op"] for r in report] == \
+            ["transform", "fit+transform", "collect"]
+        for r in report:
+            assert r["seconds"] >= 0.0
+            assert r["uid"]
+        assert report[0]["rows_in"] == 16 and report[0]["rows_out"] == 16
+        # the final stage never transforms during fit: no output to count
+        assert report[-1]["rows_out"] is None
+        # the fitted model still computes the right thing
+        out = model.transform(_ds(4))
+        np.testing.assert_array_equal(out["x"], [3.0, 5.0, 7.0, 9.0])
+
+    def test_report_is_a_copy(self):
+        pipe = Pipeline(stages=[_AddOne(), _AddOne()])
+        pipe.fit(_ds())
+        pipe.last_fit_report()[0]["seconds"] = -1
+        assert pipe.last_fit_report()[0]["seconds"] >= 0.0
+
+    def test_stage_spans_and_row_counters(self):
+        pipe = Pipeline(stages=[_AddOne(), _DoubleEstimator()])
+        pipe.fit(_ds(8))
+        names = {e["name"] for e in spans.get_trace_events()}
+        assert any(n.startswith("_AddOne.") for n in names)
+        assert any(n.startswith("_DoubleEstimator.") for n in names)
+        assert metrics.counter("stage_rows_in_total", stage="_AddOne",
+                               op="transform").value == 8.0
+        assert metrics.counter("stage_rows_out_total", stage="_AddOne",
+                               op="transform").value == 8.0
+        h = metrics.histogram("span_duration_seconds", name="_AddOne")
+        assert h.count >= 1
+
+
+# ---------------------------------------------------------------------------
+# Serving: live GET /metrics round-trip
+# ---------------------------------------------------------------------------
+
+
+def _echo_transform(ds):
+    vals = ds["value"]
+    return ds.with_column(
+        "reply", [{"entity": {"y": (v or {}).get("x", 0.0)},
+                   "statusCode": 200} for v in vals])
+
+
+class TestServingMetricsEndpoint:
+    def test_get_metrics_round_trip(self):
+        from mmlspark_tpu.io.serving import serve
+
+        q = (serve().address("localhost", 0, "scoring")
+             .batch(max_batch=8, max_latency_ms=5)
+             .transform(_echo_transform).start())
+        host, port = q.server.host, q.server.port
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            for _ in range(5):
+                conn.request("POST", "/scoring", body=b'{"x": 1.0}',
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                assert resp.status == 200
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            body = resp.read().decode("utf-8")
+            conn.close()
+            assert resp.status == 200
+            assert resp.getheader("Content-Type").startswith("text/plain")
+            # request-latency histogram with buckets, per-code counters,
+            # batching telemetry — all present in one exposition
+            assert "# TYPE serving_request_seconds histogram" in body
+            assert 'serving_request_seconds_bucket{api="scoring",le="+Inf"}' \
+                in body
+            assert 'serving_responses_total{api="scoring",code="200"} 5' \
+                in body
+            assert "serving_batch_size" in body
+            assert "serving_batch_assembly_seconds" in body
+            assert "serving_queue_depth" in body
+            line_re = re.compile(
+                r'^(# TYPE [a-z_]+ (counter|gauge|histogram)'
+                r'|[a-z_]+(\{[^{}]*\})? [^ ]+)$')
+            for line in body.splitlines():
+                assert line_re.match(line), line
+        finally:
+            q.stop()
+
+    def test_disabled_metrics_releases_the_route(self):
+        # set_enabled(False) must restore exactly the uninstrumented
+        # routing: GET /metrics flows to the user's transform via the
+        # queue instead of being intercepted with a Prometheus rendering
+        from mmlspark_tpu.io.serving import serve
+
+        q = (serve().address("localhost", 0, "owner")
+             .batch(max_batch=8, max_latency_ms=5)
+             .transform(_echo_transform).start())
+        try:
+            metrics.set_enabled(False)
+            conn = http.client.HTTPConnection(q.server.host, q.server.port,
+                                              timeout=10)
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            body = resp.read().decode("utf-8")
+            conn.close()
+            assert resp.status == 200
+            assert not body.startswith("# TYPE")
+            assert json.loads(body) == {"y": 0.0}  # the echo transform's reply
+        finally:
+            metrics.set_enabled(True)
+            q.stop()
+
+    def test_user_metric_family_conflict_does_not_break_serving(self):
+        # the exact hazard: user code registers a built-in serving metric
+        # name first with a different shape; the worker's safe_* lookup
+        # must degrade to a no-op, not raise and kill the batching thread
+        from mmlspark_tpu.io.serving import serve
+
+        metrics.histogram("serving_batch_size", api="hijack")  # default ladder
+        metrics.counter("serving_transform_seconds")           # kind clash
+        q = (serve().address("localhost", 0, "resilient")
+             .batch(max_batch=8, max_latency_ms=5)
+             .transform(_echo_transform).start())
+        try:
+            conn = http.client.HTTPConnection(q.server.host, q.server.port,
+                                              timeout=10)
+            for _ in range(3):
+                conn.request("POST", "/resilient", body=b'{"x": 2.0}',
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                body = json.loads(resp.read())
+                assert resp.status == 200 and body == {"y": 2.0}
+            conn.close()
+        finally:
+            q.stop()
+
+    def test_inflight_gauge_survives_mid_request_toggle(self):
+        # disabling telemetry while a request is parked on done.wait()
+        # must not orphan the inc() — inc/dec go through the same object
+        from mmlspark_tpu.io.serving import ServingServer
+
+        server = ServingServer("localhost", 0, api_name="toggling",
+                               request_timeout=0.3).start()
+        try:
+            conn = http.client.HTTPConnection(server.host, server.port,
+                                              timeout=10)
+            # nobody drains the queue, so the handler parks then 504s;
+            # flip the kill switch while it is parked
+            done = threading.Event()
+
+            def _post():
+                conn.request("POST", "/toggling", body=b"{}")
+                resp = conn.getresponse()
+                resp.read()
+                assert resp.status == 504
+                done.set()
+
+            t = threading.Thread(target=_post, daemon=True)
+            t.start()
+            time.sleep(0.1)
+            metrics.set_enabled(False)
+            assert done.wait(10)
+            t.join(10)
+            conn.close()
+            metrics.set_enabled(True)
+            g = metrics.gauge("serving_inflight_requests", api="toggling")
+            assert g.value == 0.0
+        finally:
+            metrics.set_enabled(True)
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Degradation: disabled telemetry and failing profiler
+# ---------------------------------------------------------------------------
+
+
+class TestDisabledDegradation:
+    def test_disabled_results_byte_identical_and_registry_untouched(self):
+        pipe = Pipeline(stages=[_AddOne(), _DoubleEstimator(), _AddOne()])
+        enabled_out = pipe.fit(_ds()).transform(_ds())
+
+        metrics.reset()
+        spans.clear_trace()
+        metrics.set_enabled(False)
+        disabled_out = pipe.fit(_ds()).transform(_ds())
+
+        assert np.asarray(enabled_out["x"]).tobytes() == \
+            np.asarray(disabled_out["x"]).tobytes()
+        assert metrics.get_registry().snapshot() == {}
+        assert spans.get_trace_events() == []
+        # fit report still works: it is a product feature, not telemetry
+        assert len(pipe.last_fit_report()) == 3
+
+    def test_disabled_helpers_return_noops(self):
+        metrics.set_enabled(False)
+        c = metrics.counter("ignored_total")
+        c.inc(100)
+        assert c.value == 0.0
+        metrics.gauge("ignored").set(5)
+        metrics.histogram("ignored_seconds").observe(1.0)
+        with spans.span("ignored") as sp:
+            sp.set(anything="goes")
+        spans.instant("ignored")
+        assert metrics.get_registry().snapshot() == {}
+        assert spans.get_trace_events() == []
+
+    def test_device_memory_gauges_disabled(self):
+        from mmlspark_tpu.observability import device_memory_gauges
+        metrics.set_enabled(False)
+        assert device_memory_gauges() == {}
+        assert metrics.get_registry().snapshot() == {}
+
+    def test_device_memory_gauges_enabled_samples(self):
+        from mmlspark_tpu.observability import device_memory_gauges
+        stats = device_memory_gauges()
+        # CPU devices exist under the forced host platform; whether they
+        # expose memory stats is backend-dependent — the call must succeed
+        # either way and return the raw dict
+        assert isinstance(stats, dict) and len(stats) >= 1
+
+
+class TestProfilerFailureDegradation:
+    def test_span_survives_failing_annotation(self, monkeypatch):
+        import jax
+
+        class Exploding:
+            def __init__(self, name):
+                raise RuntimeError("profiler unavailable")
+
+        monkeypatch.setattr(jax.profiler, "TraceAnnotation", Exploding)
+        with spans.span("still_works"):
+            pass
+        assert [e["name"] for e in spans.get_trace_events()] == \
+            ["still_works"]
+        assert metrics.histogram("span_duration_seconds",
+                                 name="still_works").count == 1
+
+    def test_annotate_noop_on_failure(self, monkeypatch):
+        import jax
+        from mmlspark_tpu.utils import profiling
+
+        class Exploding:
+            def __init__(self, name):
+                raise RuntimeError("no profiler")
+
+        monkeypatch.setattr(jax.profiler, "TraceAnnotation", Exploding)
+        ran = []
+        with profiling.annotate("x"):
+            ran.append(True)
+        assert ran == [True]
+
+    def test_trace_noop_on_failure(self, monkeypatch, tmp_path):
+        import jax
+        from mmlspark_tpu.utils import profiling
+
+        def explode(*a, **k):
+            raise RuntimeError("no profiler")
+
+        monkeypatch.setattr(jax.profiler, "start_trace", explode)
+        ran = []
+        with profiling.trace(str(tmp_path)):
+            ran.append(True)
+        assert ran == [True]
+
+    def test_pipeline_fit_survives_failing_profiler(self, monkeypatch):
+        import jax
+
+        class Exploding:
+            def __init__(self, name):
+                raise RuntimeError("profiler unavailable")
+
+        monkeypatch.setattr(jax.profiler, "TraceAnnotation", Exploding)
+        pipe = Pipeline(stages=[_AddOne(), _DoubleEstimator()])
+        model = pipe.fit(_ds(4))
+        out = model.transform(_ds(4))
+        np.testing.assert_array_equal(out["x"], [2.0, 4.0, 6.0, 8.0])
+        assert len(pipe.last_fit_report()) == 2

@@ -138,6 +138,31 @@ class TestExactTreeSHAP:
         with pytest.raises(ValueError, match="saabas"):
             b.predict_contrib(X[:5], method="treeshap")
 
+    def test_out_of_range_split_feature_raises(self):
+        # internal-node feat outside [0, F) must fail loudly in the
+        # SHARED pre-dispatch validation: numpy would wrap feat=-1 to the
+        # last phi column / write feat==F into the expected-value column
+        # — silently corrupted attributions (uses a golden import so the
+        # check runs without TPU training)
+        import os
+        import pytest
+
+        from mmlspark_tpu.models.gbdt.booster import Booster
+        path = os.path.join(os.path.dirname(__file__), "resources",
+                            "lgbm_golden", "binary", "model.txt")
+        with open(path) as f:
+            b = Booster.from_lightgbm_string(f.read())
+        feat = np.asarray(b.trees.feat)
+        is_leaf = np.asarray(b.trees.is_leaf)
+        X = np.zeros((3, int(feat.max()) + 1), dtype=np.float32)
+        j = int(np.argwhere(~is_leaf[0].astype(bool))[0][0])
+        for bad_val in (-1, X.shape[1]):
+            bad = feat.copy()
+            bad[0, j] = bad_val
+            b.trees = b.trees._replace(feat=bad)
+            with pytest.raises(ValueError, match="split feature"):
+                b.predict_contrib(X, method="treeshap")
+
     def test_deep_chain_tree_no_recursion_limit(self):
         # leafwise growth on monotone data makes chain-shaped trees with
         # depth ~ num_leaves; the explicit-stack DFS must handle depth well

@@ -23,23 +23,31 @@ while true; do
   if probe; then
     echo "$(date +%T) relay UP - firing playbook" >> /tmp/relay_watch.log
     ts=$(date +%H%M%S)
+    # Each leg captures its bench process's own exit status into rc
+    # IMMEDIATELY after the timeout command: the marker-gating chains
+    # below it overwrite $?, so logging $? there reported the last
+    # grep/touch status instead of why the capture actually ended.
     if [ ! -f /tmp/relay_captures/treeshap.done ]; then
       timeout 1500 "$PYBIN" tools/tpu_treeshap_bench.py quick \
-        > "docs/tpu_capture_r05/auto/treeshap_$ts.jsonl" 2>> /tmp/relay_watch.log \
-        && touch /tmp/relay_captures/treeshap.done
-      echo "$(date +%T) treeshap exited rc=$?" >> /tmp/relay_watch.log
+        > "docs/tpu_capture_r05/auto/treeshap_$ts.jsonl" 2>> /tmp/relay_watch.log
+      rc=$?
+      [ "$rc" -eq 0 ] && touch /tmp/relay_captures/treeshap.done
+      echo "$(date +%T) treeshap exited rc=$rc" >> /tmp/relay_watch.log
     elif [ ! -f /tmp/relay_captures/bench_full.done ]; then
       GRAFT_BENCH_LEG=tpu timeout 2700 "$PYBIN" bench.py \
-        > "docs/tpu_capture_r05/auto/bench_tpu_leg_$ts.jsonl" 2>> /tmp/relay_watch.log \
+        > "docs/tpu_capture_r05/auto/bench_tpu_leg_$ts.jsonl" 2>> /tmp/relay_watch.log
+      rc=$?
+      [ "$rc" -eq 0 ] \
         && grep -q '"partial"' "docs/tpu_capture_r05/auto/bench_tpu_leg_$ts.jsonl" \
         && ! tail -1 "docs/tpu_capture_r05/auto/bench_tpu_leg_$ts.jsonl" | grep -q '"partial"' \
         && touch /tmp/relay_captures/bench_full.done
-      echo "$(date +%T) bench_full leg exited rc=$?" >> /tmp/relay_watch.log
+      echo "$(date +%T) bench_full leg exited rc=$rc" >> /tmp/relay_watch.log
     elif [ ! -f /tmp/relay_captures/micro_full.done ]; then
       timeout 1800 "$PYBIN" tools/tpu_microbench.py \
-        > "docs/tpu_capture_r05/auto/micro_full_$ts.jsonl" 2>> /tmp/relay_watch.log \
-        && touch /tmp/relay_captures/micro_full.done
-      echo "$(date +%T) micro_full exited rc=$?" >> /tmp/relay_watch.log
+        > "docs/tpu_capture_r05/auto/micro_full_$ts.jsonl" 2>> /tmp/relay_watch.log
+      rc=$?
+      [ "$rc" -eq 0 ] && touch /tmp/relay_captures/micro_full.done
+      echo "$(date +%T) micro_full exited rc=$rc" >> /tmp/relay_watch.log
     fi
   else
     echo "$(date +%T) relay down" >> /tmp/relay_watch.log
