@@ -244,6 +244,13 @@ class ServingServer:
         try:
             out.append(self._queue.get(timeout=max_latency))
         except queue.Empty:
+            # idle poll: no batch was assembled, but the depth gauge must
+            # still track reality — without this, a service that drains to
+            # empty keeps exporting the LAST busy depth forever (the
+            # assembly histogram correctly stays untouched: there was no
+            # assembly)
+            _metrics.safe_gauge("serving_queue_depth",
+                                api=self.api_name).set(self._queue.qsize())
             return out
         t_first = time.monotonic()
         if eager:

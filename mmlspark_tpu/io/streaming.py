@@ -28,10 +28,53 @@ from typing import Callable, List, Optional, Union
 import numpy as np
 
 from ..models.gbdt.ingest import PathLike, ShardedMatrixSource
+from .prefetch import iter_prefetched
 
 
 def _as_source(source) -> ShardedMatrixSource:
     return ShardedMatrixSource.coerce(source)
+
+
+class _ChunkAccumulator:
+    """Collects per-chunk outputs into ONE preallocated ``[total, ...]``
+    buffer when chunk outputs are row-aligned with their inputs (the
+    documented ``fn`` contract) — peak host memory is the output buffer
+    plus a single chunk, instead of every chunk PLUS their concatenated
+    copy (which doubled the peak on large streamed scores). A chunk whose
+    output rows/shape/dtype don't line up demotes gracefully to the old
+    accumulate-then-concatenate behavior."""
+
+    def __init__(self, total_rows: int):
+        self.total = total_rows
+        self.buf: Optional[np.ndarray] = None
+        self.filled = 0
+        self.outs: List[np.ndarray] = []
+
+    def add(self, out: np.ndarray, rows_in: int) -> None:
+        aligned = (not self.outs and out.shape[0] == rows_in
+                   and (self.buf is None
+                        or (out.shape[1:] == self.buf.shape[1:]
+                            and out.dtype == self.buf.dtype)))
+        if aligned:
+            if self.buf is None:
+                self.buf = np.empty((self.total,) + out.shape[1:],
+                                    out.dtype)
+            self.buf[self.filled:self.filled + out.shape[0]] = out
+            self.filled += out.shape[0]
+        else:
+            if self.buf is not None:
+                # copy, don't view: a view would pin the full [total, ...]
+                # preallocation for the rest of the (now list-based) run
+                self.outs.append(self.buf[:self.filled].copy())
+                self.buf = None
+            self.outs.append(out)
+
+    def result(self) -> np.ndarray:
+        if self.buf is not None:
+            return (self.buf if self.filled == self.total
+                    else self.buf[:self.filled])
+        return (np.concatenate(self.outs, axis=0) if self.outs
+                else np.zeros((0,), np.float32))
 
 
 def stream_apply(source: Union[PathLike, ShardedMatrixSource],
@@ -40,19 +83,26 @@ def stream_apply(source: Union[PathLike, ShardedMatrixSource],
                  out_dir: Optional[PathLike] = None,
                  prefix: str = "part") -> Union[np.ndarray, List[str]]:
     """Apply ``fn(chunk [m, ...]) -> [m, ...]`` over a sharded source in
-    bounded row chunks (offset reads — one chunk resident at a time).
+    bounded row chunks.
+
+    Chunk i+1 is read on a background thread while ``fn`` scores chunk i
+    (double-buffered — at most two chunks resident; see
+    :mod:`mmlspark_tpu.io.prefetch`, kill switch
+    ``MMLSPARK_TPU_DISABLE_PREFETCH=1``). ``fn`` itself always runs on
+    the calling thread in chunk order, so outputs are bit-identical with
+    prefetch on or off.
 
     With ``out_dir`` each chunk's output is written as one ``.npy`` shard
     (a valid source for further streamed stages) and the shard paths are
-    returned; without it, outputs are concatenated — appropriate when the
-    output is much smaller than the input (e.g. ``[n]`` scores from
-    ``[n, F]`` features).
+    returned; without it, outputs land in one preallocated result array —
+    appropriate when the output is much smaller than the input (e.g.
+    ``[n]`` scores from ``[n, F]`` features).
     """
     if chunk_rows <= 0:
         raise ValueError(f"chunk_rows must be positive, got {chunk_rows}")
     src = _as_source(source)
     paths: List[str] = []
-    outs: List[np.ndarray] = []
+    acc = _ChunkAccumulator(src.n)
     if out_dir is not None:
         out_dir = os.fspath(out_dir)
         src_dirs = {os.path.realpath(os.path.dirname(p))
@@ -67,18 +117,29 @@ def stream_apply(source: Union[PathLike, ShardedMatrixSource],
             # a previous run's shards must not mix into this run's output
             if stale.startswith(f"{prefix}-") and stale.endswith(".npy"):
                 os.unlink(os.path.join(out_dir, stale))
-    for i, lo in enumerate(range(0, src.n, chunk_rows)):
-        out = np.asarray(fn(src.read(lo, min(lo + chunk_rows, src.n))))
+    bounds = [(lo, min(lo + chunk_rows, src.n))
+              for lo in range(0, src.n, chunk_rows)]
+
+    def _score(chunk: np.ndarray) -> np.ndarray:
+        # the ONLY host materialization of fn's output: keeps np.asarray
+        # (a potential device sync) out of the per-chunk loop body, where
+        # tests/test_lint.py guards against accidental host syncs
+        return np.asarray(fn(chunk))
+
+    def _emit(i: int, out: np.ndarray) -> None:
         if out_dir is not None:
             p = os.path.join(out_dir, f"{prefix}-{i:05d}.npy")
             np.save(p, out)
             paths.append(p)
         else:
-            outs.append(out)
+            acc.add(out, bounds[i][1] - bounds[i][0])
+
+    reads = ((lambda lo=lo, hi=hi: src.read(lo, hi)) for lo, hi in bounds)
+    for i, chunk in enumerate(iter_prefetched(reads, site="stream_apply")):
+        _emit(i, _score(chunk))
     if out_dir is not None:
         return paths
-    return (np.concatenate(outs, axis=0) if outs
-            else np.zeros((0,), np.float32))
+    return acc.result()
 
 
 def stream_transform(stage, source: Union[PathLike, ShardedMatrixSource], *,
@@ -117,7 +178,11 @@ def stream_featurize_images(featurizer, image_dir: str, *,
     """ImageFeaturizer over a DIRECTORY of encoded images, never holding
     more than ``batch_files`` decoded images: files stream through the host
     decoder (reference: BinaryFileReader.scala:20 / ImageReader) in bounded
-    batches, each batch rides the featurizer's device path.
+    batches, each batch rides the featurizer's device path. Batch i+1 is
+    read AND decoded on the prefetch thread while the featurizer scores
+    batch i (double-buffered; ``MMLSPARK_TPU_DISABLE_PREFETCH=1`` restores
+    the sequential loop) — host decode is the dominant cost at this stage,
+    so the overlap hides it behind device compute.
 
     Returns ``(paths, features)`` — or ``(paths, shard_paths)`` with
     ``out_dir``. Undecodable files are skipped (dropNa semantics) and do
@@ -138,11 +203,15 @@ def stream_featurize_images(featurizer, image_dir: str, *,
         out_dir = os.fspath(out_dir)
         os.makedirs(out_dir, exist_ok=True)
 
-    def flush(batch, idx):
-        if not batch:
-            return
-        paths_b = [p for p, _ in batch]
-        imgs = [decode_image(b) for _, b in batch]
+    def load(files):
+        # runs on the prefetch thread: disk read + host decode, the two
+        # phases worth overlapping with the featurizer's device batch
+        batch = [read_binary_file(f) for f in files]
+        return ([p for p, _ in batch],
+                [decode_image(b) for _, b in batch])
+
+    def flush(loaded, idx):
+        paths_b, imgs = loaded
         ds = Dataset({"_img": imgs, "_path": np.asarray(paths_b)})
         scored = featurizer.transform(ds)
         if len(scored) == 0:
@@ -156,18 +225,26 @@ def stream_featurize_images(featurizer, image_dir: str, *,
         else:
             feats.append(block)
 
-    # lazy file walk (read_binary_files materializes every blob up front —
-    # exactly what streaming must avoid); zip members are not expanded here
-    rng = np.random.default_rng(seed)
-    batch, idx = [], 0
-    for f in _iter_files(image_dir, recursive):
-        if sample_ratio < 1.0 and rng.random() >= sample_ratio:
-            continue
-        batch.append(read_binary_file(f))
-        if len(batch) >= batch_files:
-            flush(batch, idx)
-            batch, idx = [], idx + 1
-    flush(batch, idx)
+    def file_batches():
+        # lazy file walk (read_binary_files materializes every blob up
+        # front — exactly what streaming must avoid); zip members are not
+        # expanded here. The rng draw stays on the calling thread so the
+        # sampled file set is independent of prefetch.
+        rng = np.random.default_rng(seed)
+        files: List[str] = []
+        for f in _iter_files(image_dir, recursive):
+            if sample_ratio < 1.0 and rng.random() >= sample_ratio:
+                continue
+            files.append(f)
+            if len(files) >= batch_files:
+                yield (lambda fs=files: load(fs))
+                files = []
+        if files:
+            yield (lambda fs=files: load(fs))
+
+    for idx, loaded in enumerate(
+            iter_prefetched(file_batches(), site="featurize_images")):
+        flush(loaded, idx)
     if out_dir is not None:
         return kept_paths, shard_paths
     return kept_paths, (np.concatenate(feats, axis=0) if feats

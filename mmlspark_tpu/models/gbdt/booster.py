@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
@@ -26,13 +27,15 @@ from jax import lax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ...observability import metrics as _metrics
+from ...observability import spans as _spans
 from ...ops.binning import QuantileBinner, bin_cols_device
 from ...parallel import mesh as meshlib
 from .growth import (GrowConfig, Tree, bitset_words, grow_tree,
                      grow_tree_depthwise, predict_forest_raw,
                      predict_tree_binned)
 from .objectives import (HIGHER_IS_BETTER, Objective, eval_metric,
-                         get_objective)
+                         get_objective, score_transform)
 
 
 # bounded LRU of compiled boosting steps: one executable per
@@ -104,13 +107,22 @@ def pack_trees(trees: Tree) -> jnp.ndarray:
     return jnp.concatenate(parts)
 
 
+def _tree_field_shape(name: str, lead: Tuple[int, ...], M: int,
+                      BW: int) -> Tuple[int, ...]:
+    """THE single source of truth for the packed-buffer field layout:
+    per-tree ``[M, BW]`` for the category bitsets, scalar for node_count,
+    ``[M]`` for every other field — shared by the host and device
+    unpackers so the wire layout cannot drift between them."""
+    return lead + ((M, BW) if name == "cat_bitset"
+                   else () if name == "node_count" else (M,))
+
+
 def unpack_trees(flat: np.ndarray, lead: Tuple[int, ...], M: int,
                  BW: int) -> Tree:
     """Inverse of :func:`pack_trees`: trees with leading dims ``lead``."""
     fields, off = {}, 0
     for name in Tree._fields:
-        shape = lead + ((M, BW) if name == "cat_bitset"
-                        else () if name == "node_count" else (M,))
+        shape = _tree_field_shape(name, lead, M, BW)
         size = int(np.prod(shape, dtype=np.int64)) if shape else 1
         seg = np.ascontiguousarray(flat[off:off + size])
         off += size
@@ -124,6 +136,155 @@ def unpack_trees(flat: np.ndarray, lead: Tuple[int, ...], M: int,
         f"unpack_trees: buffer has {flat.size} elements, layout expects "
         f"{off} — num_leaves/num_bins mismatch between pack and unpack")
     return Tree(**fields)
+
+
+# --- device-resident inference hot path -------------------------------------
+# The fused predictor: ONE compiled program evaluates the forest, sums the
+# per-class tree outputs, adds the base score, and (for predict()) applies
+# the objective transform — so a scoring call downloads only [n, K] instead
+# of [T, n] + a host tile/loop + a re-upload for the transform. Packed trees
+# ride as ARGUMENTS (never jit constants), which makes the executables
+# shareable process-wide: any Booster with the same shape key — including
+# one just unpickled in a serving worker, or a num_iteration sweep — hits
+# the same compiled program.
+
+
+def _pow2_ceil(v: int) -> int:
+    """Smallest power of two >= max(1, v)."""
+    return 1 << (max(1, int(v)) - 1).bit_length()
+
+
+def _pack_trees_host(trees: Tree, t_end: int) -> np.ndarray:
+    """Host-side mirror of :func:`pack_trees`: flatten the first ``t_end``
+    trees into ONE int32 buffer (bools widened, float/uint bits riding
+    bitcast) so the forest upload is a single host->device transfer and the
+    executable's tree argument is one flat array."""
+    parts = []
+    for name, arr in zip(Tree._fields, trees):
+        a = np.asarray(arr)[:t_end].astype(_TREE_FIELD_DTYPES[name],
+                                           copy=False)
+        if a.dtype == np.bool_:
+            a = a.astype(np.int32)
+        elif a.dtype != np.int32:
+            a = np.ascontiguousarray(a).view(np.int32)
+        parts.append(np.ascontiguousarray(a).reshape(-1))
+    return np.concatenate(parts)
+
+
+def _unpack_trees_device(flat: jnp.ndarray, T: int, M: int, BW: int) -> Tree:
+    """Device-side inverse of :func:`_pack_trees_host` (static slicing —
+    traces into pure reshapes/bitcasts, no data movement). Field order,
+    shapes and bitcast rules are shared with the host pack/unpack pair
+    (``Tree._fields`` / :func:`_tree_field_shape` /
+    ``_TREE_FIELD_DTYPES``)."""
+    fields, off = {}, 0
+    for name in Tree._fields:
+        shape = _tree_field_shape(name, (T,), M, BW)
+        size = int(np.prod(shape, dtype=np.int64))
+        seg = flat[off:off + size]
+        off += size
+        dt = _TREE_FIELD_DTYPES[name]
+        if dt == np.bool_:
+            seg = seg.astype(jnp.bool_)
+        elif dt != np.int32:
+            seg = lax.bitcast_convert_type(seg, jnp.dtype(dt))
+        fields[name] = seg.reshape(shape)
+    return Tree(**fields)
+
+
+def _to_device(x):
+    """The predict hot path's ONLY host->device transfer funnel — tests
+    shim this to assert exactly one upload per scoring call."""
+    return jnp.asarray(x)
+
+
+def _from_device(x) -> np.ndarray:
+    """The predict hot path's ONLY device->host transfer funnel — tests
+    shim this to assert exactly one download per scoring call."""
+    return np.asarray(x)
+
+
+# process-wide fused-predictor executable cache. Keyed on shape/config only
+# (tree bucket, batch bucket, num_class, transform...), NEVER on a Booster
+# instance: a serving worker that unpickles a model, or a sweep re-scoring
+# at many num_iteration values, reuses compiled executables instead of
+# recompiling per object.
+_PREDICT_CACHE: "OrderedDict" = OrderedDict()
+_PREDICT_CACHE_MAX = 64
+_PREDICT_CACHE_LOCK = threading.Lock()
+
+
+def _predict_program(key, build):
+    """Get-or-build in the bounded process-wide predictor cache, counting
+    hits/misses (``gbdt_predict_cache_{hits,misses}_total``)."""
+    with _PREDICT_CACHE_LOCK:
+        fn = _PREDICT_CACHE.get(key)
+        if fn is not None:
+            _PREDICT_CACHE.move_to_end(key)
+    if fn is None:
+        _metrics.safe_counter("gbdt_predict_cache_misses_total").inc()
+        with _spans.span("gbdt_predict_build"):
+            fn = build()
+        with _PREDICT_CACHE_LOCK:
+            fn = _PREDICT_CACHE.setdefault(key, fn)
+            _PREDICT_CACHE.move_to_end(key)
+            while len(_PREDICT_CACHE) > _PREDICT_CACHE_MAX:
+                _PREDICT_CACHE.popitem(last=False)
+    else:
+        _metrics.safe_counter("gbdt_predict_cache_hits_total").inc()
+    return fn
+
+
+def _freeze_kwargs(kwargs: dict):
+    """Hashable rendering of objective kwargs for the executable-cache
+    key. JSON round-trips turn tuples into lists (e.g. a ranker's
+    label_gain), which would make the key unhashable — values are frozen
+    structurally, never passed back to the objective (the builder uses
+    the booster's own kwargs for that)."""
+    def freeze(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(freeze(x) for x in v)
+        if isinstance(v, dict):
+            return tuple(sorted((k, freeze(x)) for k, x in v.items()))
+        if isinstance(v, np.ndarray):
+            return ("ndarray", v.dtype.str, v.shape, v.tobytes())
+        return v
+    return tuple(sorted((k, freeze(v)) for k, v in kwargs.items()))
+
+
+def _build_predict_program(T_pad: int, M: int, BW: int, depth_cap: int,
+                           K: int, cat_max_bin: int, transform):
+    """Build the fused device-resident scoring program.
+
+    ``run(packed, thr, base, active, is_cat, mdec, X)`` evaluates all
+    ``T_pad`` trees, masks out trees past ``t_end`` via ``active`` (so one
+    executable serves every t_end inside the bucket), reduces per class,
+    adds the base score and — when ``transform`` (a traceable raw->
+    prediction function, see ``objectives.score_transform``) is set —
+    applies the objective transform, all inside ONE jitted program.
+    ``is_cat`` / ``mdec`` are passed as ``None`` when absent (the key
+    distinguishes those variants)."""
+
+    def run(packed, thr, base, active, is_cat, mdec, X):
+        trees = _unpack_trees_device(packed, T_pad, M, BW)
+        leaf = predict_forest_raw(trees, thr, X, depth_cap, is_cat=is_cat,
+                                  cat_max_bin=cat_max_bin,
+                                  missing_dec=mdec)            # [T_pad, n]
+        masked = leaf * active[:, None]
+        if T_pad % K == 0:
+            # tree t scores class t % K: [T_pad/K, K, n] groups each
+            # class's trees in one reshaped axis — same mapping as the
+            # old host loop's per_tree[k::K].sum(0)
+            per_class = masked.reshape(T_pad // K, K,
+                                       masked.shape[1]).sum(axis=0)
+        else:                       # defensive: partial final iteration
+            onehot = jax.nn.one_hot(jnp.arange(T_pad) % K, K,
+                                    dtype=masked.dtype)
+            per_class = jnp.einsum("tk,tn->kn", onehot, masked)
+        raw = per_class.T + base[None, :]                      # [n, K]
+        return raw if transform is None else transform(raw)
+
+    return jax.jit(run)
 
 
 # --- device-side synthesis of row-shaped defaults ---------------------------
@@ -362,7 +523,6 @@ class Booster:
         self.binner_state = binner_state
         self.best_iteration = int(best_iteration)
         self.eval_history = eval_history or {}
-        self._predict_fn = None
         # Per-node LightGBM decision_type bytes [T, M] (missing-value
         # routing: bit 1 default-left, bits 2-3 missing type), set only by
         # the native-model import path. None = the framework's own training
@@ -380,10 +540,15 @@ class Booster:
         return self.num_trees // self.num_class
 
     def __getstate__(self):
-        # compiled-predictor cache holds jitted closures: rebuilt on demand,
-        # never pickled (stage persistence pickles fitted models whole)
+        # device-resident predictor state (uploaded tree buffers, active
+        # masks) is rebuilt on demand and never pickled; the COMPILED
+        # executables live in the process-wide _PREDICT_CACHE keyed by
+        # shape, so an unpickled model in a serving worker reuses them
+        # without recompiling
         d = dict(self.__dict__)
-        d["_predict_fn"] = None
+        d.pop("_dev_forest", None)
+        d.pop("_dev_active", None)
+        d.pop("_predict_fn", None)    # legacy per-instance jit cache
         return d
 
     def _obj(self) -> Objective:
@@ -412,71 +577,120 @@ class Booster:
         m[np.asarray(cats, dtype=int)] = True
         return jnp.asarray(m)
 
-    def _forest_eval(self, t_end: int):
-        """Persistent compiled forest evaluator for the first ``t_end`` trees.
+    def _tree_bucket(self, t_end: int) -> int:
+        """Tree-count bucket for the executable cache: the full model keeps
+        its exact shape (the serving hot path must not pay padded-forest
+        compute), partial t_end — num_iteration sweeps, best_iteration
+        scoring — rounds the iteration count up to a power of two so a
+        sweep hits log2 executables instead of one per value. Trees past
+        ``t_end`` inside the bucket are masked by the ``active`` argument,
+        so bucketing never changes results."""
+        T_full = self.num_trees
+        if t_end >= T_full:
+            return T_full
+        bucket = self.num_class * _pow2_ceil(t_end // self.num_class)
+        return T_full if bucket >= T_full else bucket
 
-        The forest rides as jit constants (device-resident after the first
-        call); callers bucket row counts so repeat scoring — the serving
-        hot path — is one cached executable dispatch, not a fresh trace +
-        forest re-upload per request (the reference keeps one loaded native
-        booster per executor the same way, LightGBMBooster.scala:186-249).
-        """
-        if self._predict_fn is None:
-            self._predict_fn = OrderedDict()
-        fn = self._predict_fn.get(t_end)
-        if fn is None:
-            trees = jax.tree_util.tree_map(
-                lambda a: jnp.asarray(np.asarray(a)[:t_end]), self.trees)
-            thr = jnp.asarray(self.thr_raw[:t_end])
-            depth_cap = self.depth_cap
+    def _device_forest_args(self, T_pad: int):
+        """Device-RESIDENT forest arguments for the first ``T_pad`` trees:
+        (packed trees, thresholds, base score, categorical mask, missing
+        decisions) — uploaded once per bucket, cached on the instance
+        (dropped by ``__getstate__``), and passed as jit ARGUMENTS so the
+        compiled program itself stays model-independent."""
+        cache = self.__dict__.setdefault("_dev_forest", OrderedDict())
+        ent = cache.get(T_pad)
+        if ent is None:
+            packed = _pack_trees_host(self.trees, T_pad)
+            thr = np.ascontiguousarray(
+                np.asarray(self.thr_raw, np.float32)[:T_pad])
             is_cat = self._is_cat()
-            cat_max_bin = self.binner_state.get("max_bin") or 0
             mdec = (None if self.missing_dec is None
-                    else jnp.asarray(self.missing_dec[:t_end]))
-            fn = jax.jit(lambda X: predict_forest_raw(
-                trees, thr, X, depth_cap, is_cat=is_cat,
-                cat_max_bin=cat_max_bin, missing_dec=mdec))
-            # keyed by t_end: services alternate full-model and
-            # best_iteration scoring; both must stay cached executables.
-            # Bounded LRU: each entry pins a device tree-slice, so a
+                    else jnp.asarray(
+                        np.ascontiguousarray(self.missing_dec[:T_pad])))
+            ent = (jnp.asarray(packed), jnp.asarray(thr),
+                   jnp.asarray(self.base_score), is_cat, mdec)
+            # bounded LRU: each entry pins a device tree buffer, so a
             # learning-curve sweep over every t_end must not pin O(T^2)
-            self._predict_fn[t_end] = fn
-            while len(self._predict_fn) > 4:
-                self._predict_fn.popitem(last=False)
+            cache[T_pad] = ent
+            while len(cache) > 4:
+                cache.popitem(last=False)
         else:
-            self._predict_fn.move_to_end(t_end)
-        return fn
+            cache.move_to_end(T_pad)
+        return ent
 
-    def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
-        """Raw margin scores: [n, num_class] (num_class=1 for binary/regression)."""
+    def _device_active(self, T_pad: int, t_end: int):
+        """[T_pad] f32 device mask selecting trees below ``t_end``."""
+        cache = self.__dict__.setdefault("_dev_active", OrderedDict())
+        key = (T_pad, t_end)
+        a = cache.get(key)
+        if a is None:
+            a = jnp.asarray((np.arange(T_pad) < t_end)
+                            .astype(np.float32))
+            cache[key] = a
+            while len(cache) > 8:
+                cache.popitem(last=False)
+        else:
+            cache.move_to_end(key)
+        return a
+
+    def _predict_device(self, X: np.ndarray, num_iteration: int,
+                        transformed: bool) -> np.ndarray:
+        """Shared device-resident scoring driver for predict/predict_raw.
+
+        Steady state (device args warm) a call is exactly ONE host->device
+        transfer (the feature batch, via :func:`_to_device`) and ONE
+        device->host transfer (the ``[n, K]`` result, via
+        :func:`_from_device`): tree-sum, base-score add and the objective
+        transform are fused into the cached executable.
+        """
         X = np.asarray(X, dtype=np.float32)
         if num_iteration is None or num_iteration < 0:
             num_iteration = self.num_iterations
-        t_end = num_iteration * self.num_class
+        t_end = min(num_iteration * self.num_class, self.num_trees)
         n = X.shape[0]
         # power-of-two row bucket for SMALL batches only: serving's varying
-        # micro-batch sizes hit log2 cached executables instead of one trace
-        # per size. Large batch scoring keeps its exact shape — padding
-        # 600k rows to 1M would waste up to 2x forest compute.
+        # micro-batch sizes hit log2 cached executables instead of one
+        # trace per size. Large batch scoring keeps its exact shape —
+        # padding 600k rows to 1M would waste up to 2x forest compute.
         if 0 < n <= 8192:
             n_pad = 1 << (n - 1).bit_length()
         else:
             n_pad = max(n, 1)
+        T_pad = self._tree_bucket(t_end)
+        M = int(np.asarray(self.trees.feat).shape[1])
+        BW = int(np.asarray(self.trees.cat_bitset).shape[-1])
+        cat_max_bin = int(self.binner_state.get("max_bin") or 0)
+        spec_key = transform = None
+        if transformed:
+            spec_key = (self.objective, self.num_class,
+                        _freeze_kwargs(self.objective_kwargs))
+            transform = score_transform(self.objective, self.num_class,
+                                        **self.objective_kwargs)
+        packed, thr, base, is_cat, mdec = self._device_forest_args(T_pad)
+        active = self._device_active(T_pad, t_end)
+        key = (T_pad, M, BW, n_pad, X.shape[1], self.num_class,
+               self.depth_cap, cat_max_bin, is_cat is not None,
+               mdec is not None, spec_key)
+        fn = _predict_program(key, lambda: _build_predict_program(
+            T_pad, M, BW, self.depth_cap, self.num_class, cat_max_bin,
+            transform))
         Xp = np.pad(X, ((0, n_pad - n), (0, 0))) if n_pad != n else X
-        per_tree = np.asarray(
-            self._forest_eval(t_end)(jnp.asarray(Xp)))[:, :n]  # [T, n]
-        out = np.tile(self.base_score[None, :], (n, 1)).astype(np.float32)
-        for k in range(self.num_class):
-            out[:, k] += per_tree[k::self.num_class].sum(axis=0)
-        return out
+        out = fn(packed, thr, base, active, is_cat, mdec, _to_device(Xp))
+        return _from_device(out)[:n]
+
+    def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        """Raw margin scores: [n, num_class] (num_class=1 for
+        binary/regression). Device-resident end to end: the per-class
+        tree-sum and base-score add run inside the compiled forest program
+        (see :meth:`_predict_device`), downloading only ``[n, K]``."""
+        return self._predict_device(X, num_iteration, transformed=False)
 
     def predict(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
-        """Transformed prediction (probability for binary/multiclass)."""
-        raw = self.predict_raw(X, num_iteration)
-        obj = self._obj()
-        if self.num_class > 1:
-            return np.asarray(jax.nn.softmax(raw, axis=-1))
-        return np.asarray(obj.transform(jnp.asarray(raw[:, 0])))
+        """Transformed prediction (probability for binary/multiclass).
+        The sigmoid/softmax/exp transform is fused into the same compiled
+        program as the forest evaluation — no raw-score download and
+        re-upload between the two."""
+        return self._predict_device(X, num_iteration, transformed=True)
 
     def predict_streamed(self, source, *, chunk_rows: int = 262_144,
                          out_dir=None, num_iteration: int = -1,

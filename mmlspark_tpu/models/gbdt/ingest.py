@@ -471,7 +471,7 @@ def binned_matrix_from_source(src: ShardedMatrixSource,
     my_devs = [i for i, d in enumerate(devs)
                if d.process_index == my_proc]
 
-    for off in range(0, per_dev, c):
+    def load_chunk(off: int):
         # width never crosses the shard boundary: a clamped
         # dynamic_update_slice would silently shift the write
         width = min(c, per_dev - off)
@@ -483,7 +483,9 @@ def binned_matrix_from_source(src: ShardedMatrixSource,
         # public promise about when the H2D transfer reads the source.
         # Same-size alloc/free per step recycles in the allocator — the
         # measured RSS pathologies were mixed-size churn and per-device
-        # program pools, not this.
+        # program pools, not this. The prefetch below keeps at most TWO
+        # such buffers live (the one transferring + the one being read),
+        # so host peak stays chunk-bounded.
         host = np.zeros((k * width, F), np.float32)
         for i in my_devs:
             lo = i * per_dev + off
@@ -492,6 +494,16 @@ def binned_matrix_from_source(src: ShardedMatrixSource,
             got = src.read_into(seg, lo, hi) if hi > lo else 0
             if got < width:
                 seg[got:] = 0.0            # in-file padding rows
+        return off, host
+
+    # chunk i+1's file reads run on the prefetch thread while the device
+    # bins chunk i (io/prefetch.py; MMLSPARK_TPU_DISABLE_PREFETCH=1 for
+    # the sequential loop). device_put + step stay on the calling thread
+    # in offset order, so the filled buffer is identical either way.
+    from ...io.prefetch import iter_prefetched
+    chunk_reads = ((lambda o=off: load_chunk(o))
+                   for off in range(0, per_dev, c))
+    for off, host in iter_prefetched(chunk_reads, site="ingest"):
         buf = step(buf, jax.device_put(host, row_sh), ub_d,
                    np.int32(off))
     return buf

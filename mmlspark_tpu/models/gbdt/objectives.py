@@ -280,6 +280,22 @@ def get_objective(name: str, num_class: int = 1, alpha: float = 0.9,
     raise ValueError(f"unknown objective {name!r}")
 
 
+def score_transform(objective: str, num_class: int = 1, **kwargs):
+    """Raw-margin -> prediction-space transform as ONE traceable function.
+
+    ``[n, K] -> [n, K]`` for multiclass (softmax over classes), and
+    ``[n, 1] -> [n]`` otherwise (the objective's own elementwise transform
+    on the single score column) — exactly the shapes ``Booster.predict``
+    has always returned. Split out so the device-resident inference
+    program can fuse the transform into the compiled forest evaluator
+    instead of re-uploading raw scores for a second host round-trip.
+    """
+    if num_class > 1:
+        return lambda raw: jax.nn.softmax(raw, axis=-1)
+    transform = get_objective(objective, num_class, **kwargs).transform
+    return lambda raw: transform(raw[:, 0])
+
+
 # -- eval metrics for early stopping (reference: TrainUtils.scala:220-315) ------
 
 

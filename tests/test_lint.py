@@ -151,5 +151,92 @@ def test_metric_names_unique_per_kind():
     assert not conflicts, conflicts
 
 
+def _loop_body_calls(fn_node):
+    """Call nodes inside For/While bodies of ``fn_node``, excluding nested
+    function/lambda bodies (helpers DEFINED outside the loop and merely
+    called inside it are the sanctioned pattern)."""
+    calls = []
+    for node in ast.walk(fn_node):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        stack = list(node.body) + list(node.orelse)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call):
+                calls.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+    return calls
+
+
+def test_streaming_chunk_loops_have_no_host_syncs():
+    """Hot-path guard for the double-buffered streaming loops
+    (io/streaming.py): ``np.asarray`` / ``float()`` inside a per-chunk
+    loop body is a host sync that serializes device compute against the
+    loop and defeats the prefetch overlap. Materialization belongs in a
+    helper defined OUTSIDE the loop (e.g. ``_score``), where it is one
+    deliberate, testable sync per chunk."""
+    tree = _parse(os.path.join(_PKG_ROOT, "io", "streaming.py"))
+    fns = [n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)]
+    assert any(f.name == "stream_apply" for f in fns)
+    offenders = []
+    for fn in fns:
+        for call in _loop_body_calls(fn):
+            callee = call.func
+            name = callee.attr if isinstance(callee, ast.Attribute) else \
+                callee.id if isinstance(callee, ast.Name) else None
+            if name in ("asarray", "float"):
+                offenders.append((fn.name, call.lineno, name))
+    assert not offenders, (
+        "host syncs inside per-chunk streaming loop bodies "
+        f"(move into a pre-loop helper): {offenders}")
+
+
+def test_booster_predict_path_takes_trees_as_arguments():
+    """Hot-path guard for the device-resident predictor
+    (models/gbdt/booster.py): the forest must ride as jit ARGUMENTS, not
+    constants — ``jnp.asarray(self.trees...)`` (or a device_put of them)
+    anywhere in the predictor build path would bake the trees into the
+    executable, making it per-Booster and bringing back the
+    recompile-after-unpickle serving stall this PR removed."""
+    tree = _parse(os.path.join(_PKG_ROOT, "models", "gbdt", "booster.py"))
+    predict_path = {"predict", "predict_raw", "_predict_device",
+                    "_device_forest_args", "_device_active",
+                    "_build_predict_program", "_predict_program"}
+    fns = [n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)
+           and n.name in predict_path]
+    # the predictor build path exists — an empty scan would mean this
+    # lint silently matches nothing
+    assert len(fns) >= 4, sorted(f.name for f in fns)
+    offenders = []
+    for fn in fns:
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            callee = call.func
+            name = callee.attr if isinstance(callee, ast.Attribute) else \
+                callee.id if isinstance(callee, ast.Name) else None
+            if name not in ("asarray", "array", "device_put"):
+                continue
+            # numpy host-side staging (np.asarray) is allowed; only
+            # device placement of the raw tree arrays is baking
+            mod = callee.value.id if (isinstance(callee, ast.Attribute)
+                                      and isinstance(callee.value,
+                                                     ast.Name)) else None
+            if mod == "np":
+                continue
+            for arg in ast.walk(ast.Module(body=[ast.Expr(a) for a
+                                                 in call.args],
+                                           type_ignores=[])):
+                if isinstance(arg, ast.Attribute) and arg.attr == "trees":
+                    offenders.append((fn.name, call.lineno))
+                    break
+    assert not offenders, (
+        "predictor build path must pass trees as packed jit arguments, "
+        f"not bake them via jnp.asarray/device_put: {offenders}")
+
+
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-q"]))

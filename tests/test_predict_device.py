@@ -1,0 +1,240 @@
+"""Device-resident GBDT inference hot path (models/gbdt/booster.py).
+
+Pins the PR's serving contracts without needing the training path (boosters
+are built synthetically), so they hold on any backend:
+
+* one host->device and one device->host transfer per predict call
+  (asserted through the ``_to_device`` / ``_from_device`` shim funnels);
+* power-of-two batch bucketing + tree-count bucketing hit the expected
+  process-wide executable counts (n in {1, 8192, 8193});
+* a pickled/unpickled Booster scores through the SAME cached executables —
+  no recompile (cache-hit counter asserted);
+* streamed scoring is bit-identical to in-memory with the prefetch
+  executor enabled and disabled.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import mmlspark_tpu.models.gbdt.booster as booster_mod
+from mmlspark_tpu.models.gbdt.booster import Booster
+from mmlspark_tpu.models.gbdt.growth import Tree
+from mmlspark_tpu.observability import metrics
+
+
+def make_booster(T=6, K=1, F=4, objective="binary", seed=0):
+    """A tiny hand-built ensemble: node 0 splits on a random feature,
+    nodes 1/2 are leaves — enough structure to make every tree's output
+    row-dependent."""
+    M = 7
+    rng = np.random.default_rng(seed)
+    feat = np.zeros((T, M), np.int32)
+    feat[:, 0] = rng.integers(0, F, T)
+    left = np.zeros((T, M), np.int32)
+    left[:, 0] = 1
+    right = np.zeros((T, M), np.int32)
+    right[:, 0] = 2
+    is_leaf = np.ones((T, M), bool)
+    is_leaf[:, 0] = False
+    leaf_value = (rng.normal(size=(T, M)) * 0.1).astype(np.float32)
+    trees = Tree(feat=feat, thr_bin=np.zeros((T, M), np.int32), left=left,
+                 right=right, is_leaf=is_leaf, leaf_value=leaf_value,
+                 node_count=np.full(T, 3, np.int32),
+                 node_grad=np.zeros((T, M), np.float32),
+                 node_hess=np.zeros((T, M), np.float32),
+                 node_cnt=np.zeros((T, M), np.float32),
+                 split_gain=np.zeros((T, M), np.float32),
+                 node_value=leaf_value.copy(),
+                 cat_bitset=np.zeros((T, M, 1), np.uint32))
+    thr_raw = rng.normal(size=(T, M)).astype(np.float32)
+    binner_state = dict(upper_bounds=np.zeros((F, 1), np.float32),
+                        max_bin=0, sample_count=0, seed=0,
+                        num_features=F, categorical_features=[])
+    return Booster(trees, thr_raw, K,
+                   np.full(K, 0.5, np.float32), objective, 3, binner_state)
+
+
+def host_reference_raw(b, X, t_end=None):
+    """The pre-fusion reference: per-tree leaf values downloaded [T, n],
+    base score tiled and classes summed on the host."""
+    import jax
+    import jax.numpy as jnp
+
+    from mmlspark_tpu.models.gbdt.growth import predict_forest_raw
+
+    t_end = b.num_trees if t_end is None else t_end
+    trees = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(np.asarray(a)[:t_end]), b.trees)
+    per_tree = np.asarray(predict_forest_raw(
+        trees, jnp.asarray(b.thr_raw[:t_end]), jnp.asarray(X),
+        b.depth_cap))
+    out = np.tile(b.base_score[None, :], (X.shape[0], 1)).astype(np.float32)
+    for k in range(b.num_class):
+        out[:, k] += per_tree[k::b.num_class].sum(axis=0)
+    return out
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+class TestFusedCorrectness:
+    def test_binary_matches_host_reference(self, rng):
+        b = make_booster()
+        X = rng.normal(size=(50, 4)).astype(np.float32)
+        np.testing.assert_allclose(b.predict_raw(X),
+                                   host_reference_raw(b, X), rtol=1e-6)
+        sig = 1.0 / (1.0 + np.exp(-host_reference_raw(b, X)[:, 0]))
+        pred = b.predict(X)
+        assert pred.shape == (50,)
+        np.testing.assert_allclose(pred, sig, rtol=1e-5)
+
+    def test_multiclass_matches_host_reference(self, rng):
+        b = make_booster(T=9, K=3, objective="multiclass")
+        X = rng.normal(size=(20, 4)).astype(np.float32)
+        raw = b.predict_raw(X)
+        np.testing.assert_allclose(raw, host_reference_raw(b, X),
+                                   rtol=1e-5)
+        pred = b.predict(X)
+        assert pred.shape == (20, 3)
+        np.testing.assert_allclose(pred.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_num_iteration_slice(self, rng):
+        b = make_booster()
+        X = rng.normal(size=(30, 4)).astype(np.float32)
+        np.testing.assert_allclose(b.predict_raw(X, num_iteration=3),
+                                   host_reference_raw(b, X, 3), rtol=1e-6)
+        # num_iteration beyond the model clamps to the full forest
+        np.testing.assert_array_equal(b.predict_raw(X, num_iteration=99),
+                                      b.predict_raw(X))
+
+    def test_list_valued_objective_kwargs(self, rng):
+        # JSON round-trips (Booster.load / from_string) turn tuple kwargs
+        # into lists (e.g. a ranker's label_gain); the executable-cache
+        # key must freeze them, not crash unhashable
+        b = make_booster(objective="lambdarank")
+        b.objective_kwargs = {"label_gain": [1.0, 3.0, 7.0],
+                              "max_position": 20}
+        X = rng.normal(size=(10, 4)).astype(np.float32)
+        pred = b.predict(X)                  # transformed path hashes key
+        np.testing.assert_allclose(pred, b.predict_raw(X)[:, 0],
+                                   rtol=1e-6)  # ranker transform=identity
+        b2 = pickle.loads(pickle.dumps(b))
+        np.testing.assert_array_equal(b2.predict(X), pred)
+
+    def test_empty_and_zero_iteration(self, rng):
+        b = make_booster()
+        X = rng.normal(size=(5, 4)).astype(np.float32)
+        assert b.predict_raw(X[:0]).shape == (0, 1)
+        np.testing.assert_allclose(b.predict_raw(X, num_iteration=0),
+                                   np.full((5, 1), 0.5, np.float32))
+
+
+class TestTransferCounts:
+    def test_exactly_one_upload_one_download_per_call(self, rng,
+                                                      monkeypatch):
+        b = make_booster()
+        X = rng.normal(size=(100, 4)).astype(np.float32)
+        counts = {"h2d": 0, "d2h": 0}
+        orig_to, orig_from = booster_mod._to_device, booster_mod._from_device
+
+        def counting_to(x):
+            counts["h2d"] += 1
+            return orig_to(x)
+
+        def counting_from(x):
+            counts["d2h"] += 1
+            return orig_from(x)
+
+        monkeypatch.setattr(booster_mod, "_to_device", counting_to)
+        monkeypatch.setattr(booster_mod, "_from_device", counting_from)
+        b.predict(X)                     # warm: device args + executable
+        for fn in (b.predict, b.predict_raw):
+            counts["h2d"] = counts["d2h"] = 0
+            fn(X)
+            assert counts == {"h2d": 1, "d2h": 1}, (fn, counts)
+
+
+class TestExecutableCache:
+    def test_batch_bucket_executable_counts(self, rng):
+        b = make_booster(seed=3)
+        cache = booster_mod._PREDICT_CACHE
+
+        def n_new(n_rows):
+            before = len(cache)
+            b.predict_raw(rng.normal(size=(n_rows, 4))
+                          .astype(np.float32))
+            return len(cache) - before
+
+        first = n_new(1)
+        assert first <= 1           # 0 if another test already compiled it
+        assert n_new(1) == 0        # repeat: cached executable
+        assert n_new(5) <= 1        # pads to 8
+        assert n_new(7) == 0        # pads to 8 again: same executable
+        assert n_new(8192) <= 1     # largest bucketed size
+        grew = n_new(8193)          # beyond bucketing: exact shape
+        assert grew <= 1
+        assert n_new(8193) == 0     # exact shape is itself cached
+
+    def test_num_iteration_sweep_hits_log2_buckets(self, rng):
+        b = make_booster(T=16, seed=5)
+        X = rng.normal(size=(64, 4)).astype(np.float32)
+        cache = booster_mod._PREDICT_CACHE
+        before = len(cache)
+        for it in range(1, 17):
+            b.predict_raw(X, num_iteration=it)
+        # buckets {1, 2, 4, 8, 16(full)} — not one executable per t_end
+        assert len(cache) - before <= 5
+
+    def test_pickled_booster_scores_without_recompiling(self, rng):
+        was_enabled = metrics.set_enabled(True)
+        try:
+            b = make_booster(seed=9)
+            X = rng.normal(size=(33, 4)).astype(np.float32)
+            expected = b.predict(X)      # warms executable + device args
+            cache_len = len(booster_mod._PREDICT_CACHE)
+            reg = metrics.get_registry()
+            hits0 = reg.counter("gbdt_predict_cache_hits_total").value
+            misses0 = reg.counter("gbdt_predict_cache_misses_total").value
+
+            b2 = pickle.loads(pickle.dumps(b))
+            got = b2.predict(X)
+
+            np.testing.assert_array_equal(got, expected)
+            assert len(booster_mod._PREDICT_CACHE) == cache_len
+            assert reg.counter(
+                "gbdt_predict_cache_misses_total").value == misses0
+            assert reg.counter(
+                "gbdt_predict_cache_hits_total").value >= hits0 + 1
+        finally:
+            metrics.set_enabled(was_enabled)
+
+    def test_getstate_drops_device_resident_args(self, rng):
+        b = make_booster()
+        b.predict(rng.normal(size=(8, 4)).astype(np.float32))
+        assert "_dev_forest" in b.__dict__ and "_dev_active" in b.__dict__
+        state = b.__getstate__()
+        assert "_dev_forest" not in state and "_dev_active" not in state
+
+
+class TestStreamedIdentity:
+    @pytest.mark.parametrize("disable_prefetch", ["0", "1"])
+    def test_streamed_bit_identical_to_in_memory(self, rng, tmp_path,
+                                                 monkeypatch,
+                                                 disable_prefetch):
+        from mmlspark_tpu.models.gbdt.ingest import write_shards
+
+        monkeypatch.setenv("MMLSPARK_TPU_DISABLE_PREFETCH",
+                           disable_prefetch)
+        b = make_booster(seed=11)
+        X = rng.normal(size=(5000, 4)).astype(np.float32)
+        write_shards([X[:1234], X[1234:3000], X[3000:]], tmp_path / "x")
+        streamed = b.predict_streamed(str(tmp_path / "x"), chunk_rows=700)
+        np.testing.assert_array_equal(streamed, b.predict(X))
+        raw = b.predict_streamed(str(tmp_path / "x"), chunk_rows=700,
+                                 raw=True)
+        np.testing.assert_array_equal(raw, b.predict_raw(X))

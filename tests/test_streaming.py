@@ -87,6 +87,114 @@ class TestStreamedBooster:
             ShardedMatrixSource(tmp_path)
 
 
+class TestPrefetch:
+    """The double-buffered prefetch executor (io/prefetch.py) and its
+    stream_apply adoption: identical outputs prefetch on/off, bounded
+    buffering, ordered delivery, exception propagation."""
+
+    @pytest.fixture
+    def shards(self, tmp_path):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(4000, 6)).astype(np.float32)
+        write_shards([X[:900], X[900:2500], X[2500:]], tmp_path / "x")
+        return X, str(tmp_path / "x")
+
+    @pytest.mark.parametrize("disable", ["0", "1"])
+    def test_stream_apply_identical_on_off(self, shards, monkeypatch,
+                                           disable):
+        X, xdir = shards
+        monkeypatch.setenv("MMLSPARK_TPU_DISABLE_PREFETCH", disable)
+        out = stream_apply(xdir, lambda c: c * 2.0 + 1.0, chunk_rows=700)
+        np.testing.assert_array_equal(out, X * 2.0 + 1.0)
+
+    def test_preallocated_epilogue_exact_buffer(self, shards):
+        # aligned chunk outputs land in ONE [total, ...] buffer — the
+        # result owns its memory (no chunk list + concatenate copy)
+        X, xdir = shards
+        out = stream_apply(xdir, lambda c: c[:, 0], chunk_rows=512)
+        assert out.shape == (4000,) and out.base is None
+        np.testing.assert_array_equal(out, X[:, 0])
+
+    def test_misaligned_outputs_demote_to_concatenate(self, shards):
+        # fn that VIOLATES the row-aligned contract (drops rows) must
+        # still produce the concatenation of its outputs, not crash
+        X, xdir = shards
+        out = stream_apply(xdir, lambda c: c[::2], chunk_rows=1000)
+        ref = np.concatenate([X[lo:lo + 1000:2]
+                              for lo in range(0, 4000, 1000)])
+        np.testing.assert_array_equal(out, ref)
+
+    def test_consumer_exception_propagates(self, shards):
+        X, xdir = shards
+        calls = []
+
+        def boom(c):
+            calls.append(len(c))
+            if len(calls) == 2:
+                raise RuntimeError("scorer failed")
+            return c
+
+        with pytest.raises(RuntimeError, match="scorer failed"):
+            stream_apply(xdir, boom, chunk_rows=700)
+        assert len(calls) == 2
+
+    def test_reader_exception_propagates_in_order(self, shards,
+                                                  monkeypatch):
+        X, xdir = shards
+        src = ShardedMatrixSource(xdir)
+        real_read = src.read
+
+        def failing_read(lo, hi):
+            if lo >= 1400:
+                raise IOError("disk gone")
+            return real_read(lo, hi)
+
+        monkeypatch.setattr(src, "read", failing_read)
+        seen = []
+        with pytest.raises(IOError, match="disk gone"):
+            stream_apply(src, lambda c: seen.append(c.shape[0]) or c,
+                         chunk_rows=700)
+        assert seen == [700, 700]     # chunks before the failure scored
+
+    def test_at_most_two_chunks_in_flight(self, monkeypatch):
+        from mmlspark_tpu.io.prefetch import iter_prefetched
+
+        monkeypatch.delenv("MMLSPARK_TPU_DISABLE_PREFETCH", raising=False)
+        state = {"loaded": 0, "consumed": 0, "max_ahead": 0}
+
+        def thunk(i):
+            def load():
+                state["loaded"] += 1
+                state["max_ahead"] = max(
+                    state["max_ahead"],
+                    state["loaded"] - state["consumed"])
+                return i
+            return load
+
+        got = []
+        for v in iter_prefetched((thunk(i) for i in range(8))):
+            got.append(v)
+            state["consumed"] += 1
+        assert got == list(range(8))
+        # one chunk being consumed + one loading ahead, never more
+        assert state["max_ahead"] <= 2
+
+    def test_kill_switch_stays_sequential(self, monkeypatch):
+        import threading
+
+        from mmlspark_tpu.io.prefetch import iter_prefetched
+
+        monkeypatch.setenv("MMLSPARK_TPU_DISABLE_PREFETCH", "1")
+        main = threading.current_thread().name
+        threads = []
+        out = list(iter_prefetched(
+            (lambda i=i: threads.append(
+                threading.current_thread().name) or i)
+            for i in range(3)))
+        assert out == [0, 1, 2]
+        assert set(threads) == {main}
+
+
 class TestStreamedDNN:
     def test_dnn_stream_transform_matches_in_memory(self, tmp_path):
         from mmlspark_tpu.models.dnn.cnn import (CNNConfig, apply_cnn,
