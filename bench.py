@@ -94,6 +94,24 @@ def _dump_metrics_snapshot(leg: str) -> None:
         print(f"metrics snapshot failed: {e!r}", file=sys.stderr)
 
 
+def _dump_flight_snapshot(leg: str) -> None:
+    """``GRAFT_BENCH_FLIGHT_SNAPSHOT=<path>`` writes the flight
+    recorder's event ring (docs/observability.md) next to the metrics
+    snapshot — span tails, compile events (cache key / wall time / XLA
+    cost), failovers — so a slow round ships its event sequence, not just
+    its aggregates. Same per-leg filename splice as the metrics dump."""
+    path = os.environ.get("GRAFT_BENCH_FLIGHT_SNAPSHOT")
+    if not path:
+        return
+    root, ext = os.path.splitext(path)
+    path = f"{root}.{leg}{ext or '.json'}"
+    try:
+        from mmlspark_tpu.observability import flight as _obs_flight
+        _obs_flight.dump(path)
+    except Exception as e:  # noqa: BLE001 — telemetry must not fail a bench
+        print(f"flight snapshot failed: {e!r}", file=sys.stderr)
+
+
 def main() -> None:
     """Orchestrate: CPU leg first (publish early), TPU leg if the relay
     answers within the capped wait (upgrade late). Legs are subprocesses of
@@ -467,6 +485,7 @@ def _run_leg(on_tpu: bool) -> None:
             lime_rates["perturbations_per_sec"]
     print(json.dumps(out))
     _dump_metrics_snapshot("tpu" if on_tpu else "cpu")
+    _dump_flight_snapshot("tpu" if on_tpu else "cpu")
 
 
 def _gbdt_roofline(n_rows: int, n_feat: int, max_bin: int,

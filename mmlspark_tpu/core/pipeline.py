@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 from ..observability import spans as _spans
 from .dataset import Dataset
@@ -51,7 +52,16 @@ def _instrumented(method, op: str):
                 sp.set(rows_in=rows_in)
                 _metrics.safe_counter("stage_rows_in_total",
                                       stage=cls, op=op).inc(rows_in)
-            out = method(self, dataset, *args, **kwargs)
+            try:
+                out = method(self, dataset, *args, **kwargs)
+            except Exception as e:
+                # the flight recorder's error record: which stage blew
+                # up, on how many rows — the context a post-mortem dump
+                # from a dying worker needs next to its span tail
+                _flight.record("error", stage=cls, uid=self.uid, op=op,
+                               rows_in=rows_in,
+                               error=f"{type(e).__name__}: {e}")
+                raise
             if op == "transform":
                 rows_out = _row_count(out)
                 if rows_out is not None:

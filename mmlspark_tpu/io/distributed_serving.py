@@ -40,9 +40,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional
 
 from ..core.dataset import Dataset
+from ..observability import flight as _flight
 from ..observability import metrics as _metrics
-from .serving import (ServingQuery, ServingServer, is_metrics_scrape,
-                      write_metrics_response)
+from ..observability import spans as _spans
+from ..observability import tracing as _tracing
+from .serving import (ServingQuery, ServingServer, debug_route,
+                      write_debug_response, write_http_response)
 
 # ---------------------------------------------------------------------------
 # Service registry
@@ -147,29 +150,53 @@ class GatewayServer:
             def _handle(self, method):
                 # enabled() gate: same disabled-path contract as
                 # ServingServer — set_enabled(False) restores plain
-                # proxying of GET /metrics to the workers
-                if _metrics.enabled() and \
-                        is_metrics_scrape(method, self.path, outer.api_name):
-                    # the gateway's own registry view: routing counters,
-                    # failovers, live-worker gauge — not proxied to workers
-                    write_metrics_response(self)
-                    return
+                # proxying of GET /metrics (and /healthz etc.) to the
+                # workers
+                if _metrics.enabled():
+                    route = debug_route(method, self.path, outer.api_name)
+                    if route is not None:
+                        # the gateway's own view: routing counters,
+                        # failovers, live-worker gauge, its flight ring —
+                        # not proxied to workers
+                        write_debug_response(self, route, outer.api_name)
+                        return
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
+                # edge hop: adopt the client's trace or mint one; the
+                # active context is what _route injects into the worker
+                # hop, so edge, gateway, and worker spans share a trace_id
+                ctx = _tracing.context_from_headers(self.headers)
+                token = _tracing.activate(ctx) if ctx is not None else None
                 t0 = time.perf_counter()
-                status, payload, hdrs = outer._route(method, self.path, body)
-                _metrics.safe_histogram("gateway_request_seconds",
-                                        api=outer.api_name).observe(
-                    time.perf_counter() - t0)
-                _metrics.safe_counter("gateway_responses_total",
-                                      api=outer.api_name,
-                                      code=str(status)).inc()
-                self.send_response(status)
-                for k, v in hdrs.items():
-                    self.send_header(k, v)
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
+                try:
+                    with _spans.span("gateway_request",
+                                     api=outer.api_name, method=method,
+                                     path=self.path):
+                        status, payload, hdrs = outer._route(
+                            method, self.path, body)
+                except Exception as e:  # noqa: BLE001
+                    # e.g. a corrupted file-backed registry blowing up the
+                    # worker scan: answer 500 instead of dropping the
+                    # connection (and leave the forensics in the ring)
+                    status, payload = 500, b'{"error": "gateway internal"}'
+                    hdrs = {"Content-Type": "application/json"}
+                    _flight.record("gateway_error", api=outer.api_name,
+                                   error=f"{type(e).__name__}: {e}")
+                finally:
+                    dt = time.perf_counter() - t0
+                    _metrics.safe_histogram("gateway_request_seconds",
+                                            api=outer.api_name).observe(dt)
+                    _metrics.safe_counter("gateway_responses_total",
+                                          api=outer.api_name,
+                                          code=str(status)).inc()
+                    _tracing.maybe_mark_slow("gateway_request_seconds",
+                                             dt, api=outer.api_name)
+                    if token is not None:
+                        _tracing.deactivate(token)
+                if ctx is not None:
+                    hdrs = {**hdrs,
+                            _tracing.REQUEST_ID_HEADER: ctx.trace_id}
+                write_http_response(self, status, payload, hdrs)
 
             def do_GET(self):
                 self._handle("GET")
@@ -246,7 +273,10 @@ class GatewayServer:
             try:
                 conn = http.client.HTTPConnection(
                     w.host, w.port, timeout=self.request_timeout)
-                conn.request(method, f"/{w.api_name}", body=body)
+                # outbound hop: the active trace context rides the wire,
+                # so worker-side spans stitch to this gateway's
+                conn.request(method, f"/{w.api_name}", body=body,
+                             headers=_tracing.outbound_headers())
                 resp = conn.getresponse()
                 payload = resp.read()
                 headers = {"Content-Type":
@@ -261,7 +291,7 @@ class GatewayServer:
                                       api=self.api_name,
                                       worker=f"{w.host}:{w.port}").inc()
                 return resp.status, payload, headers
-            except (OSError, http.client.HTTPException):
+            except (OSError, http.client.HTTPException) as e:
                 # connection-level failure OR a worker dying mid-response
                 # (BadStatusLine/IncompleteRead): mark dead until a health
                 # sweep readmits it, retry on another worker
@@ -271,6 +301,15 @@ class GatewayServer:
                 self.failovers += 1
                 _metrics.safe_counter("gateway_failovers_total",
                                       api=self.api_name).inc()
+                # labeled by failure class (a bounded set), so silent
+                # failovers separate into "worker gone" vs "worker sick"
+                _metrics.safe_counter("gateway_retries_total",
+                                      api=self.api_name,
+                                      reason=type(e).__name__).inc()
+                _flight.record("gateway_failover",
+                               api=self.api_name, worker=w.worker_id,
+                               addr=f"{w.host}:{w.port}",
+                               reason=f"{type(e).__name__}: {e}")
             finally:
                 with self._lock:
                     self._inflight[w.worker_id] = max(

@@ -35,6 +35,7 @@ from ..core.dataset import Dataset
 from ..core.params import (HasErrorCol, HasInputCol, HasOutputCol, Param,
                            TypeConverters)
 from ..core.pipeline import PipelineModel, Transformer
+from ..observability import tracing as _tracing
 
 # ---------------------------------------------------------------------------
 # Schema (reference: io/http/HTTPSchema.scala:26-166)
@@ -124,6 +125,11 @@ def send_request(request: HTTPRequestData, timeout: float = 60.0) -> HTTPRespons
         request.url, data=request.entity, method=request.method.upper())
     for k, v in (request.headers or {}).items():
         req.add_header(k, v)
+    # propagate the active trace context (a no-op when telemetry is off,
+    # outside any request, or when the caller set the header explicitly)
+    for k, v in _tracing.outbound_headers().items():
+        if not req.has_header(k.capitalize()):
+            req.add_header(k, v)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return HTTPResponseData(

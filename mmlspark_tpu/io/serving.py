@@ -35,7 +35,9 @@ Fluent entry (IOImplicits parity)::
 from __future__ import annotations
 
 import json
+import os
 import queue
+import sys
 import threading
 import time
 import uuid
@@ -44,12 +46,29 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional
 
 from ..core.dataset import Dataset
+from ..observability import flight as _flight
 from ..observability import metrics as _metrics
+from ..observability import spans as _spans
+from ..observability import tracing as _tracing
 from .http import to_jsonable
 
 #: paths (relative to the server root) answered with the Prometheus text
 #: rendering of the global registry instead of entering the request queue
 METRICS_PATH = "/metrics"
+#: liveness + device presence, answered in-band like /metrics
+HEALTHZ_PATH = "/healthz"
+#: registry JSON + build/config info + slow-request exemplars
+VARZ_PATH = "/varz"
+#: the flight recorder's ring buffer as JSON
+FLIGHT_PATH = "/debug/flight"
+
+#: (route name, path) table shared by the serving server and the gateway
+DEBUG_ROUTES = (
+    ("metrics", METRICS_PATH),
+    ("healthz", HEALTHZ_PATH),
+    ("varz", VARZ_PATH),
+    ("flight", FLIGHT_PATH),
+)
 
 
 def render_metrics() -> bytes:
@@ -57,28 +76,140 @@ def render_metrics() -> bytes:
     return _metrics.get_registry().render_prometheus().encode("utf-8")
 
 
-def is_metrics_scrape(method: str, path: str, api_name: str) -> bool:
-    """True when a request is a ``GET /metrics`` (or
-    ``GET /{api_name}/metrics``) scrape — shared by ``ServingServer`` and
-    the distributed-serving gateway so the path normalization and alias
-    set stay defined in exactly one place."""
+def debug_route(method: str, path: str, api_name: str) -> Optional[str]:
+    """Which in-band debug endpoint (if any) a request addresses:
+    ``"metrics"`` / ``"healthz"`` / ``"varz"`` / ``"flight"`` — each also
+    reachable under ``/{api_name}`` — or None for normal traffic. Shared
+    by ``ServingServer`` and the distributed-serving gateway so the path
+    normalization and alias set stay defined in exactly one place."""
     if method != "GET":
-        return False
+        return None
     path_only = path.split("?", 1)[0].rstrip("/") or "/"
-    return path_only in (METRICS_PATH, f"/{api_name}{METRICS_PATH}")
+    for name, route in DEBUG_ROUTES:
+        if path_only in (route, f"/{api_name}{route}"):
+            return name
+    return None
+
+
+def write_http_response(handler: BaseHTTPRequestHandler, status: int,
+                        payload: bytes = b"",
+                        headers: Optional[Dict[str, str]] = None,
+                        counter: Optional[str] = None,
+                        **labels: Any) -> None:
+    """The single funnel every ``io/`` HTTP handler's bytes leave
+    through: status line, headers, Content-Length, body, and (when
+    ``counter`` is given) a per-status-code counter — so no handler
+    branch can silently skip accounting. ``tests/test_lint.py`` rejects
+    direct ``send_response`` calls anywhere else under ``io/``."""
+    if isinstance(payload, str):
+        payload = payload.encode("utf-8")
+    handler.send_response(status)
+    for k, v in (headers or {}).items():
+        handler.send_header(k, v)
+    handler.send_header("Content-Length", str(len(payload)))
+    handler.end_headers()
+    handler.wfile.write(payload)
+    if counter:
+        _metrics.safe_counter(counter, code=str(status), **labels).inc()
 
 
 def write_metrics_response(handler: BaseHTTPRequestHandler) -> None:
     """Answer a scrape on any ``BaseHTTPRequestHandler`` in-band — shared
     by ``ServingServer`` and the distributed-serving gateway so the
     exposition content type stays defined in exactly one place."""
-    payload = render_metrics()
-    handler.send_response(200)
-    handler.send_header("Content-Type",
-                        "text/plain; version=0.0.4; charset=utf-8")
-    handler.send_header("Content-Length", str(len(payload)))
-    handler.end_headers()
-    handler.wfile.write(payload)
+    write_http_response(
+        handler, 200, render_metrics(),
+        {"Content-Type": "text/plain; version=0.0.4; charset=utf-8"})
+
+
+_device_probe: Optional[Dict[str, Any]] = None
+
+
+def _probe_devices() -> Dict[str, Any]:
+    """Device presence for /healthz, without side effects.
+
+    Only probes when this process already imported jax (a worker serving
+    a model has; a pure gateway process may not — and ``jax.devices()``
+    there would block the probe thread on full backend init and contend
+    for a TPU the colocated workers own). Successful probes are cached:
+    the device set of a live process doesn't change, and liveness checks
+    arrive often. Failures are NOT cached — a sick runtime should keep
+    reporting degraded until it recovers."""
+    global _device_probe
+    if _device_probe is not None:
+        return _device_probe
+    if "jax" not in sys.modules:
+        return {"devices": None, "platform": None,
+                "device_note": "jax not loaded in this process"}
+    try:
+        import jax
+        devices = jax.devices()
+        _device_probe = {
+            "devices": len(devices),
+            "platform": devices[0].platform if devices else None,
+        }
+        return _device_probe
+    except Exception as e:  # noqa: BLE001 — degraded, but still alive
+        return {"status": "degraded", "devices": 0,
+                "device_error": f"{type(e).__name__}: {e}"}
+
+
+def healthz_payload() -> Dict[str, Any]:
+    """Liveness + device presence. Device enumeration is best-effort: a
+    health probe must answer even when the accelerator runtime is sick —
+    that is precisely when operators probe it."""
+    info: Dict[str, Any] = {"status": "ok", "pid": os.getpid(),
+                            "time": time.time()}
+    info.update(_probe_devices())
+    return info
+
+
+def varz_payload(api_name: str) -> Dict[str, Any]:
+    """Registry JSON + build/config info + slow-request exemplars (the
+    ``/varz`` body; name after the Google-style debug endpoint)."""
+    from .. import __version__
+    build: Dict[str, Any] = {"version": __version__,
+                             "python": sys.version.split()[0]}
+    if "jax" in sys.modules:
+        # report-only, never import: a pure gateway process must not pay
+        # the jax package import (same isolation rule as _probe_devices)
+        try:
+            build["jax"] = sys.modules["jax"].__version__
+        except Exception:  # noqa: BLE001
+            pass
+    return {
+        "build": build,
+        "config": {
+            "api_name": api_name,
+            "pid": os.getpid(),
+            "slow_request_seconds": _tracing.get_slow_threshold(),
+            "flight_capacity": _flight.capacity(),
+            "max_trace_events": _spans.get_max_trace_events(),
+            "trace_events_dropped": _spans.dropped_events(),
+        },
+        "exemplars": _tracing.get_exemplars(),
+        "metrics": _metrics.get_registry().snapshot(),
+    }
+
+
+def write_debug_response(handler: BaseHTTPRequestHandler, route: str,
+                         api_name: str) -> None:
+    """Answer any debug route in-band (never queued: these must work
+    even when the batching worker or every backend worker is wedged)."""
+    if route == "metrics":
+        write_metrics_response(handler)
+        return
+    if route == "healthz":
+        payload: Any = healthz_payload()
+    elif route == "varz":
+        payload = varz_payload(api_name)
+    else:
+        payload = _flight.snapshot()
+    body = json.dumps(payload, default=repr).encode("utf-8")
+    write_http_response(handler, 200, body,
+                        {"Content-Type": "application/json"},
+                        counter="debug_requests_total",
+                        api=api_name, endpoint=route)
 
 
 # power-of-two ladder matching the jit bucket shapes (bucket_size below)
@@ -102,6 +233,8 @@ class ServedRequest:
     done: threading.Event = field(default_factory=threading.Event)
     response: Optional[Dict[str, Any]] = None
     requeued: bool = False
+    #: trace context extracted at the edge (None with telemetry disabled)
+    trace: Optional[Any] = None
 
     def json(self) -> Any:
         return json.loads(self.body.decode("utf-8")) if self.body else None
@@ -130,13 +263,19 @@ class ServingServer:
                 # the enabled() gate keeps the disabled-path contract
                 # (set_enabled(False) restores exactly the uninstrumented
                 # routing) and gives an API that legitimately owns GET
-                # /metrics a way to reclaim the path
-                if _metrics.enabled() and \
-                        is_metrics_scrape(method, self.path, outer.api_name):
-                    # answered in-band, never queued: the scrape must work
-                    # even when the batching worker is wedged
-                    write_metrics_response(self)
-                    return
+                # /metrics — or /healthz etc. — a way to reclaim the path
+                if _metrics.enabled():
+                    route = debug_route(method, self.path, outer.api_name)
+                    if route is not None:
+                        # answered in-band, never queued: these must work
+                        # even when the batching worker is wedged
+                        write_debug_response(self, route, outer.api_name)
+                        return
+                # inbound hop: adopt the caller's trace (gateway/client
+                # traceparent) or start one; None while disabled, which
+                # also suppresses the X-Request-Id echo
+                ctx = _tracing.context_from_headers(self.headers)
+                token = _tracing.activate(ctx) if ctx is not None else None
                 t0 = time.perf_counter()
                 # captured once so inc/dec hit the same object even if
                 # metrics.set_enabled is toggled while this request is
@@ -148,45 +287,53 @@ class ServingServer:
                 inflight.inc()
                 status = 504
                 try:
-                    length = int(self.headers.get("Content-Length") or 0)
-                    body = self.rfile.read(length) if length else b""
-                    req = ServedRequest(
-                        id=uuid.uuid4().hex, method=method, path=self.path,
-                        headers={k.lower(): v
-                                 for k, v in self.headers.items()},
-                        body=body)
-                    with outer._lock:
-                        outer._inflight[req.id] = req
-                    outer._queue.put(req)
-                    _metrics.safe_gauge("serving_queue_depth",
-                                        api=outer.api_name).set(
-                        outer._queue.qsize())
-                    ok = req.done.wait(outer.request_timeout)
-                    with outer._lock:
-                        outer._inflight.pop(req.id, None)
-                    if not ok or req.response is None:
-                        self.send_response(504)
-                        self.end_headers()
-                        return
-                    resp = req.response
-                    status = int(resp.get("statusCode", 200))
-                    self.send_response(status)
-                    payload = resp.get("entity", b"")
-                    if isinstance(payload, str):
-                        payload = payload.encode("utf-8")
-                    for k, v in (resp.get("headers") or {}).items():
-                        self.send_header(k, v)
-                    self.send_header("Content-Length", str(len(payload)))
-                    self.end_headers()
-                    self.wfile.write(payload)
+                    with _spans.span("serving_request",
+                                     api=outer.api_name, method=method,
+                                     path=self.path):
+                        length = int(self.headers.get("Content-Length")
+                                     or 0)
+                        body = self.rfile.read(length) if length else b""
+                        req = ServedRequest(
+                            id=uuid.uuid4().hex, method=method,
+                            path=self.path,
+                            headers={k.lower(): v
+                                     for k, v in self.headers.items()},
+                            body=body, trace=ctx)
+                        with outer._lock:
+                            outer._inflight[req.id] = req
+                        outer._queue.put(req)
+                        _metrics.safe_gauge("serving_queue_depth",
+                                            api=outer.api_name).set(
+                            outer._queue.qsize())
+                        ok = req.done.wait(outer.request_timeout)
+                        with outer._lock:
+                            outer._inflight.pop(req.id, None)
+                        echo = ({} if ctx is None else
+                                {_tracing.REQUEST_ID_HEADER: ctx.trace_id})
+                        if not ok or req.response is None:
+                            _flight.record("request_timeout",
+                                           api=outer.api_name,
+                                           request_id=req.id)
+                            write_http_response(self, 504, b"", echo)
+                            return
+                        resp = req.response
+                        status = int(resp.get("statusCode", 200))
+                        payload = resp.get("entity", b"")
+                        hdrs = {**(resp.get("headers") or {}), **echo}
+                        write_http_response(self, status, payload, hdrs)
                 finally:
                     inflight.dec()
                     _metrics.safe_counter("serving_responses_total",
                                           api=outer.api_name,
                                           code=str(status)).inc()
+                    dt = time.perf_counter() - t0
                     _metrics.safe_histogram(
                         "serving_request_seconds", api=outer.api_name
-                    ).observe(time.perf_counter() - t0)
+                    ).observe(dt)
+                    _tracing.maybe_mark_slow("serving_request_seconds",
+                                             dt, api=outer.api_name)
+                    if token is not None:
+                        _tracing.deactivate(token)
 
             def do_GET(self):
                 self._handle("GET")
@@ -289,6 +436,9 @@ class ServingServer:
             return False
         req.requeued = True
         self._queue.put(req)
+        # queue transition: a crash-recovery requeue is exactly the kind
+        # of event a post-mortem flight dump needs in sequence
+        _flight.record("requeue", api=self.api_name, request_id=req.id)
         return True
 
     # -- sink side ---------------------------------------------------------
@@ -297,6 +447,12 @@ class ServingServer:
         with self._lock:
             req = self._inflight.get(request_id)
         if req is None:
+            # late/duplicate replies (request already timed out and its
+            # socket released) were silently dropped — make them visible
+            _metrics.safe_counter("serving_reply_unknown_total",
+                                  api=self.api_name).inc()
+            _flight.record("reply_unknown", api=self.api_name,
+                           request_id=request_id)
             return False
         if not isinstance(entity, (bytes, str)) and entity is not None:
             entity = json.dumps(entity)
@@ -414,8 +570,19 @@ class ServingQuery:
                 len(batch))
             ds = requests_to_dataset(batch)
             t0 = time.perf_counter()
+            # the queue crosses a thread boundary, so the handler threads'
+            # contextvars don't reach this worker: re-activate the first
+            # request's trace (exact attribution at the dominant batch
+            # size of 1; under larger batches the span's trace_ids attr
+            # names every co-batched request)
+            traces = [r.trace for r in batch if r.trace is not None]
+            ctx = traces[0] if traces else None
+            token = _tracing.activate(ctx) if ctx is not None else None
             try:
-                out = self.transform(ds)
+                with _spans.span("serving_transform", api=api,
+                                 batch_size=len(batch),
+                                 trace_ids=[t.trace_id for t in traces]):
+                    out = self.transform(ds)
                 replies = out[self.reply_col]
                 ids = out["id"]
                 for rid, rep in zip(ids, replies):
@@ -430,8 +597,12 @@ class ServingQuery:
                 _metrics.safe_histogram("serving_transform_seconds",
                                         api=api).observe(
                     time.perf_counter() - t0)
-            except Exception:
+            except Exception as e:
                 survivors = [r for r in batch if self.server.requeue(r)]
+                _flight.record("batch_error", api=api,
+                               batch_size=len(batch),
+                               requeued=len(survivors),
+                               error=f"{type(e).__name__}: {e}")
                 _metrics.safe_counter("serving_batch_failures_total",
                                       api=api).inc()
                 _metrics.safe_counter("serving_requeues_total", api=api).inc(
@@ -439,6 +610,9 @@ class ServingQuery:
                 for r in batch:
                     if r not in survivors and not r.done.is_set():
                         self.server.reply(r.id, {"error": "internal"}, 500)
+            finally:
+                if token is not None:
+                    _tracing.deactivate(token)
 
 
 class ServingBuilder:

@@ -85,11 +85,34 @@ def main(argv=None) -> int:
     g.add_argument("--port", type=int, default=8898)
     g.add_argument("--api-name", default="serving")
 
+    for role_parser in (w, g):
+        role_parser.add_argument(
+            "--slow-request-seconds", type=float, default=None,
+            help="slow-request exemplar threshold (default: "
+                 "MMLSPARK_TPU_SLOW_REQUEST_SECONDS or 1.0)")
+        role_parser.add_argument(
+            "--flight-dir", default=None,
+            help="directory for flight-recorder dumps on crash or SIGUSR2 "
+                 "(default: MMLSPARK_TPU_FLIGHT_DIR or the system temp dir)")
+
     args = p.parse_args(argv)
 
+    from ..observability import flight as _flight
+    from ..observability import tracing as _tracing
     from .distributed_serving import (GatewayServer, ServiceRegistry,
                                       WorkerInfo)
     from .serving import ServingQuery, ServingServer
+
+    # arm the flight recorder: SIGUSR2 pokes a live dump out of a wedged
+    # process, the excepthook catches the dying one; docs/observability.md
+    # has the recovery recipe
+    if args.flight_dir:
+        import os
+        os.environ["MMLSPARK_TPU_FLIGHT_DIR"] = args.flight_dir
+    if args.slow_request_seconds is not None:
+        _tracing.set_slow_threshold(args.slow_request_seconds)
+    _flight.set_default_fields(role=args.role)
+    _flight.install()
 
     registry = ServiceRegistry(args.registry)
     stop = threading.Event()

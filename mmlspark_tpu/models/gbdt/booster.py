@@ -27,6 +27,7 @@ from jax import lax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ...observability import flight as _flight
 from ...observability import metrics as _metrics
 from ...observability import spans as _spans
 from ...ops.binning import QuantileBinner, bin_cols_device
@@ -49,7 +50,16 @@ def _cached_program(key, build):
     """Get-or-build a compiled program in the bounded LRU step cache."""
     prog = _STEP_CACHE.get(key)
     if prog is None:
+        t0 = time.perf_counter()
         prog = build()
+        # compile event: XLA hands this cache jitted programs that compile
+        # lazily, so the recorded time is stage-out only — the predict
+        # cache (below) is the one that observes real compile wall time
+        _flight.record("program_build", cache="gbdt_step",
+                       key=repr(key),
+                       seconds=round(time.perf_counter() - t0, 6))
+        _metrics.safe_counter("gbdt_program_builds_total",
+                              cache="gbdt_step").inc()
         _STEP_CACHE[key] = prog
         while len(_STEP_CACHE) > _STEP_CACHE_MAX:
             _STEP_CACHE.popitem(last=False)
@@ -214,6 +224,76 @@ _PREDICT_CACHE_MAX = 64
 _PREDICT_CACHE_LOCK = threading.Lock()
 
 
+def _cost_summary(compiled) -> dict:
+    """FLOPs / bytes-accessed from XLA ``cost_analysis()`` where the
+    backend exposes it ({} elsewhere) — the GSPMD observation that what
+    got compiled, and how big, is itself a key runtime observable."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        out = {}
+        if ca.get("flops") is not None:
+            out["flops"] = float(ca["flops"])
+        if ca.get("bytes accessed") is not None:
+            out["bytes_accessed"] = float(ca["bytes accessed"])
+        return out
+    except Exception:  # noqa: BLE001 — telemetry must not fail a predict
+        return {}
+
+
+class _ObservedProgram:
+    """Cache entry that makes the compile observable.
+
+    ``jax.jit`` compiles lazily on first dispatch, which hides compile
+    wall time and the compiled artifact. This wrapper AOT-compiles on the
+    first call instead (``lower(*args).compile()`` — exact shapes are
+    pinned by the cache key, so one compile serves every call), records a
+    flight-recorder compile event with the cache key, wall time, and XLA
+    ``cost_analysis()`` FLOPs/bytes, and feeds ``gbdt_compile_seconds``.
+    If the AOT path is unavailable it falls back to plain jit dispatch —
+    scoring never depends on the observability path.
+    """
+
+    __slots__ = ("_jitted", "_key", "_compiled", "_lock")
+
+    def __init__(self, jitted, key):
+        self._jitted = jitted
+        self._key = key
+        self._compiled = None
+        self._lock = threading.Lock()
+
+    def __call__(self, *args):
+        fn = self._compiled
+        if fn is None:
+            fn = self._compile_observed(args)
+        return fn(*args)
+
+    def _compile_observed(self, args):
+        # serialized: two serving threads hitting a cold entry must not
+        # both pay the multi-second XLA compile (the plain-jit path
+        # deduplicated this inside jax's dispatch cache) nor double-count
+        # the compile metrics
+        with self._lock:
+            if self._compiled is not None:
+                return self._compiled
+            t0 = time.perf_counter()
+            cost = {}
+            try:
+                fn = self._jitted.lower(*args).compile()
+                cost = _cost_summary(fn)
+            except Exception:  # noqa: BLE001 — AOT API drift: plain jit
+                fn = self._jitted
+            dt = time.perf_counter() - t0
+            self._compiled = fn
+        _metrics.safe_counter("gbdt_compiles_total", cache="predict").inc()
+        _metrics.safe_histogram("gbdt_compile_seconds",
+                                cache="predict").observe(dt)
+        _flight.record("compile", cache="predict", key=repr(self._key),
+                       seconds=round(dt, 6), **cost)
+        return fn
+
+
 def _predict_program(key, build):
     """Get-or-build in the bounded process-wide predictor cache, counting
     hits/misses (``gbdt_predict_cache_{hits,misses}_total``)."""
@@ -224,7 +304,7 @@ def _predict_program(key, build):
     if fn is None:
         _metrics.safe_counter("gbdt_predict_cache_misses_total").inc()
         with _spans.span("gbdt_predict_build"):
-            fn = build()
+            fn = _ObservedProgram(build(), key)
         with _PREDICT_CACHE_LOCK:
             fn = _PREDICT_CACHE.setdefault(key, fn)
             _PREDICT_CACHE.move_to_end(key)

@@ -1,17 +1,23 @@
-"""Unified telemetry: metrics registry, stage-level spans, device gauges.
+"""Unified telemetry: metrics, spans, tracing, flight recorder, device.
 
-Three pieces, one flag:
+Five pieces, one flag:
 
 - :mod:`.metrics` — process-wide ``MetricsRegistry`` (Counter / Gauge /
   Histogram with labels), snapshot-to-dict, Prometheus text renderer.
 - :mod:`.spans` — nesting wall-time spans that feed the registry AND enter
   ``utils/profiling.annotate`` so host scopes and XLA device traces share
   names; exportable as Chrome trace-event JSON.
+- :mod:`.tracing` — per-request ``TraceContext`` (trace_id / span_id /
+  parent_id) propagated across serving hops via W3C-traceparent headers
+  and stamped onto every span, plus slow-request exemplars.
+- :mod:`.flight` — bounded crash-safe ring buffer of structured events,
+  dumped on unhandled exception, SIGUSR2, or demand (``/debug/flight``).
 - :mod:`.device` — ``device_memory_gauges()`` sampling live HBM stats.
 
 ``metrics.set_enabled(False)`` turns every instrumentation site in the
 framework into a cheap no-op (profiling.py's never-break-the-pipeline
-contract). ``ServingServer`` exposes the registry at ``GET /metrics``.
+contract). ``ServingServer`` exposes the registry at ``GET /metrics``
+and the debug trio at ``/healthz`` / ``/varz`` / ``/debug/flight``.
 See docs/observability.md.
 """
 
@@ -19,10 +25,13 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
                       counter, enabled, gauge, get_registry, histogram,
                       reset, safe_counter, safe_gauge, safe_histogram,
                       set_enabled, set_registry)
+from .tracing import (REQUEST_ID_HEADER, TRACEPARENT_HEADER,  # noqa: F401
+                      TraceContext)
 from .spans import (clear_trace, current_span, dump_trace,  # noqa: F401
                     get_trace_events, instant, set_default_attrs, span,
                     span_fn)
 from .device import device_memory_gauges  # noqa: F401
+from . import flight, tracing  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -31,5 +40,7 @@ __all__ = [
     "reset", "enabled", "set_enabled",
     "span", "span_fn", "instant", "dump_trace", "get_trace_events",
     "clear_trace", "set_default_attrs", "current_span",
+    "TraceContext", "TRACEPARENT_HEADER", "REQUEST_ID_HEADER",
+    "tracing", "flight",
     "device_memory_gauges",
 ]

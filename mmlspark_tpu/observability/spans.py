@@ -29,16 +29,30 @@ import time
 from typing import Any, Deque, Dict, Iterator, List, Optional
 
 from . import metrics as _metrics
+from . import tracing as _tracing
 
 __all__ = [
     "span", "span_fn", "instant", "dump_trace", "get_trace_events",
     "clear_trace", "set_default_attrs", "get_default_attrs", "current_span",
-    "MAX_TRACE_EVENTS",
+    "MAX_TRACE_EVENTS", "set_max_trace_events", "get_max_trace_events",
+    "dropped_events",
 ]
 
+
+def _env_cap() -> int:
+    try:
+        n = int(os.environ.get("MMLSPARK_TPU_MAX_TRACE_EVENTS", "")
+                or 100_000)
+    except ValueError:
+        n = 100_000
+    return max(1, n)
+
+
 # Bounded buffer: long-running servers must not grow without limit; the
-# oldest events are dropped once full (dump early, dump often).
-MAX_TRACE_EVENTS = 100_000
+# oldest events are dropped once full (dump early, dump often). Tunable
+# via MMLSPARK_TPU_MAX_TRACE_EVENTS (a week-long serving process sizes
+# this to its memory budget) or set_max_trace_events at runtime.
+MAX_TRACE_EVENTS = _env_cap()
 
 _parent: "contextvars.ContextVar[Optional[_SpanRecord]]" = \
     contextvars.ContextVar("mmlspark_tpu_span_parent", default=None)
@@ -107,12 +121,48 @@ def _pid() -> int:
     return int(idx) if idx is not None else os.getpid()
 
 
+def set_max_trace_events(n: int) -> int:
+    """Resize the bounded event buffer (keeps the newest events); returns
+    the previous cap. Env default: ``MMLSPARK_TPU_MAX_TRACE_EVENTS``."""
+    global _events, _dropped, MAX_TRACE_EVENTS
+    n = max(1, int(n))
+    with _buf_lock:
+        prev = MAX_TRACE_EVENTS
+        kept = list(_events)[-n:]
+        _dropped += len(_events) - len(kept)
+        _events = collections.deque(kept, maxlen=n)
+        MAX_TRACE_EVENTS = n
+    return prev
+
+
+def get_max_trace_events() -> int:
+    return MAX_TRACE_EVENTS
+
+
+def dropped_events() -> int:
+    """Oldest-dropped count since the last :func:`clear_trace` (also
+    exported as the ``trace_events_dropped_total`` counter)."""
+    return _dropped
+
+
 def _record(event: Dict[str, Any]) -> None:
     global _dropped
+    ctx = _tracing.current()
+    if ctx is not None:
+        # stitch key: Chrome-trace dumps from different processes merge
+        # into one logical request by this id
+        args = event.get("args")
+        if args is not None:
+            args.setdefault("trace_id", ctx.trace_id)
+            args.setdefault("span_id", ctx.span_id)
     with _buf_lock:
-        if len(_events) == MAX_TRACE_EVENTS:
+        full = len(_events) == _events.maxlen
+        if full:
             _dropped += 1  # deque maxlen evicts the oldest on append
         _events.append(event)
+    if full:
+        # outside _buf_lock: the registry has its own lock, never nest them
+        _metrics.safe_counter("trace_events_dropped_total").inc()
 
 
 @contextlib.contextmanager
@@ -153,6 +203,10 @@ def span(name: str, metric_label: Optional[str] = None,
         })
         _metrics.safe_histogram("span_duration_seconds",
                                 name=metric_label or name).observe(dur)
+        # flight-recorder feed: span ends are the "what was it doing in
+        # its final seconds" record a crash dump is made of
+        from . import flight as _flight
+        _flight.record("span_end", name=name, dur_us=int(dur * 1e6))
 
 
 def span_fn(name: str, **attrs: Any):

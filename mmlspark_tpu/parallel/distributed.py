@@ -85,6 +85,7 @@ def _tag_spans_with_process_index() -> None:
     multi-host trace dumps separate by process. Backend is safe to touch
     here: jax.distributed.initialize has already run."""
     try:
+        from ..observability import flight as _flight
         from ..observability import metrics as _metrics
         from ..observability import spans as _spans
         if not _metrics.enabled():
@@ -92,7 +93,13 @@ def _tag_spans_with_process_index() -> None:
             # effect — don't pay (or force) backend startup to stamp an
             # attribute the disabled telemetry layer will never record
             return
-        _spans.set_default_attrs(process_index=jax.process_index())
+        idx = jax.process_index()
+        _spans.set_default_attrs(process_index=idx)
+        # same stamp on flight events, so merged post-mortem dumps from
+        # several hosts separate by process the way trace dumps do
+        _flight.set_default_fields(process_index=idx)
+        _flight.record("distributed_init", process_index=idx,
+                       process_count=jax.process_count())
     except Exception:  # noqa: BLE001 — telemetry must never break init
         pass
 

@@ -73,7 +73,7 @@ def test_observability_has_no_top_level_framework_imports():
                 offenders.append(f"{os.path.basename(path)}: "
                                  f"{'.' * level}{mod}")
             elif level == 1 and top not in (
-                    "metrics", "spans", "device", ""):
+                    "metrics", "spans", "device", "tracing", "flight", ""):
                 offenders.append(f"{os.path.basename(path)}: .{mod}")
     assert not offenders, (
         "observability must defer framework imports into function bodies "
@@ -236,6 +236,75 @@ def test_booster_predict_path_takes_trees_as_arguments():
     assert not offenders, (
         "predictor build path must pass trees as packed jit arguments, "
         f"not bake them via jnp.asarray/device_put: {offenders}")
+
+
+def _functions_containing(tree):
+    """Map every AST node to its innermost enclosing function name."""
+    owner = {}
+
+    def walk(node, fn_name):
+        for child in ast.iter_child_nodes(node):
+            name = fn_name
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = child.name
+            owner[child] = name
+            walk(child, name)
+
+    owner[tree] = None
+    walk(tree, None)
+    return owner
+
+
+def test_io_handlers_route_through_shared_response_helper():
+    """Every do_GET/do_POST branch in io/ must emit its response through
+    serving.py's ``write_http_response`` — the shared status-counter
+    funnel — so no handler branch can skip Content-Length, the
+    per-status counters, or future response policy. A raw
+    ``send_response`` call anywhere else under io/ is the violation."""
+    io_dir = os.path.join(_PKG_ROOT, "io")
+    offenders = []
+    seen_helper = False
+    for path in _py_files(io_dir):
+        tree = _parse(path)
+        owner = _functions_containing(tree)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "send_response"):
+                continue
+            fn = owner.get(node)
+            if fn == "write_http_response" and \
+                    os.path.basename(path) == "serving.py":
+                seen_helper = True
+                continue
+            offenders.append((os.path.relpath(path, _PKG_ROOT),
+                              node.lineno, fn))
+    assert seen_helper, "write_http_response helper not found in serving.py"
+    assert not offenders, (
+        "io/ handlers must route responses through "
+        f"serving.write_http_response: {offenders}")
+
+
+def test_trace_header_names_come_from_tracing_module():
+    """The wire contract lives in observability/tracing.py
+    (TRACEPARENT_HEADER / REQUEST_ID_HEADER); a string literal at any
+    other call site can drift per hop and silently break cross-process
+    stitching."""
+    header_names = {"traceparent", "x-request-id"}
+    tracing_py = os.path.join("observability", "tracing.py")
+    offenders = []
+    for path in _py_files(_PKG_ROOT):
+        if os.path.relpath(path, _PKG_ROOT) == tracing_py:
+            continue
+        for node in ast.walk(_parse(path)):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    node.value.strip().lower() in header_names:
+                offenders.append((os.path.relpath(path, _PKG_ROOT),
+                                  node.lineno, node.value))
+    assert not offenders, (
+        "trace header names must come from observability.tracing "
+        f"constants, not literals: {offenders}")
 
 
 if __name__ == "__main__":

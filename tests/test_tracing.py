@@ -1,0 +1,622 @@
+"""End-to-end request tracing, flight recorder, and debug endpoints.
+
+The PR 3 acceptance surface:
+
+* W3C-traceparent encode/decode and contextvar lifecycle;
+* trace propagation edge -> gateway -> worker: one ``trace_id`` in the
+  spans on both sides of the HTTP hop and in the ``X-Request-Id``
+  response header, including across a ``kill_worker`` failover;
+* flight recorder: ring wrap, SIGUSR2 dump, excepthook dump, and the
+  disabled path recording nothing;
+* ``/healthz`` / ``/varz`` / ``/debug/flight`` round-trips on both the
+  serving server and the gateway, inert behind the kill switch
+  (byte-identical handler behavior);
+* satellites: bounded span buffer with dropped-counter, gateway retry
+  counter + flight event, unknown-reply counter.
+"""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mmlspark_tpu.observability import flight, metrics, spans, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    prev = metrics.set_enabled(True)
+    metrics.reset()
+    spans.clear_trace()
+    flight.clear()
+    tracing.clear_exemplars()
+    prev_thresh = tracing.set_slow_threshold(1.0)
+    yield
+    metrics.set_enabled(prev)
+    metrics.reset()
+    spans.clear_trace()
+    flight.clear()
+    tracing.clear_exemplars()
+    tracing.set_slow_threshold(prev_thresh)
+
+
+def _get(host, port, path, headers=None):
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    conn.request("GET", path, headers=headers or {})
+    r = conn.getresponse()
+    body = r.read()
+    hdrs = dict(r.getheaders())
+    conn.close()
+    return r.status, body, hdrs
+
+
+def _post(host, port, path, payload, headers=None):
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    conn.request("POST", path, body=json.dumps(payload),
+                 headers=headers or {})
+    r = conn.getresponse()
+    body = r.read()
+    hdrs = dict(r.getheaders())
+    conn.close()
+    return r.status, json.loads(body) if body else None, hdrs
+
+
+def _echo_transform(ds):
+    return ds.with_column(
+        "reply", [{"entity": {"y": (v or {}).get("x", 0.0)},
+                   "statusCode": 200} for v in ds["value"]])
+
+
+TRACE_ID = "ab" * 16
+PARENT_SPAN = "cd" * 8
+TRACEPARENT = f"00-{TRACE_ID}-{PARENT_SPAN}-01"
+
+
+# ---------------------------------------------------------------------------
+# TraceContext + header codec
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_format_parse_round_trip(self):
+        ctx = tracing.new_context()
+        parsed = tracing.parse_traceparent(tracing.format_traceparent(ctx))
+        assert parsed.trace_id == ctx.trace_id
+        assert parsed.span_id == ctx.span_id
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-short-short-01",
+        f"ff-{TRACE_ID}-{PARENT_SPAN}-01",          # forbidden version
+        f"00-{'0' * 32}-{PARENT_SPAN}-01",          # all-zero trace id
+        f"00-{TRACE_ID}-{'0' * 16}-01",             # all-zero span id
+        f"00-{TRACE_ID.upper()}!-{PARENT_SPAN}-01",
+    ])
+    def test_parse_is_total(self, bad):
+        assert tracing.parse_traceparent(bad) is None
+
+    def test_extraction_derives_child(self):
+        ctx = tracing.context_from_headers({"traceparent": TRACEPARENT})
+        assert ctx.trace_id == TRACE_ID
+        assert ctx.parent_id == PARENT_SPAN
+        assert ctx.span_id != PARENT_SPAN       # this hop's own span
+
+    def test_extraction_adopts_request_id_header(self):
+        ctx = tracing.context_from_headers({"x-request-id": TRACE_ID})
+        assert ctx.trace_id == TRACE_ID
+        # non-hex request ids start a fresh trace instead
+        ctx2 = tracing.context_from_headers({"x-request-id": "req-42"})
+        assert ctx2.trace_id != TRACE_ID and len(ctx2.trace_id) == 32
+
+    def test_extraction_none_when_disabled(self):
+        metrics.set_enabled(False)
+        assert tracing.context_from_headers(
+            {"traceparent": TRACEPARENT}) is None
+
+    def test_activate_is_scoped(self):
+        assert tracing.current() is None
+        with tracing.use(tracing.new_context()) as ctx:
+            assert tracing.current() is ctx
+            assert tracing.outbound_headers() == {
+                tracing.TRACEPARENT_HEADER: tracing.format_traceparent(ctx)}
+        assert tracing.current() is None
+        assert tracing.outbound_headers() == {}
+
+    def test_spans_stamp_trace_ids(self):
+        with tracing.use(tracing.new_context()) as ctx:
+            with spans.span("traced_work"):
+                pass
+        (ev,) = [e for e in spans.get_trace_events()
+                 if e["name"] == "traced_work"]
+        assert ev["args"]["trace_id"] == ctx.trace_id
+        assert ev["args"]["span_id"] == ctx.span_id
+
+
+class TestSlowExemplars:
+    def test_threshold_gates_recording(self):
+        tracing.set_slow_threshold(10.0)
+        assert not tracing.maybe_mark_slow("m_seconds", 0.5, api="a")
+        assert tracing.get_exemplars() == []
+        tracing.set_slow_threshold(0.1)
+        with tracing.use(tracing.new_context()) as ctx:
+            assert tracing.maybe_mark_slow("m_seconds", 0.5, api="a")
+        (ex,) = tracing.get_exemplars()
+        assert ex["trace_id"] == ctx.trace_id
+        assert ex["labels"] == {"api": "a"}
+        assert metrics.counter("slow_requests_total",
+                               metric="m_seconds").value == 1.0
+        assert [e["kind"] for e in flight.events()] == ["slow_request"]
+
+    def test_disabled_is_inert(self):
+        tracing.set_slow_threshold(0.0)
+        metrics.set_enabled(False)
+        assert not tracing.maybe_mark_slow("m_seconds", 9.9)
+        assert tracing.get_exemplars() == []
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_record_round_trip_with_trace(self):
+        with tracing.use(tracing.new_context()) as ctx:
+            flight.record("unit_event", detail=7)
+        (ev,) = flight.events()
+        assert ev["kind"] == "unit_event" and ev["detail"] == 7
+        assert ev["trace_id"] == ctx.trace_id
+        assert ev["ts"] > 0 and ev["seq"] == 1
+
+    def test_ring_wraps_keeping_newest(self):
+        prev = flight.set_capacity(8)
+        try:
+            for i in range(20):
+                flight.record("w", i=i)
+            evs = flight.events()
+            assert len(evs) == 8
+            assert [e["i"] for e in evs] == list(range(12, 20))
+            assert flight.dropped() == 12
+            snap = flight.snapshot()
+            assert snap["dropped"] == 12 and snap["capacity"] == 8
+        finally:
+            flight.set_capacity(prev)
+
+    def test_disabled_records_nothing(self):
+        metrics.set_enabled(False)
+        flight.record("ghost")
+        assert flight.events() == []
+
+    def test_default_fields_stamped(self):
+        flight.set_default_fields(role="test_worker")
+        try:
+            flight.record("stamped")
+            assert flight.events()[0]["role"] == "test_worker"
+        finally:
+            flight.set_default_fields(role=None)
+
+    def test_dump_writes_valid_json(self, tmp_path):
+        flight.record("pre_dump", payload=b"bytes are repr()d")
+        path = flight.dump(str(tmp_path / "f.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["pid"] == os.getpid()
+        assert [e["kind"] for e in doc["events"]] == ["pre_dump"]
+
+    def test_sigusr2_dump(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_FLIGHT_DIR", str(tmp_path))
+        flight.install()
+        try:
+            flight.record("before_signal")
+            signal.raise_signal(signal.SIGUSR2)
+            dumps = [p for p in os.listdir(tmp_path)
+                     if p.startswith("flight-")]
+            assert len(dumps) == 1
+            with open(tmp_path / dumps[0]) as f:
+                doc = json.load(f)
+            kinds = [e["kind"] for e in doc["events"]]
+            assert kinds[0] == "before_signal"
+            assert "signal_dump" in kinds
+        finally:
+            flight.uninstall()
+
+    def test_excepthook_dump_chains(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_FLIGHT_DIR", str(tmp_path))
+        seen = []
+        prev = sys.excepthook
+        sys.excepthook = lambda *a: seen.append(a)
+        try:
+            flight.install()
+            try:
+                raise RuntimeError("boom")
+            except RuntimeError:
+                sys.excepthook(*sys.exc_info())
+            assert len(seen) == 1            # previous hook still ran
+            kinds = [e["kind"] for e in flight.events()]
+            assert "unhandled_exception" in kinds
+            assert any(p.startswith("flight-")
+                       for p in os.listdir(tmp_path))
+        finally:
+            flight.uninstall()
+            sys.excepthook = prev
+
+    def test_env_capacity_fresh_interpreter(self):
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "from mmlspark_tpu.observability import flight, spans\n"
+             "assert flight.capacity() == 17, flight.capacity()\n"
+             "assert spans.get_max_trace_events() == 23\n"
+             "print('env ok')"],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "MMLSPARK_TPU_FLIGHT_EVENTS": "17",
+                 "MMLSPARK_TPU_MAX_TRACE_EVENTS": "23"},
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        assert proc.returncode == 0, proc.stderr
+        assert "env ok" in proc.stdout
+
+
+class TestStageErrorsReachFlight:
+    def test_failing_stage_records_error_event(self):
+        from mmlspark_tpu.core.dataset import Dataset
+        from mmlspark_tpu.core.pipeline import Transformer
+
+        class _Boom(Transformer):
+            def transform(self, dataset):
+                raise ValueError("bad batch")
+
+        with pytest.raises(ValueError):
+            _Boom().transform(Dataset({"x": [1.0]}))
+        errs = [e for e in flight.events() if e["kind"] == "error"]
+        assert errs and errs[0]["stage"] == "_Boom"
+        assert "bad batch" in errs[0]["error"]
+
+
+class TestSpanBufferBound:
+    def test_cap_resize_and_dropped_counter(self):
+        prev = spans.set_max_trace_events(16)
+        try:
+            for i in range(40):
+                with spans.span(f"s_{i}"):
+                    pass
+            evs = spans.get_trace_events()
+            assert len(evs) == 16
+            assert evs[-1]["name"] == "s_39"     # newest kept
+            assert spans.dropped_events() >= 24
+            assert metrics.counter(
+                "trace_events_dropped_total").value >= 24
+        finally:
+            spans.set_max_trace_events(prev)
+            spans.clear_trace()
+
+
+# ---------------------------------------------------------------------------
+# Serving edge: header echo + debug endpoints
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def serving_query():
+    from mmlspark_tpu.io.serving import serve
+
+    q = (serve().address("localhost", 0, "traced")
+         .batch(max_batch=8, max_latency_ms=5)
+         .transform(_echo_transform).start())
+    yield q
+    q.stop()
+
+
+class TestServingEdge:
+    def test_response_echoes_request_id(self, serving_query):
+        host, port = serving_query.server.host, serving_query.server.port
+        status, body, hdrs = _post(host, port, "/traced", {"x": 1.0},
+                                   {"traceparent": TRACEPARENT})
+        assert status == 200 and body == {"y": 1.0}
+        assert hdrs["X-Request-Id"] == TRACE_ID
+        # no traceparent: a fresh 32-hex id is minted
+        status, _, hdrs = _post(host, port, "/traced", {"x": 2.0})
+        assert status == 200
+        assert len(hdrs["X-Request-Id"]) == 32
+        ev = [e for e in spans.get_trace_events()
+              if e["name"] == "serving_request"
+              and e["args"].get("trace_id") == TRACE_ID]
+        assert ev, "edge span must carry the caller's trace id"
+        # the batch worker thread re-activates the request's context, so
+        # the model-side span stitches to the same trace despite the
+        # queue's thread boundary
+        tr = [e for e in spans.get_trace_events()
+              if e["name"] == "serving_transform"
+              and e["args"].get("trace_id") == TRACE_ID]
+        assert tr and TRACE_ID in tr[0]["args"]["trace_ids"]
+
+    def test_debug_endpoints_round_trip(self, serving_query):
+        host, port = serving_query.server.host, serving_query.server.port
+        status, body, hdrs = _get(host, port, "/healthz")
+        health = json.loads(body)
+        assert status == 200 and health["status"] in ("ok", "degraded")
+        assert "devices" in health
+        assert hdrs["Content-Type"] == "application/json"
+
+        status, body, _ = _get(host, port, "/varz")
+        varz = json.loads(body)
+        assert status == 200
+        assert varz["build"]["version"]
+        assert varz["config"]["api_name"] == "traced"
+        assert isinstance(varz["metrics"], dict)
+        assert "exemplars" in varz
+
+        flight.record("endpoint_marker", n=3)
+        status, body, _ = _get(host, port, "/debug/flight")
+        snap = json.loads(body)
+        assert status == 200 and snap["pid"] == os.getpid()
+        assert any(e["kind"] == "endpoint_marker" for e in snap["events"])
+
+        # api-prefixed aliases answer too
+        for path in ("/traced/healthz", "/traced/varz",
+                     "/traced/debug/flight"):
+            status, _, _ = _get(host, port, path)
+            assert status == 200, path
+
+    def test_debug_endpoints_count_requests(self, serving_query):
+        host, port = serving_query.server.host, serving_query.server.port
+        _get(host, port, "/healthz")
+        assert metrics.counter("debug_requests_total", api="traced",
+                               endpoint="healthz", code="200").value == 1.0
+
+    def test_disabled_routes_fall_through_byte_identical(self,
+                                                         serving_query):
+        """Kill switch off: /healthz etc. reach the user transform exactly
+        like any other path — same body, no X-Request-Id, nothing
+        recorded."""
+        host, port = serving_query.server.host, serving_query.server.port
+        metrics.set_enabled(False)
+        for path in ("/healthz", "/varz", "/debug/flight", "/metrics"):
+            status, body, hdrs = _get(host, port, path)
+            assert status == 200
+            assert json.loads(body) == {"y": 0.0}, path  # the echo reply
+            assert "X-Request-Id" not in hdrs
+        assert flight.events() == []
+        metrics.set_enabled(True)
+        assert metrics.get_registry().snapshot() == {}
+
+    def test_unknown_reply_counted(self, serving_query):
+        server = serving_query.server
+        assert not server.reply("no_such_request", {"y": 0})
+        assert metrics.counter("serving_reply_unknown_total",
+                               api="traced").value == 1.0
+        assert any(e["kind"] == "reply_unknown"
+                   and e["request_id"] == "no_such_request"
+                   for e in flight.events())
+
+    def test_slow_request_exemplar_from_live_request(self, serving_query):
+        tracing.set_slow_threshold(0.0)      # every request is "slow"
+        host, port = serving_query.server.host, serving_query.server.port
+        _post(host, port, "/traced", {"x": 1.0},
+              {"traceparent": TRACEPARENT})
+        exs = [e for e in tracing.get_exemplars()
+               if e["metric"] == "serving_request_seconds"]
+        assert exs and exs[-1]["trace_id"] == TRACE_ID
+
+
+# ---------------------------------------------------------------------------
+# Distributed: edge -> gateway -> worker propagation
+# ---------------------------------------------------------------------------
+
+
+class TestDistributedPropagation:
+    def test_one_trace_id_across_gateway_and_worker(self):
+        from mmlspark_tpu.io.distributed_serving import DistributedServing
+
+        d = DistributedServing(_echo_transform, num_workers=2).start()
+        try:
+            status, body, hdrs = _post(
+                d.gateway.host, d.gateway.port, "/serving", {"x": 5.0},
+                {"traceparent": TRACEPARENT})
+            assert status == 200 and body == {"y": 5.0}
+            assert hdrs["X-Request-Id"] == TRACE_ID
+
+            evs = spans.get_trace_events()
+            gw = [e for e in evs if e["name"] == "gateway_request"
+                  and e["args"].get("trace_id") == TRACE_ID]
+            wk = [e for e in evs if e["name"] == "serving_request"
+                  and e["args"].get("trace_id") == TRACE_ID]
+            assert gw and wk, "both hops must stamp the same trace id"
+            # distinct hop span ids: the worker is a child, not a clone
+            assert gw[0]["args"]["span_id"] != wk[0]["args"]["span_id"]
+        finally:
+            d.stop()
+
+    def test_merged_chrome_dump_stitches_one_trace(self, tmp_path):
+        from mmlspark_tpu.io.distributed_serving import DistributedServing
+
+        d = DistributedServing(_echo_transform, num_workers=2).start()
+        try:
+            _post(d.gateway.host, d.gateway.port, "/serving", {"x": 1.0},
+                  {"traceparent": TRACEPARENT})
+        finally:
+            d.stop()
+        path = spans.dump_trace(str(tmp_path / "merged.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        stitched = [e for e in doc["traceEvents"]
+                    if e.get("args", {}).get("trace_id") == TRACE_ID]
+        names = {e["name"] for e in stitched}
+        assert {"gateway_request", "serving_request"} <= names
+
+    def test_trace_survives_failover(self):
+        from mmlspark_tpu.io.distributed_serving import DistributedServing
+
+        d = DistributedServing(_echo_transform, num_workers=2).start()
+        try:
+            _post(d.gateway.host, d.gateway.port, "/serving", {"x": 0.0})
+            killed = d.kill_worker(0)
+            ok = 0
+            for i in range(10):
+                status, body, hdrs = _post(
+                    d.gateway.host, d.gateway.port, "/serving",
+                    {"x": float(i)}, {"traceparent": TRACEPARENT})
+                if status == 200:
+                    ok += 1
+                    assert hdrs["X-Request-Id"] == TRACE_ID
+            assert ok == 10, "failover must preserve the trace contract"
+            # the satellite: silent failovers become visible
+            retries = metrics.get_registry().snapshot().get(
+                "gateway_retries_total")
+            assert retries is not None
+            assert all(s["labels"].get("reason")
+                       for s in retries["series"])
+            failover_events = [e for e in flight.events()
+                               if e["kind"] == "gateway_failover"]
+            assert any(e["worker"] == killed.worker_id
+                       for e in failover_events)
+        finally:
+            d.stop()
+
+    def test_gateway_debug_endpoints(self):
+        from mmlspark_tpu.io.distributed_serving import (DistributedServing)
+
+        d = DistributedServing(_echo_transform, num_workers=1).start()
+        try:
+            host, port = d.gateway.host, d.gateway.port
+            status, body, _ = _get(host, port, "/healthz")
+            assert status == 200
+            assert json.loads(body)["status"] in ("ok", "degraded")
+            status, body, _ = _get(host, port, "/varz")
+            assert status == 200 and "build" in json.loads(body)
+            status, body, _ = _get(host, port, "/debug/flight")
+            assert status == 200 and "events" in json.loads(body)
+            # disabled: the gateway proxies these paths to a worker like
+            # any other request (the echo transform answers)
+            metrics.set_enabled(False)
+            status, body, hdrs = _get(host, port, "/healthz")
+            assert status == 200 and json.loads(body) == {"y": 0.0}
+            assert "X-Request-Id" not in hdrs
+            metrics.set_enabled(True)
+        finally:
+            d.stop()
+
+
+_WORKER_SCRIPT = r"""
+import signal, sys, threading
+from mmlspark_tpu.io.serving import ServingQuery, ServingServer
+from mmlspark_tpu.io.distributed_serving import ServiceRegistry, WorkerInfo
+from mmlspark_tpu.observability import spans
+
+def echo(ds):
+    return ds.with_column(
+        "reply", [{"entity": {"y": (v or {}).get("x", 0.0)},
+                   "statusCode": 200} for v in ds["value"]])
+
+server = ServingServer("localhost", 0, "serving")
+q = ServingQuery(server, echo, max_batch=8, max_latency=0.005).start()
+ServiceRegistry(sys.argv[1]).register(
+    WorkerInfo("wsub", "localhost", server.port, "serving"))
+stop = threading.Event()
+signal.signal(signal.SIGTERM, lambda *a: stop.set())
+print("ready", flush=True)
+stop.wait()
+spans.dump_trace(sys.argv[2])
+q.stop()
+"""
+
+
+class TestMultiProcessPropagation:
+    @pytest.mark.slow
+    def test_trace_stitches_across_a_process_boundary(
+            self, tmp_path, cpu_subprocess_env):
+        """The real thing: the worker lives in another PROCESS behind the
+        gateway; its trace dump, merged with ours, still stitches into
+        one trace_id — the cross-process contract the traceparent hop
+        carries."""
+        from mmlspark_tpu.io.distributed_serving import (GatewayServer,
+                                                         ServiceRegistry)
+
+        reg_dir = str(tmp_path / "reg")
+        worker_dump = str(tmp_path / "worker_trace.json")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _WORKER_SCRIPT, reg_dir, worker_dump],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=dict(cpu_subprocess_env))
+        gateway = None
+        try:
+            line = proc.stdout.readline()
+            assert "ready" in line, line
+            gateway = GatewayServer(ServiceRegistry(reg_dir),
+                                    "localhost", 0, "serving").start()
+            status, body, hdrs = _post(
+                gateway.host, gateway.port, "/serving", {"x": 4.0},
+                {"traceparent": TRACEPARENT})
+            assert status == 200 and body == {"y": 4.0}
+            assert hdrs["X-Request-Id"] == TRACE_ID
+        finally:
+            if gateway is not None:
+                gateway.stop()
+            proc.terminate()
+            proc.wait(timeout=30)
+
+        # merge this process's dump with the worker's: one stitched trace
+        gw_path = spans.dump_trace(str(tmp_path / "gateway_trace.json"))
+        merged = []
+        for path in (gw_path, worker_dump):
+            with open(path) as f:
+                merged.extend(json.load(f)["traceEvents"])
+        stitched = {e["name"]: e for e in merged
+                    if e.get("args", {}).get("trace_id") == TRACE_ID}
+        assert {"gateway_request", "serving_request"} <= set(stitched)
+        # two processes, two Chrome-trace pid tracks, one trace id
+        assert stitched["gateway_request"]["pid"] != \
+            stitched["serving_request"]["pid"]
+
+
+# ---------------------------------------------------------------------------
+# serving_main deployment entrypoint wiring
+# ---------------------------------------------------------------------------
+
+
+class TestServingMainWiring:
+    @pytest.mark.slow
+    def test_gateway_process_installs_flight_and_healthz(
+            self, tmp_path, cpu_subprocess_env):
+        """A real `serving_main gateway` process answers /healthz and
+        dumps its flight ring on SIGUSR2 (the wedged-process recipe)."""
+        env = dict(cpu_subprocess_env)
+        env["MMLSPARK_TPU_FLIGHT_DIR"] = str(tmp_path / "dumps")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "mmlspark_tpu.io.serving_main",
+             "gateway", "--registry", str(tmp_path / "reg"),
+             "--host", "localhost", "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+        try:
+            line = proc.stdout.readline()
+            assert "gateway on" in line, line
+            port = int(line.rsplit(":", 1)[1])
+            deadline = time.monotonic() + 30
+            status = None
+            while time.monotonic() < deadline:
+                try:
+                    status, body, _ = _get("localhost", port, "/healthz")
+                    break
+                except OSError:
+                    time.sleep(0.2)
+            assert status == 200, "gateway /healthz did not come up"
+            assert json.loads(body)["status"] in ("ok", "degraded")
+            proc.send_signal(signal.SIGUSR2)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                dumps = (os.listdir(tmp_path / "dumps")
+                         if (tmp_path / "dumps").exists() else [])
+                if dumps:
+                    break
+                time.sleep(0.2)
+            assert dumps, "SIGUSR2 must produce a flight dump"
+        finally:
+            proc.terminate()
+            proc.wait(timeout=30)
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))
