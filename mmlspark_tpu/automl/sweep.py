@@ -27,6 +27,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..core.dataset import Dataset
+from ..parallel.compat import shard_map
 
 # estimator param -> GrowConfig field. All are used only inside jnp ops in
 # growth.py (verified: no Python-level branching), so they can be traced.
@@ -55,8 +56,12 @@ def _eligible(est, param_maps: List[Dict[str, Any]]) -> bool:
     if (g("baggingFraction") < 1.0 or g("posBaggingFraction") < 1.0
             or g("negBaggingFraction") < 1.0 or g("featureFraction") < 1.0):
         return False
-    if g("useQuantizedGrad") or g("histSubtraction"):
+    if g("useQuantizedGrad"):
         return False
+    # histSubtraction is NOT gated here: it is tri-state ("auto" default,
+    # resolved per backend) and only ENGAGES above the growth layer's row
+    # threshold — swept_fit applies that engagement rule once the row
+    # count is known, so default-config sweeps keep the vmapped fast path
     if g("earlyStoppingRound") > 0 or g("isProvideTrainingMetric"):
         return False
     if g("modelString") or g("checkpointDir") or g("initScoreCol"):
@@ -116,6 +121,18 @@ def swept_fit(est, param_maps: List[Dict[str, Any]],
     if not _eligible(est, param_maps):
         return None
     X, y, w = est._extract_arrays(train)
+    base_cfg: GrowConfig = est._grow_config()   # "auto" already resolved
+    # subtraction would actually engage inside the trials (single-device
+    # rule, resolved config): fall back to sequential fits so the sweep
+    # takes exactly the code path — and the memory profile — a plain
+    # est.fit() would. The engagement row count is the PADDED dataset size
+    # (trials grow on replicated padded rows, not len(y)); below the
+    # threshold the resolved flag is inert and the envelope is unchanged.
+    from ..models.gbdt.growth import _use_subtraction
+    nshards = meshlib.num_shards(meshlib.get_default_mesh())
+    n_pad = -(-len(y) // nshards) * nshards
+    if _use_subtraction(base_cfg, None, n_pad):
+        return None
     objinfo = _objective_of(est, y)
     if objinfo is None:
         return None
@@ -123,8 +140,6 @@ def swept_fit(est, param_maps: List[Dict[str, Any]],
     obj = get_objective(objective, 1, **obj_kwargs)
     if obj.num_scores != 1:
         return None
-
-    base_cfg: GrowConfig = est._grow_config()
     max_bin = est.get_or_default("maxBin")
     num_iterations = est.get_or_default("numIterations")
     ds = _cached_binned_dataset(
@@ -189,7 +204,7 @@ def swept_fit(est, param_maps: List[Dict[str, Any]],
 
         return jax.vmap(one)(*hp_vals)        # pytree: [T_pad/D, iters, ...]
 
-    fit_all = jax.jit(jax.shard_map(
+    fit_all = jax.jit(shard_map(
         local, mesh=mesh,
         in_specs=(P(), P(), P(), P()) + (P(axis),) * len(fields),
         out_specs=P(axis), check_vma=False))

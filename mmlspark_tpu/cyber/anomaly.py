@@ -97,6 +97,7 @@ def als_fit(user_idx: np.ndarray, item_idx: np.ndarray, rating: np.ndarray,
     from jax.sharding import PartitionSpec as P
 
     from ..parallel import mesh as meshlib
+    from ..parallel.compat import shard_map
 
     nnz = len(rating)
     key = jax.random.PRNGKey(seed)
@@ -189,7 +190,7 @@ def als_fit(user_idx: np.ndarray, item_idx: np.ndarray, rating: np.ndarray,
 
     if mesh is not None and nshards > 1:
         axis = data_axis
-        fitted = jax.jit(jax.shard_map(
+        fitted = jax.jit(shard_map(
             lambda x, y, ul, il, rl, wl: run(x, y, ul, il, rl, wl, axis),
             mesh=mesh,
             in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis)),

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...parallel.ring_attention import (ring_attention,
                                         zigzag_ring_attention)
+from ...parallel.compat import axis_size as compat_axis_size, shard_map
 from ...parallel.ulysses import ulysses_attention
 
 
@@ -123,7 +124,7 @@ def forward_local(params, tokens, cfg: TransformerConfig,
     tokens: [B_local, S_local] int32. Axes: data/seq/model as module docstring.
     """
     H, Dh, E = cfg.n_heads, cfg.d_head, cfg.d_model
-    tp = lax.axis_size("model")
+    tp = compat_axis_size("model")
     sp_idx = lax.axis_index("seq")
     Hl = H // tp
     B, S = tokens.shape
@@ -136,7 +137,7 @@ def forward_local(params, tokens, cfg: TransformerConfig,
         # the global sequence (tokens/targets must be pre-permuted with
         # parallel.ring_attention.zigzag_permute) — slice the positional
         # table accordingly
-        n_sp = lax.axis_size("seq")
+        n_sp = compat_axis_size("seq")
         C = S // 2
         p1 = lax.dynamic_slice_in_dim(params["pos"], sp_idx * C, C, axis=0)
         p2 = lax.dynamic_slice_in_dim(
@@ -198,7 +199,7 @@ def forward_local(params, tokens, cfg: TransformerConfig,
 def sharded_xent(logits_local, targets, cfg: TransformerConfig):
     """Cross-entropy over vocab-sharded logits (stable log-sum-exp with
     pmax/psum over 'model'); mean over all tokens via pmean over data x seq."""
-    tp = lax.axis_size("model")
+    tp = compat_axis_size("model")
     v_local = cfg.vocab_size // tp
     v0 = lax.axis_index("model") * v_local
     # stability shift only — constant w.r.t. differentiation (pmax has no JVP,
@@ -278,7 +279,7 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh, lr: float = 1e-3):
 
     opt_specs = {"mu": specs, "nu": specs, "count": P()}
     data_spec = P("data", "seq")
-    fn = jax.shard_map(
+    fn = shard_map(
         step_local, mesh=mesh,
         in_specs=(specs, opt_specs, data_spec, data_spec),
         out_specs=(specs, opt_specs, P()),
@@ -294,7 +295,7 @@ def make_forward(cfg: TransformerConfig, mesh: Mesh, causal: bool = True):
         logits_local = forward_local(params, tokens, cfg, causal=causal)
         return logits_local
 
-    fn = jax.shard_map(
+    fn = shard_map(
         fwd_local, mesh=mesh,
         in_specs=(specs, P("data", "seq")),
         out_specs=P("data", "seq", "model"),

@@ -30,11 +30,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ...observability import flight as _flight
 from ...observability import metrics as _metrics
 from ...observability import spans as _spans
+from ...utils import compile_cache as _compile_cache
 from ...ops.binning import QuantileBinner, bin_cols_device
 from ...parallel import mesh as meshlib
+from ...parallel.compat import shard_map
 from .growth import (GrowConfig, Tree, bitset_words, grow_tree,
                      grow_tree_depthwise, predict_forest_raw,
-                     predict_tree_binned)
+                     predict_tree_binned, resolve_growth_backend)
 from .objectives import (HIGHER_IS_BETTER, Objective, eval_metric,
                          get_objective, score_transform)
 
@@ -50,6 +52,10 @@ def _cached_program(key, build):
     """Get-or-build a compiled program in the bounded LRU step cache."""
     prog = _STEP_CACHE.get(key)
     if prog is None:
+        # wire the persistent compile cache before ANY cached program is
+        # built — dataset construction (bin_cols, synth masks) builds
+        # programs before train_booster's own ensure() runs
+        _compile_cache.ensure()
         t0 = time.perf_counter()
         prog = build()
         # compile event: XLA hands this cache jitted programs that compile
@@ -57,7 +63,8 @@ def _cached_program(key, build):
         # cache (below) is the one that observes real compile wall time
         _flight.record("program_build", cache="gbdt_step",
                        key=repr(key),
-                       seconds=round(time.perf_counter() - t0, 6))
+                       seconds=round(time.perf_counter() - t0, 6),
+                       persistent_cache=_compile_cache.cache_dir() or "")
         _metrics.safe_counter("gbdt_program_builds_total",
                               cache="gbdt_step").inc()
         _STEP_CACHE[key] = prog
@@ -289,8 +296,13 @@ class _ObservedProgram:
         _metrics.safe_counter("gbdt_compiles_total", cache="predict").inc()
         _metrics.safe_histogram("gbdt_compile_seconds",
                                 cache="predict").observe(dt)
+        # persistent_cache: the active MMLSPARK_TPU_COMPILE_CACHE_DIR ("" =
+        # off). With a warm dir, `seconds` is the disk fetch, not an XLA
+        # compile — persistent_compile_cache_hits_total counts those.
         _flight.record("compile", cache="predict", key=repr(self._key),
-                       seconds=round(dt, 6), **cost)
+                       seconds=round(dt, 6),
+                       persistent_cache=_compile_cache.cache_dir() or "",
+                       **cost)
         return fn
 
 
@@ -391,7 +403,7 @@ def _device_tile_scores(base_d, n_pad: int, K: int, mesh: Mesh):
 def _bin_program(x_shape, max_bin: int, mesh: Mesh, bin_dtype=jnp.int32):
     return _cached_program(
         ("bin_cols", x_shape, max_bin, mesh, jnp.dtype(bin_dtype).name),
-        lambda: jax.jit(jax.shard_map(
+        lambda: jax.jit(shard_map(
             lambda X, ub: bin_cols_device(X, ub, out_dtype=bin_dtype),
             mesh=mesh,
             in_specs=(P("data", None), P()), out_specs=P(None, "data"),
@@ -723,6 +735,7 @@ class Booster:
         :func:`_from_device`): tree-sum, base-score add and the objective
         transform are fused into the cached executable.
         """
+        _compile_cache.ensure()
         X = np.asarray(X, dtype=np.float32)
         if num_iteration is None or num_iteration < 0:
             num_iteration = self.num_iterations
@@ -1262,6 +1275,16 @@ def train_booster(
     ``mesh`` are taken from the dataset (``X`` may still be passed alongside
     for ``init_booster`` warm starts, which score raw rows).
     """
+    # persistent compile cache (MMLSPARK_TPU_COMPILE_CACHE_DIR): wire it
+    # before the first program of this fit traces, so serving workers and
+    # repeat CLI fits skip the cold multi-second XLA compile
+    _compile_cache.ensure()
+    # resolve backend-adaptive tri-states ("auto" hist_subtraction /
+    # compact_selector) to concrete values up front: cfg flows into the
+    # checkpoint fingerprint and every compiled-program cache key below,
+    # and an unresolved sentinel there would alias programs across
+    # backends (lint-pinned in tests/test_lint.py)
+    cfg = resolve_growth_backend(cfg or GrowConfig())
     if dataset is not None and checkpoint_dir is not None:
         raise ValueError(
             "checkpointDir requires raw X/y arrays (the resume fingerprint "
@@ -1378,7 +1401,7 @@ def train_booster(
     resume_state: Optional[dict] = None
     if checkpoint_dir is not None:
         from ...utils.checkpoint import CheckpointManager, data_fingerprint
-        cfg_norm = (cfg or GrowConfig())._replace(num_bins=max_bin)
+        cfg_norm = cfg._replace(num_bins=max_bin)
         ckpt_fingerprint = data_fingerprint(
             np.asarray(X, np.float32), np.asarray(y, np.float32),
             None if weight is None else np.asarray(weight, np.float32),
@@ -1418,7 +1441,6 @@ def train_booster(
                                          prior + num_iterations)
 
     tw = _PhaseTimer()
-    cfg = cfg or GrowConfig()
     if boosting_type == "rf":
         # random forest: no shrinkage; the averaged ensemble is scaled at
         # finalize time instead (LightGBM rf semantics)
@@ -1657,8 +1679,13 @@ def train_booster(
 
     dummy = np.zeros((), np.float32)
     # cache the compiled step across train_booster calls: the closure is fresh
-    # per call, so jit's identity-keyed cache would otherwise recompile
-    cache_key = (cfg, K, objective, tuple(sorted(objective_kwargs.items())),
+    # per call, so jit's identity-keyed cache would otherwise recompile.
+    # The resolved histogram engine keys the cache too: engine selection is
+    # trace-time static (env/backend), so an MMLSPARK_TPU_HIST_ENGINE flip
+    # mid-process must build a new program, not reuse the old engine's.
+    from ...ops.histogram import resolve_engine as _resolve_hist_engine
+    cache_key = (_resolve_hist_engine(),
+                 cfg, K, objective, tuple(sorted(objective_kwargs.items())),
                  tuple(np.flatnonzero(is_cat_np).tolist()),
                  Xbt_d.shape, None if not has_valid else Xvb_d.shape,
                  use_bagging, bagging_fraction, bagging_freq,
@@ -1675,9 +1702,24 @@ def train_booster(
         # one flat download buffer instead of 13 per-field transfers
         return scores, vscores, pack_trees(trees_stacked), metrics
 
-    step = _cached_program(cache_key, lambda: jax.jit(jax.shard_map(
+    # donate the per-round score buffers: the host loop immediately rebinds
+    # scores_d/vscores_d to the step outputs, so XLA can update them in
+    # place instead of allocating + copying a fresh [n_pad, K] in HBM every
+    # boosting round. vscores (arg 8) only when real — without validation
+    # that slot holds a shared dummy scalar whose shape matches no output,
+    # and donating it would just warn per call. ACCELERATORS ONLY: on the
+    # XLA CPU backend donating these sharded shard_map buffers produced
+    # nondeterministic heap corruption (review-reproduced: ~40% of
+    # test_histogram_engines runs segfaulted mid-host-loop on jax 0.4.37;
+    # 0/6 with donation off), and host-RAM copies are not the bottleneck
+    # the donation targets anyway.
+    if jax.default_backend() == "cpu":
+        donate = ()
+    else:
+        donate = (4, 8) if has_valid else (4,)
+    step = _cached_program(cache_key, lambda: jax.jit(shard_map(
         step_packed, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-        check_vma=False)))
+        check_vma=False), donate_argnums=donate))
 
     all_trees: List[Tree] = []
     history: Dict[str, List[float]] = {metric_name: []}
@@ -1736,7 +1778,7 @@ def train_booster(
                 # one flat download buffer instead of 13 per-field transfers
                 return pack_trees(trees_seq)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(shard_map(
                 multi_local, mesh=mesh,
                 in_specs=(col_spec, row_spec, row_spec, row_spec, row2_spec),
                 out_specs=P(), check_vma=False))
@@ -1809,7 +1851,7 @@ def train_booster(
                                       num_iterations, early_stopping_rounds,
                                       higher_is_better, True, tol=es_tol)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(shard_map(
                 multi_local, mesh=mesh,
                 in_specs=(col_spec, row_spec, row_spec, row_spec, row2_spec,
                           row2_spec, row_spec, row_spec, row2_spec),
@@ -2019,8 +2061,10 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
     c_spec = P(None, "data", None)
     # compiled-step cache, same rationale as the gbdt path: the closures are
     # fresh per fit() call, so jit's identity-keyed cache would recompile on
-    # every trial of a sweep
-    cache_key = ("dart", cfg, K, objective,
+    # every trial of a sweep (the resolved histogram engine keys it for the
+    # same reason as the gbdt step cache)
+    from ...ops.histogram import resolve_engine as _resolve_hist_engine
+    cache_key = ("dart", _resolve_hist_engine(), cfg, K, objective,
                  tuple(sorted(objective_kwargs.items())),
                  None if is_cat_j is None
                  else tuple(np.flatnonzero(np.asarray(is_cat_j)).tolist()),
@@ -2030,14 +2074,14 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
                  feature_fraction, depth_cap, metric_name,
                  tuple(np.asarray(base).tolist()), mesh)
     def build_dart():
-        dstep = jax.jit(jax.shard_map(
+        dstep = jax.jit(shard_map(
             dart_step_local, mesh=mesh,
             in_specs=(col_spec, row_spec, row_spec, row_spec, c_spec, P(),
                       row2_spec if has_valid else P(),
                       c_spec if has_valid else P(), P(), P(), P()),
             out_specs=(c_spec, c_spec if has_valid else P(), P()),
             check_vma=False))
-        deval = (jax.jit(jax.shard_map(
+        deval = (jax.jit(shard_map(
             dart_eval_local, mesh=mesh,
             in_specs=(c_spec, P(), row_spec, row_spec), out_specs=P(),
             check_vma=False)) if has_valid else None)
@@ -2123,7 +2167,7 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
                                       higher_is_better,
                                       track_metric=has_valid, tol=es_tol)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(shard_map(
                 multi_local, mesh=mesh,
                 in_specs=(col_spec, row_spec, row_spec, row_spec, c_spec,
                           row2_spec if has_valid else P(),

@@ -120,18 +120,55 @@ class GrowConfig(NamedTuple):
     # so every round subtracts (see the nhist comment in grow_tree).
     # Single-device only: a shard's local membership of the globally-smaller
     # children is unbounded, so sharded fits (axis_name set) keep full-width
-    # passes regardless of this flag. Default off — validated on live TPU
-    # hardware in round 5 (docs/tpu_capture_r05/): the row-compaction
-    # gather/sort costs 3.4-10x the full-width one-hot pass it saves
-    # (depthwise 24.2 -> 7.0 argsort / 2.4 searchsorted trees/sec,
-    # leafwise 16.7 -> 4.9 at 1M x 28), so subtraction stays a
-    # CPU-fallback-only win.
-    hist_subtraction: bool = False
+    # passes regardless of this flag.
+    # Tri-state: True | False | "auto" (default). "auto" resolves per
+    # BACKEND via :func:`resolve_growth_backend` — off on TPU, where the
+    # round-5 live capture (docs/tpu_capture_r05/) measured the
+    # row-compaction gather/sort at 3.4-10x the full-width one-hot pass it
+    # saves (depthwise 24.2 -> 7.0 argsort / 2.4 searchsorted trees/sec);
+    # ON elsewhere, where halving histogram rows is a measured CPU-side
+    # win. The sentinel NEVER reaches traced code or a compiled-program
+    # cache key: train_booster and the estimator layer both resolve it
+    # first (lint-pinned in tests/test_lint.py).
+    hist_subtraction: "bool | str" = "auto"
     # Row-compaction selector for hist_subtraction: "argsort" (one stable
-    # [n] sort) or "searchsorted" (cumsum + binary search, no sort). A
-    # config field — not an env var — so every compiled-program cache keyed
-    # on cfg stays correct for free.
-    compact_selector: str = "argsort"
+    # [n] sort), "searchsorted" (cumsum + binary search, no sort), or
+    # "auto" (default: argsort on TPU — r5 measured it 2.9x the
+    # searchsorted variant there — searchsorted elsewhere, where the
+    # sort-free form wins). A config field — not an env var — so every
+    # compiled-program cache keyed on cfg stays correct for free; resolved
+    # alongside hist_subtraction.
+    compact_selector: str = "auto"
+
+
+def resolve_growth_backend(cfg: GrowConfig) -> GrowConfig:
+    """Resolve the backend-adaptive tri-states to concrete values.
+
+    ``hist_subtraction="auto"`` -> False on TPU (full-width MXU passes win
+    there), True elsewhere; ``compact_selector="auto"`` -> "argsort" on
+    TPU, "searchsorted" elsewhere (rationale on the GrowConfig fields).
+    MUST run before the config enters any compiled-program cache key or
+    traced code: two processes on different backends resolve differently,
+    and an unresolved sentinel in a cache key would alias their programs.
+    Idempotent; validates ``compact_selector`` either way.
+    """
+    hs, cs = cfg.hist_subtraction, cfg.compact_selector
+    if cs not in ("auto", "argsort", "searchsorted"):
+        raise ValueError(
+            f"compact_selector must be 'auto', 'argsort' or 'searchsorted',"
+            f" got {cs!r}")
+    if hs != "auto" and not isinstance(hs, bool):
+        raise ValueError(
+            f"hist_subtraction must be True, False or 'auto', got {hs!r}")
+    if hs == "auto" or cs == "auto":
+        from ...ops.histogram import _on_tpu_device
+        on_tpu = _on_tpu_device()
+        if hs == "auto":
+            hs = not on_tpu
+        if cs == "auto":
+            cs = "argsort" if on_tpu else "searchsorted"
+        cfg = cfg._replace(hist_subtraction=bool(hs), compact_selector=cs)
+    return cfg
 
 
 def _soft_threshold(g, l1):
@@ -322,6 +359,10 @@ def _use_subtraction(cfg, axis_name, n: int) -> bool:
     growth policies: single-device only (see the GrowConfig comment), not
     under voting, and only worth the selector/gather overhead at real row
     counts (threshold provisional until TPU gather costs are measured)."""
+    if cfg.hist_subtraction == "auto":
+        raise ValueError(
+            "hist_subtraction='auto' reached tree growth unresolved — "
+            "callers must apply resolve_growth_backend(cfg) first")
     return (cfg.hist_subtraction and axis_name is None
             and not cfg.voting and n >= 8192)
 

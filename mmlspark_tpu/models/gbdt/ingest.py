@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P, \
 
 from ...ops.binning import QuantileBinner, bin_cols_device
 from ...parallel import mesh as meshlib
+from ...parallel.compat import shard_map
 
 PathLike = Union[str, os.PathLike]
 
@@ -459,7 +460,7 @@ def binned_matrix_from_source(src: ShardedMatrixSource,
 
     # one jit object; it re-specializes automatically for the (at most
     # two) chunk shapes — full width and the shard tail
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map(
         lambda buf_l, ch_l, u, off: lax.dynamic_update_slice(
             buf_l, bin_cols_device(ch_l, u, out_dtype=bd), (0, off)),
         mesh=mesh,

@@ -34,6 +34,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ...parallel import mesh as meshlib
+from ...parallel.compat import shard_map
 
 
 class SGDConfig(NamedTuple):
@@ -208,7 +209,7 @@ def train_bfgs(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
                  tuple(d.id for d in mesh.devices.flat))
     fn = _SGD_FN_CACHE.get(cache_key)
     if fn is None:
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             local, mesh=mesh,
             in_specs=(P("data", None), P("data", None), P("data"), P("data"),
                       P()),
@@ -338,7 +339,7 @@ def train_sgd(indices: np.ndarray, values: np.ndarray, labels: np.ndarray,
                  tuple(d.id for d in mesh.devices.flat))
     fn = _SGD_FN_CACHE.get(cache_key)
     if fn is None:
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             local_train, mesh=mesh,
             in_specs=(P("data", None), P("data", None), P("data"), P("data"),
                       P(), P(), P(), P()),

@@ -1,7 +1,19 @@
-"""GBDT gradient histograms as MXU matmuls.
+"""GBDT gradient histograms — backend-adaptive engine dispatch.
 
 The reference delegates histogram building to LightGBM's C++ (CUDA/CPU) kernels
 behind LGBM_BoosterUpdateOneIter (reference: lightgbm/TrainUtils.scala:246).
+Here ONE resolver (:func:`resolve_engine`, ``MMLSPARK_TPU_HIST_ENGINE``)
+picks the formulation the current backend actually lowers well — all three
+produce equal histograms through the same entry points (count channel
+exact, grad/hess to f32 accumulation tolerance; docs/performance.md
+"Histogram engine selection"):
+
+  * ``pallas`` — the TPU kernels below (one-hot in VMEM, MXU contraction);
+  * ``onehot`` — the XLA one-hot-matmul fallback below (MXU-shaped, used
+    on TPU for shapes the kernel can't tile);
+  * ``scatter`` — :mod:`.histogram_scatter`'s segment-sum scatter-adds
+    (CPU/GPU: no ``[n, B]`` one-hot transient at all).
+
 TPUs have no fast scatter-add, so the TPU-native formulation turns the
 bin-scatter into dense one-hot contractions that run on the systolic array:
 
@@ -29,28 +41,24 @@ TCP ring all-reduce (LGBM_NetworkInit, TrainUtils.scala:496-512).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+
+from .histogram_scatter import hist_scatter, node_hist_scatter
 
 # one-hot transient element budget per chunk (bf16 elements); ~64M ≈ 128 MB
 _ONEHOT_BUDGET = 64 * 1024 * 1024
 
 
 def _interpret_mode() -> bool:
-    import os
     return bool(os.environ.get("MMLSPARK_TPU_PALLAS_INTERPRET"))
 
 
-def _use_pallas() -> bool:
-    import os
-    if os.environ.get("MMLSPARK_TPU_DISABLE_PALLAS_HIST"):
-        return False
-    if _interpret_mode():
-        # CI leg: run the real kernel logic through the Pallas interpreter
-        # on CPU so packing/layout bugs surface without TPU hardware
-        return True
+def _on_tpu_device() -> bool:
     try:
         # device_kind, not just jax.default_backend(): TPU PJRT plugins may
         # register under a different platform name (e.g. a tunneled plugin)
@@ -64,6 +72,76 @@ def _use_pallas() -> bool:
         return "tpu" in kind.lower()
     except Exception:
         return False
+
+
+def _use_pallas() -> bool:
+    if os.environ.get("MMLSPARK_TPU_DISABLE_PALLAS_HIST"):
+        return False
+    if _interpret_mode():
+        # CI leg: run the real kernel logic through the Pallas interpreter
+        # on CPU so packing/layout bugs surface without TPU hardware
+        return True
+    return _on_tpu_device()
+
+
+# ---------------------------------------------------------------------------
+# Engine resolution: pallas (TPU MXU kernel) / onehot (XLA one-hot matmul —
+# the MXU-shaped fallback) / scatter (flattened segment-sum scatter-adds —
+# what XLA CPU/GPU lowers well). One resolver, three engines, identical
+# results through the same entry points (count channel exact, grad/hess to
+# f32 accumulation tolerance) — so `growth.py` never cares which ran.
+# ---------------------------------------------------------------------------
+
+_ENGINES = ("pallas", "onehot", "scatter")
+
+
+def resolve_engine() -> str:
+    """Histogram engine for the current backend/env (before shape gates).
+
+    ``MMLSPARK_TPU_HIST_ENGINE=pallas|onehot|scatter|auto`` (default auto):
+    ``auto`` picks ``pallas`` where the TPU kernel can lower (TPU
+    device_kind, or ``MMLSPARK_TPU_PALLAS_INTERPRET``) and ``scatter``
+    elsewhere. An explicit ``pallas`` remains subject to
+    ``MMLSPARK_TPU_DISABLE_PALLAS_HIST`` and hardware availability — where
+    the kernel cannot lower, it degrades to the backend-appropriate engine
+    instead of failing Mosaic compilation.
+    """
+    env = (os.environ.get("MMLSPARK_TPU_HIST_ENGINE") or "auto")
+    env = env.strip().lower() or "auto"
+    if env not in _ENGINES + ("auto",):
+        raise ValueError(
+            f"MMLSPARK_TPU_HIST_ENGINE must be one of "
+            f"{('auto',) + _ENGINES}, got {env!r}")
+    if env in ("auto", "pallas"):
+        if _use_pallas():
+            return "pallas"
+        return "onehot" if _on_tpu_device() else "scatter"
+    return env
+
+
+def _note_engine(engine: str) -> None:
+    """hist_engine_selected_total{engine}: selections happen at trace time
+    (engine choice is static per compiled program), so the counter tracks
+    program builds, not per-batch executions."""
+    try:
+        from ..observability import metrics as _metrics
+        _metrics.safe_counter("hist_engine_selected_total",
+                              engine=engine).inc()
+    except Exception:  # noqa: BLE001 — telemetry must not fail the kernel
+        pass
+
+
+def _select_engine(n: int, F: int, S: int, B: int, fused_w: int = 0,
+                   quantized: bool = False) -> str:
+    """Resolved engine with the Pallas shape gate applied: shapes the
+    kernel cannot tile within the VMEM budget fall back to the one-hot
+    matmul (the proven fallback on every backend)."""
+    eng = resolve_engine()
+    if eng == "pallas" and _pick_row_block(n, F, S, B, fused_w=fused_w,
+                                           quantized=quantized) <= 0:
+        eng = "onehot"
+    _note_engine(eng)
+    return eng
 
 
 # ---------------------------------------------------------------------------
@@ -95,9 +173,15 @@ def histogram_cols(binned_t: jnp.ndarray, stats_t: jnp.ndarray, num_bins: int,
     F, n = binned_t.shape
     S = stats_t.shape[0]
     B = int(num_bins)
+    # stats round to stats_dtype (bf16 default) on EVERY engine — scatter
+    # included — so engine choice never changes the values being summed,
+    # only the (f32) accumulation order
     stats_t = stats_t.astype(stats_dtype)
-    if _use_pallas() and _pick_row_block(n, F, S, B) > 0:
+    eng = _select_engine(n, F, S, B)
+    if eng == "pallas":
         return _hist_pallas(binned_t, stats_t, B)
+    if eng == "scatter":
+        return hist_scatter(binned_t, stats_t, B)
     return _hist_xla(binned_t, stats_t, B)
 
 
@@ -151,10 +235,22 @@ def node_histogram(binned_t: jnp.ndarray, row_pos: jnp.ndarray,
     W = int(num_nodes)
     B = int(num_bins)
     quantized = scales is not None
-    if _use_pallas() and _pick_row_block(n, F, 3 * W, B, fused_w=W,
-                                         quantized=quantized) > 0:
+    eng = _select_engine(n, F, 3 * W, B, fused_w=W, quantized=quantized)
+    if eng == "pallas":
         out = _node_hist_pallas(binned_t, row_pos, base_t, W, B,
                                 quantized=quantized)
+    elif eng == "scatter":
+        # the position rides inside the scatter segment id, so neither the
+        # [3W, n] masked stats nor any [n, B] one-hot ever materializes.
+        # Non-quantized stats round to bf16 first — the same input rounding
+        # the one-hot engines apply — and accumulate in f32; int8 stats
+        # accumulate exactly in int32 (the scatter mirror of the MXU path).
+        if quantized:
+            out = node_hist_scatter(binned_t, row_pos, base_t, W, B,
+                                    acc_dtype=jnp.int32)
+        else:
+            out = node_hist_scatter(binned_t, row_pos,
+                                    base_t.astype(jnp.bfloat16), W, B)
     else:
         woh = row_pos[None, :] == jnp.arange(W, dtype=row_pos.dtype)[:, None]
         if quantized:
@@ -393,7 +489,6 @@ def _unroll_max() -> int:
     """Unroll cap, overridable via MMLSPARK_TPU_HIST_UNROLL_MAX (0 keeps the
     dynamic fori_loop everywhere — the escape hatch if a Mosaic version
     compiles large unrolled kernels pathologically)."""
-    import os
     v = os.environ.get("MMLSPARK_TPU_HIST_UNROLL_MAX", "").strip()
     if not v:
         return _UNROLL_MAX

@@ -1,0 +1,80 @@
+"""Scatter-add GBDT histograms — the engine XLA CPU/GPU lowers well.
+
+The one-hot MXU formulation in :mod:`.histogram` is the right shape for a
+systolic array, but on backends with a real scatter-add unit (CPU SIMD,
+GPU atomics) it pays for a dense ``[n, B]`` one-hot transient plus an
+``[S, n] @ [n, B]`` contraction per feature — work that a bin-indexed
+scatter does in ``O(n * S)``. This module is that formulation:
+
+    hist[f, s, b] = sum_{r : binned[f, r] == b} stats[s, r]
+
+built as a ``lax.scan`` over features, each step one flattened
+``.at[seg].add`` scatter (``segment_sum`` shape) into the ``[B, S]``
+accumulator. The fused node variant folds the row->frontier-node position
+into the segment id (``seg = pos * B + bin``), so the ``[3W, n]``
+masked-stats transient of the one-hot fallback never materializes either.
+
+Numeric contract (shared by all engines, tested cross-engine): the count
+channel is exact; grad/hess stats are rounded to bf16 on input — exactly
+the rounding the one-hot engines apply — and accumulated in f32, so
+engines agree to f32 accumulation-order tolerance. The int8 quantized
+path accumulates in int32 and is exact.
+
+Engine selection lives in :func:`.histogram.resolve_engine`; these
+functions assume in-range bin ids (same contract as the other engines)
+and are dispatched through the same ``histogram_cols``/``node_histogram``
+entry points, so callers never import this module directly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def hist_scatter(binned_t: jnp.ndarray, stats_t: jnp.ndarray,
+                 num_bins: int, acc_dtype=jnp.float32) -> jnp.ndarray:
+    """``[F, S, B]`` histogram via per-feature scatter-adds.
+
+    binned_t: [F, n] bin ids (int32/int16/uint8 — widened per feature in
+    registers, never in memory); stats_t: [S, n]. Stats are accumulated in
+    ``acc_dtype`` (f32, or int32 for the quantized path); any bf16 input
+    rounding has already been applied by the caller.
+    """
+    B = int(num_bins)
+    data = jnp.transpose(stats_t).astype(acc_dtype)          # [n, S]
+
+    def body(_, row):                                        # row: [n]
+        seg = row.astype(jnp.int32)
+        h = jnp.zeros((B, data.shape[1]), acc_dtype).at[seg].add(data)
+        return _, jnp.transpose(h)                           # [S, B]
+
+    _, out = lax.scan(body, None, binned_t)
+    return out                                               # [F, S, B]
+
+
+def node_hist_scatter(binned_t: jnp.ndarray, row_pos: jnp.ndarray,
+                      base_t: jnp.ndarray, num_nodes: int, num_bins: int,
+                      acc_dtype=jnp.float32) -> jnp.ndarray:
+    """Fused per-frontier-node histograms ``[F, W*3, B]`` via scatter.
+
+    Matches :func:`.histogram.node_histogram`'s channel layout
+    (``out[f, w*3 + s, b]``). The frontier position rides inside the
+    segment id (``pos * B + bin``); rows at finished leaves
+    (``row_pos < 0``) scatter into a dropped overflow segment, so neither
+    the ``[3W, n]`` masked stats nor any one-hot ever exists.
+    """
+    W = int(num_nodes)
+    B = int(num_bins)
+    data = jnp.transpose(base_t).astype(acc_dtype)           # [n, 3]
+    valid = row_pos >= 0
+    pos = jnp.where(valid, row_pos, 0).astype(jnp.int32)
+
+    def body(_, row):                                        # row: [n]
+        seg = jnp.where(valid, pos * B + row.astype(jnp.int32), W * B)
+        h = jnp.zeros((W * B + 1, 3), acc_dtype).at[seg].add(data)
+        return _, h[:W * B].reshape(W, B, 3)
+
+    _, out = lax.scan(body, None, binned_t)                  # [F, W, B, 3]
+    F = binned_t.shape[0]
+    return jnp.transpose(out, (0, 1, 3, 2)).reshape(F, 3 * W, B)

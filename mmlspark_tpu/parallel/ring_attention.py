@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .compat import axis_size as compat_axis_size
+
 
 def _block_attend(q, k, v, bias, acc, m, denom, scale):
     """One blockwise attention accumulation step (online softmax).
@@ -73,7 +75,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     Returns [B, H, S_local, D] in q's dtype.
     """
     B, H, S, D = q.shape
-    n_shards = lax.axis_size(axis_name)
+    n_shards = compat_axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
 
@@ -167,7 +169,7 @@ def zigzag_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if S2 % 2:
         raise ValueError(f"zig-zag local sequence must be even, got {S2}")
     C = S2 // 2
-    n_shards = lax.axis_size(axis_name)
+    n_shards = compat_axis_size(axis_name)
     me = lax.axis_index(axis_name)
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
 

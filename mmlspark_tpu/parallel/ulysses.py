@@ -24,6 +24,7 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import lax
 
+from .compat import axis_size as compat_axis_size
 from .ring_attention import blockwise_attention
 
 
@@ -40,7 +41,7 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     matrix is never materialized even though each device sees the whole
     sequence. Returns [B, H, S_local, D] in q's dtype.
     """
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     H = q.shape[1]
     if H % n:
         raise ValueError(

@@ -1,0 +1,110 @@
+"""Persistent XLA compilation cache — one init funnel for the framework.
+
+Training a booster compiles multi-second XLA programs (the fused
+multi-iteration scan, the per-round step, the device predictor). Within a
+process those are amortized by the in-memory program caches
+(``_STEP_CACHE`` / ``_PREDICT_CACHE``), but every NEW process — a serving
+worker fleet, repeat CLI fits, a bench warmup — pays the cold compile
+again. jax's persistent compilation cache keys compiled executables on
+(HLO, compile options, backend version) and stores them on disk, so
+identical programs skip XLA entirely across processes.
+
+``MMLSPARK_TPU_COMPILE_CACHE_DIR=<dir>`` opts in; :func:`ensure` is the
+ONLY place the knob is read (booster fit/predict paths and ``bench.py``
+all call it). Safe no-op when the env var is unset or the running jax
+lacks the config flags. Cache *hits* are surfaced as the
+``persistent_compile_cache_hits_total`` counter (fed by jax's own
+monitoring events), and every compile/program_build flight event records
+the active ``persistent_cache`` dir — that is what the warm-start test
+asserts on.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_LOCK = threading.Lock()
+_INITIALIZED = False
+_DIR: Optional[str] = None
+
+
+def cache_dir() -> Optional[str]:
+    """The active persistent-cache directory, or None (after :func:`ensure`
+    has run; before it, reflects only a previous successful init)."""
+    return _DIR
+
+
+def ensure() -> Optional[str]:
+    """Idempotently wire jax's persistent compilation cache.
+
+    Reads ``MMLSPARK_TPU_COMPILE_CACHE_DIR`` once per process (first call
+    wins — jax reads the flag at compile time, so flipping it mid-process
+    would silently apply to some programs and not others). Returns the
+    active cache dir, or None when disabled/unsupported.
+    """
+    global _INITIALIZED, _DIR
+    with _LOCK:
+        if _INITIALIZED:
+            return _DIR
+        _INITIALIZED = True
+        d = (os.environ.get("MMLSPARK_TPU_COMPILE_CACHE_DIR") or "").strip()
+        if not d:
+            return None
+        try:
+            import jax
+            jax.config.update("jax_compilation_cache_dir", d)
+        except Exception:  # noqa: BLE001 — jax without the cache: no-op
+            return None
+        # optional tuning flags are each individually best-effort: a jax
+        # that lacks one must not leave the cache half-configured (dir
+        # active but _DIR None would mis-stamp every compile event as
+        # uncached and never register the hit listener)
+        for flag, val in (
+                # cache every program: the default 1 s floor would skip
+                # most of the small per-shape programs that dominate
+                # cold-start count
+                ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                ("jax_persistent_cache_min_entry_size_bytes", 0)):
+            try:
+                jax.config.update(flag, val)
+            except Exception:  # noqa: BLE001 — flag absent on this jax
+                pass
+        # jax memoizes "is the cache used?" at the FIRST compile of the
+        # process (compilation_cache._cache_checked); anything that
+        # compiled before this funnel ran — framework import side effects,
+        # a warmup op — would have frozen the answer at False and every
+        # later compile would silently skip the dir. Reset the memo so the
+        # cache engages from here on.
+        try:
+            from jax._src import compilation_cache as _jcc
+            _jcc.reset_cache()
+        except Exception:  # noqa: BLE001 — internal API drift: the cache
+            pass           # still works when nothing compiled pre-ensure
+        _DIR = d
+        _register_hit_listener()
+        return _DIR
+
+
+def _register_hit_listener() -> None:
+    """Feed jax's cache-hit monitoring event into the metrics registry:
+    ``persistent_compile_cache_hits_total`` is the deterministic signal
+    that a warm cache dir actually skipped recompilation (wall-time
+    comparisons are flaky on loaded CI boxes)."""
+    try:
+        from jax import monitoring
+
+        def _on_event(event: str, **kwargs) -> None:
+            if event != "/jax/compilation_cache/cache_hits":
+                return
+            try:
+                from ..observability import metrics as _metrics
+                _metrics.safe_counter(
+                    "persistent_compile_cache_hits_total").inc()
+            except Exception:  # noqa: BLE001 — telemetry must never raise
+                pass
+
+        monitoring.register_event_listener(_on_event)
+    except Exception:  # noqa: BLE001 — monitoring API absent: hits simply
+        pass           # go uncounted; the cache itself still works

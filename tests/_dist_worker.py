@@ -30,6 +30,7 @@ def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     from mmlspark_tpu.parallel import distributed
+    from mmlspark_tpu.parallel.compat import shard_map
     from mmlspark_tpu.parallel.mesh import default_mesh, make_mesh
 
     if single:
@@ -46,7 +47,7 @@ def main() -> None:
     mesh = make_mesh()                    # all (global) devices on "data"
     x = np.arange(8, dtype=np.float32)
     xd = jax.device_put(x, jax.NamedSharding(mesh, P("data")))
-    psum = jax.jit(jax.shard_map(
+    psum = jax.jit(shard_map(
         lambda a: jax.lax.psum(a, "data"), mesh=mesh,
         in_specs=P("data"), out_specs=P(None), check_vma=False))(xd)
     psum_host = [float(v) for v in np.asarray(psum)]

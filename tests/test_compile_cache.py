@@ -1,0 +1,124 @@
+"""Persistent compile cache (MMLSPARK_TPU_COMPILE_CACHE_DIR) tests.
+
+The warm-start proof runs in subprocesses — the whole point is COLD
+processes skipping XLA recompilation — and asserts on deterministic
+signals, not wall time: jax's own cache-hit monitoring events (surfaced
+as ``persistent_compile_cache_hits_total`` by the utils/compile_cache
+funnel) and the ``persistent_cache`` field on the flight recorder's
+compile/program_build events.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mmlspark_tpu.utils import compile_cache
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# one tiny fit + one predict, then dump (hit counter, compile events) as
+# the last stdout line. The predict path AOT-compiles through
+# _ObservedProgram, so a real `compile` flight event (with wall time and
+# the persistent_cache field) is always present.
+_CHILD = r"""
+import json, os
+import numpy as np
+from mmlspark_tpu.models.gbdt.booster import train_booster
+from mmlspark_tpu.models.gbdt.growth import GrowConfig
+from mmlspark_tpu.observability import flight, metrics
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(512, 4)).astype(np.float32)
+y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+b = train_booster(X, y, objective="binary", num_iterations=2,
+                  cfg=GrowConfig(num_leaves=7), max_bin=15,
+                  bin_sample_count=512, seed=0)
+pred = b.predict(X[:64])
+snap = metrics.get_registry().snapshot()
+fam = snap.get("persistent_compile_cache_hits_total") or {}
+hits = sum(s.get("value", 0) for s in fam.get("series", []))
+evs = [e for e in flight.events()
+       if e.get("kind") in ("compile", "program_build")]
+print(json.dumps({
+    "hits": hits,
+    "compiles_total": sum(
+        s.get("value", 0) for s in (snap.get("gbdt_compiles_total")
+                                    or {}).get("series", [])),
+    "n_events": len(evs),
+    "persistent_fields": sorted({e.get("persistent_cache", "<absent>")
+                                 for e in evs}),
+    "pred0": float(np.asarray(pred).ravel()[0]),
+}))
+"""
+
+
+def _run_child(cache_dir: str) -> dict:
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "MMLSPARK_TPU_COMPILE_CACHE_DIR": cache_dir,
+                "PALLAS_AXON_POOL_IPS": ""})
+    proc = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                          capture_output=True, text=True, timeout=420,
+                          cwd=_REPO)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_warm_cache_dir_skips_recompilation(tmp_path):
+    """Cold process #2 on a warm cache dir must FETCH, not compile: jax
+    reports persistent-cache hits (counted by the funnel's monitoring
+    listener), and every compile/program_build flight event carries the
+    active cache dir so a flight dump shows which cache served it."""
+    d = str(tmp_path / "xla_cache")
+    first = _run_child(d)
+    assert os.path.isdir(d) and os.listdir(d), \
+        "first run left no persistent cache entries"
+    assert first["n_events"] > 0
+    assert first["persistent_fields"] == [d], first
+    assert first["compiles_total"] >= 1          # the predict AOT compile
+
+    second = _run_child(d)
+    assert second["hits"] > 0, (
+        "second process compiled from scratch despite a warm "
+        f"MMLSPARK_TPU_COMPILE_CACHE_DIR: {second}")
+    assert second["persistent_fields"] == [d], second
+    assert second["pred0"] == first["pred0"]     # cached programs: same math
+
+
+def test_funnel_noop_without_env(monkeypatch):
+    # a fresh-state ensure() with the env unset must not touch jax config
+    monkeypatch.delenv("MMLSPARK_TPU_COMPILE_CACHE_DIR", raising=False)
+    monkeypatch.setattr(compile_cache, "_INITIALIZED", False)
+    monkeypatch.setattr(compile_cache, "_DIR", None)
+    assert compile_cache.ensure() is None
+    assert compile_cache.cache_dir() is None
+
+
+def test_funnel_first_call_wins(monkeypatch, tmp_path):
+    # jax reads the flag per compile; flipping dirs mid-process would
+    # split programs across caches — the funnel pins the first value
+    monkeypatch.setattr(compile_cache, "_INITIALIZED", False)
+    monkeypatch.setattr(compile_cache, "_DIR", None)
+    d1 = str(tmp_path / "a")
+    monkeypatch.setenv("MMLSPARK_TPU_COMPILE_CACHE_DIR", d1)
+    try:
+        assert compile_cache.ensure() == d1
+        monkeypatch.setenv("MMLSPARK_TPU_COMPILE_CACHE_DIR",
+                           str(tmp_path / "b"))
+        assert compile_cache.ensure() == d1
+    finally:
+        # don't leave the suite's process compiling into a test tmp dir
+        import jax
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:  # noqa: BLE001
+            pass
+        monkeypatch.setattr(compile_cache, "_INITIALIZED", False)
+        monkeypatch.setattr(compile_cache, "_DIR", None)
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

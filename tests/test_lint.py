@@ -285,6 +285,96 @@ def test_io_handlers_route_through_shared_response_helper():
         f"serving.write_http_response: {offenders}")
 
 
+def test_shard_map_routes_through_compat_funnel():
+    """``parallel/compat.py`` is the ONE place the jax shard_map API skew
+    (jax.shard_map vs jax.experimental.shard_map.shard_map, check_vma vs
+    check_rep) is resolved. A bare ``jax.shard_map(`` — or a direct
+    experimental import — anywhere else reintroduces the version skew
+    that cost 240 tier-1 tests before the funnel existed."""
+    compat_rel = os.path.join("parallel", "compat.py")
+    repo_root = os.path.dirname(_PKG_ROOT)
+    scan = list(_py_files(_PKG_ROOT))
+    scan += list(_py_files(os.path.join(repo_root, "tests")))
+    scan += list(_py_files(os.path.join(repo_root, "tools")))
+    for fn in ("__graft_entry__.py", "bench.py", "graft_test_env.py"):
+        p = os.path.join(repo_root, fn)
+        if os.path.exists(p):
+            scan.append(p)
+    offenders = []
+    for path in scan:
+        if os.path.relpath(path, _PKG_ROOT) == compat_rel:
+            continue
+        for node in ast.walk(_parse(path)):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr == "shard_map"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "jax"):
+                offenders.append((os.path.relpath(path, repo_root),
+                                  node.lineno, "jax.shard_map"))
+            elif (isinstance(node, ast.ImportFrom) and node.module
+                    and node.module.startswith("jax.experimental.shard_map")):
+                offenders.append((os.path.relpath(path, repo_root),
+                                  node.lineno, f"from {node.module} import"))
+    assert not offenders, (
+        "shard_map must be imported from mmlspark_tpu.parallel.compat "
+        f"(the version-skew funnel): {offenders}")
+
+
+def _first_lineno(fn_node, match):
+    """Smallest lineno inside ``fn_node`` for which ``match(node)``."""
+    best = None
+    for node in ast.walk(fn_node):
+        if match(node):
+            ln = getattr(node, "lineno", None)
+            if ln is not None and (best is None or ln < best):
+                best = ln
+    return best
+
+
+def test_auto_sentinel_resolved_before_program_cache_keys():
+    """GrowConfig's backend-adaptive tri-states (hist_subtraction /
+    compact_selector = "auto") must be resolved to concrete values BEFORE
+    the config reaches any compiled-program cache key: an unresolved
+    sentinel would alias programs across backends. Source-level pin:
+    ``train_booster`` calls ``resolve_growth_backend`` before its first
+    ``cache_key`` construction / ``_cached_program`` call, and the
+    estimator layer's ``_grow_config`` routes through the resolver too.
+    (tests/test_histogram_engines.py proves it at runtime by scanning the
+    live step-cache keys after a default-config fit.)"""
+    booster_py = os.path.join(_PKG_ROOT, "models", "gbdt", "booster.py")
+    tree = _parse(booster_py)
+    tb = next(n for n in ast.walk(tree)
+              if isinstance(n, ast.FunctionDef) and n.name == "train_booster")
+
+    def is_resolver_call(n):
+        return (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Name)
+                and n.func.id == "resolve_growth_backend")
+
+    def is_cache_use(n):
+        if isinstance(n, ast.Assign):
+            return any(isinstance(t, ast.Name) and "cache_key" in t.id
+                       for t in n.targets)
+        return (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == "_cached_program")
+
+    resolver_ln = _first_lineno(tb, is_resolver_call)
+    cache_ln = _first_lineno(tb, is_cache_use)
+    assert resolver_ln is not None, \
+        "train_booster no longer resolves the 'auto' tri-states"
+    assert cache_ln is not None, "lint matched no cache-key construction"
+    assert resolver_ln < cache_ln, (
+        f"resolve_growth_backend (line {resolver_ln}) must run before the "
+        f"first cache-key construction (line {cache_ln})")
+
+    api_py = os.path.join(_PKG_ROOT, "models", "gbdt", "api.py")
+    gc = next(n for n in ast.walk(_parse(api_py))
+              if isinstance(n, ast.FunctionDef) and n.name == "_grow_config")
+    assert _first_lineno(gc, is_resolver_call) is not None, (
+        "_grow_config must resolve 'auto' before handing GrowConfig to "
+        "direct consumers (the sweep path bypasses train_booster)")
+
+
 def test_trace_header_names_come_from_tracing_module():
     """The wire contract lives in observability/tracing.py
     (TRACEPARENT_HEADER / REQUEST_ID_HEADER); a string literal at any

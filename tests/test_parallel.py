@@ -11,6 +11,7 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from mmlspark_tpu.parallel.compat import shard_map
 from mmlspark_tpu.parallel.mesh import (make_mesh, num_shards, pad_rows,
                                         shard_rows, validity_mask)
 from mmlspark_tpu.parallel.ring_attention import (blockwise_attention,
@@ -21,7 +22,7 @@ from mmlspark_tpu.parallel.ring_attention import (blockwise_attention,
 def run_seq_sharded(fn, mesh, q, k, v):
     """Shared harness: run a seq-axis attention fn under shard_map with
     [B, H, S, D] inputs sharded on the sequence axis."""
-    return np.asarray(jax.jit(jax.shard_map(
+    return np.asarray(jax.jit(shard_map(
         fn, mesh=mesh, in_specs=(P(None, None, "seq", None),) * 3,
         out_specs=P(None, None, "seq", None), check_vma=False))(q, k, v))
 
