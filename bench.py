@@ -72,14 +72,16 @@ def _emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
-def _dump_metrics_snapshot(leg: str) -> None:
+def _dump_metrics_snapshot(leg: str, wall_start: float = 0.0) -> None:
     """Opt-in telemetry dump next to the BENCH_*.json line:
     ``GRAFT_BENCH_METRICS_SNAPSHOT=<path>`` writes the process-wide
     metrics registry (docs/observability.md) accumulated over the bench —
     per-stage span histograms, serving counters, device-memory gauges —
-    as JSON, so a round's throughput line comes with its breakdown. Both
-    legs inherit the same env var, so the leg name is spliced into the
-    filename (``m.json`` -> ``m.cpu.json``) — the TPU leg must not
+    as JSON under ``"metrics"``, plus leg health meta: wall-clock
+    start/end/duration and per-site watchdog stall counts, so a round's
+    throughput line self-reports whether the leg ran clean or wedged.
+    Both legs inherit the same env var, so the leg name is spliced into
+    the filename (``m.json`` -> ``m.cpu.json``) — the TPU leg must not
     silently overwrite the CPU leg's breakdown."""
     path = os.environ.get("GRAFT_BENCH_METRICS_SNAPSHOT")
     if not path:
@@ -88,8 +90,18 @@ def _dump_metrics_snapshot(leg: str) -> None:
     path = f"{root}.{leg}{ext or '.json'}"
     try:
         from mmlspark_tpu.observability import metrics as _obs_metrics
+        from mmlspark_tpu.observability import watchdog as _obs_watchdog
+        wall_end = time.time()
+        payload = {
+            "leg": leg,
+            "wall_clock": {"start": wall_start, "end": wall_end,
+                           "seconds": round(wall_end - wall_start, 3)
+                           if wall_start else None},
+            "watchdog_stalls": _obs_watchdog.stall_counts(),
+            "metrics": _obs_metrics.get_registry().snapshot(),
+        }
         with open(path, "w") as f:
-            json.dump(_obs_metrics.get_registry().snapshot(), f, indent=2)
+            json.dump(payload, f, indent=2)
     except Exception as e:  # noqa: BLE001 — telemetry must not fail a bench
         print(f"metrics snapshot failed: {e!r}", file=sys.stderr)
 
@@ -261,6 +273,7 @@ def main() -> None:
 
 
 def _run_leg(on_tpu: bool) -> None:
+    leg_wall_start = time.time()
     # persistent compile cache via the framework's one init funnel
     # (utils/compile_cache): repeat bench runs — and any process that sets
     # MMLSPARK_TPU_COMPILE_CACHE_DIR — skip the cold XLA compiles entirely
@@ -508,7 +521,7 @@ def _run_leg(on_tpu: bool) -> None:
         out[f"imagelime_perturbations_per_sec{sfx}"] = \
             lime_rates["perturbations_per_sec"]
     print(json.dumps(out))
-    _dump_metrics_snapshot("tpu" if on_tpu else "cpu")
+    _dump_metrics_snapshot("tpu" if on_tpu else "cpu", leg_wall_start)
     _dump_flight_snapshot("tpu" if on_tpu else "cpu")
 
 

@@ -4,11 +4,12 @@ codegen step; reference: sbt packagePythonTask at build.sbt:204-247)."""
 
 import sys
 
+from ..observability.logging import console
 from . import generate_all
 
 if __name__ == "__main__":
     out = sys.argv[1] if len(sys.argv) > 1 else "python_api"
     result = generate_all(out)
-    print(f"wrote {len(result['namespace_files'])} namespace modules, "
-          f"{result['docs']}, {result['tests']}, "
-          f"{result['migration']}")
+    console(f"wrote {len(result['namespace_files'])} namespace modules, "
+            f"{result['docs']}, {result['tests']}, "
+            f"{result['migration']}")

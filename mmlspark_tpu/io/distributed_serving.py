@@ -44,8 +44,12 @@ from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 from ..observability import spans as _spans
 from ..observability import tracing as _tracing
+from ..observability.federation import MetricsFederator
+from ..observability.logging import get_logger
 from .serving import (ServingQuery, ServingServer, debug_route,
                       write_debug_response, write_http_response)
+
+logger = get_logger("mmlspark_tpu.io.distributed_serving")
 
 # ---------------------------------------------------------------------------
 # Service registry
@@ -157,8 +161,11 @@ class GatewayServer:
                     if route is not None:
                         # the gateway's own view: routing counters,
                         # failovers, live-worker gauge, its flight ring —
-                        # not proxied to workers
-                        write_debug_response(self, route, outer.api_name)
+                        # not proxied to workers. /metrics additionally
+                        # carries the federated cluster_* families and
+                        # /debug/cluster the per-worker scrape health.
+                        write_debug_response(self, route, outer.api_name,
+                                             federation=outer.federation)
                         return
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
@@ -212,11 +219,19 @@ class GatewayServer:
 
         self._httpd = Server((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
+        # cluster federation: scrape every registered worker's /metrics and
+        # expose the merged view on this gateway's /metrics + /debug/cluster
+        # (inert per-tick while telemetry is disabled)
+        self.federation = MetricsFederator(self._federation_targets)
         self._threads = [
             threading.Thread(target=self._httpd.serve_forever, daemon=True),
             threading.Thread(target=self._health_loop, daemon=True),
         ]
         self._stop = threading.Event()
+
+    def _federation_targets(self):
+        return [(f"{w.host}:{w.port}", w.host, w.port)
+                for w in self.registry.workers()]
 
     @property
     def url(self) -> str:
@@ -226,10 +241,12 @@ class GatewayServer:
         for t in self._threads:
             if not t.is_alive():
                 t.start()
+        self.federation.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        self.federation.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -306,6 +323,14 @@ class GatewayServer:
                 _metrics.safe_counter("gateway_retries_total",
                                       api=self.api_name,
                                       reason=type(e).__name__).inc()
+                logger.warning("failover: worker %s (%s:%s) failed: %s",
+                               w.worker_id, w.host, w.port, e,
+                               api=self.api_name,
+                               reason=type(e).__name__)
+                self.federation.last_failover = {
+                    "ts": time.time(), "worker": w.worker_id,
+                    "addr": f"{w.host}:{w.port}",
+                    "reason": f"{type(e).__name__}: {e}"}
                 _flight.record("gateway_failover",
                                api=self.api_name, worker=w.worker_id,
                                addr=f"{w.host}:{w.port}",

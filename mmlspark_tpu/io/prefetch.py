@@ -36,6 +36,7 @@ from typing import Callable, Iterable, Iterator, TypeVar
 
 from ..observability import metrics as _metrics
 from ..observability import spans as _spans
+from ..observability import watchdog as _watchdog
 
 T = TypeVar("T")
 
@@ -64,6 +65,10 @@ def iter_prefetched(thunks: Iterable[Callable[[], T]], *, depth: int = 1,
     pending: deque = deque()
     ex = ThreadPoolExecutor(max_workers=1,
                             thread_name_prefix="mmlspark-prefetch")
+    # watchdog heartbeat: one beat per chunk served — a reader wedged on
+    # a dead filesystem (or a consumer wedged on device compute) stops
+    # the beat and gets flagged with full stacks instead of hanging mute
+    hb = _watchdog.register(f"prefetch:{site}", stall_seconds=120.0)
     try:
         while len(pending) < depth:
             thunk = next(it, None)
@@ -71,6 +76,7 @@ def iter_prefetched(thunks: Iterable[Callable[[], T]], *, depth: int = 1,
                 break
             pending.append(ex.submit(thunk))
         while pending:
+            hb.beat()
             fut = pending.popleft()
             t0 = time.perf_counter()
             with _spans.span("prefetch_wait", site=site):
@@ -88,6 +94,7 @@ def iter_prefetched(thunks: Iterable[Callable[[], T]], *, depth: int = 1,
                                   site=site).inc()
             yield out
     finally:
+        hb.close()
         for fut in pending:
             fut.cancel()
         # wait=True: an abandoned in-flight read must not outlive the

@@ -50,7 +50,11 @@ from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 from ..observability import spans as _spans
 from ..observability import tracing as _tracing
+from ..observability import watchdog as _watchdog
+from ..observability.logging import get_logger
 from .http import to_jsonable
+
+logger = get_logger("mmlspark_tpu.io.serving")
 
 #: paths (relative to the server root) answered with the Prometheus text
 #: rendering of the global registry instead of entering the request queue
@@ -61,6 +65,9 @@ HEALTHZ_PATH = "/healthz"
 VARZ_PATH = "/varz"
 #: the flight recorder's ring buffer as JSON
 FLIGHT_PATH = "/debug/flight"
+#: per-worker scrape health + staleness + last failover (gateway
+#: federation view; answers with a "no federation" note elsewhere)
+CLUSTER_PATH = "/debug/cluster"
 
 #: (route name, path) table shared by the serving server and the gateway
 DEBUG_ROUTES = (
@@ -68,6 +75,7 @@ DEBUG_ROUTES = (
     ("healthz", HEALTHZ_PATH),
     ("varz", VARZ_PATH),
     ("flight", FLIGHT_PATH),
+    ("cluster", CLUSTER_PATH),
 )
 
 
@@ -113,12 +121,15 @@ def write_http_response(handler: BaseHTTPRequestHandler, status: int,
         _metrics.safe_counter(counter, code=str(status), **labels).inc()
 
 
-def write_metrics_response(handler: BaseHTTPRequestHandler) -> None:
+def write_metrics_response(handler: BaseHTTPRequestHandler,
+                           extra: bytes = b"") -> None:
     """Answer a scrape on any ``BaseHTTPRequestHandler`` in-band — shared
     by ``ServingServer`` and the distributed-serving gateway so the
-    exposition content type stays defined in exactly one place."""
+    exposition content type stays defined in exactly one place.
+    ``extra`` appends pre-rendered families (the gateway's federated
+    ``cluster_*`` suffix)."""
     write_http_response(
-        handler, 200, render_metrics(),
+        handler, 200, render_metrics() + extra,
         {"Content-Type": "text/plain; version=0.0.4; charset=utf-8"})
 
 
@@ -164,9 +175,11 @@ def healthz_payload() -> Dict[str, Any]:
     return info
 
 
-def varz_payload(api_name: str) -> Dict[str, Any]:
+def varz_payload(api_name: str, federation: Optional[Any] = None
+                 ) -> Dict[str, Any]:
     """Registry JSON + build/config info + slow-request exemplars (the
-    ``/varz`` body; name after the Google-style debug endpoint)."""
+    ``/varz`` body; name after the Google-style debug endpoint). On a
+    federating gateway, also the cluster scrape-health section."""
     from .. import __version__
     build: Dict[str, Any] = {"version": __version__,
                              "python": sys.version.split()[0]}
@@ -177,7 +190,7 @@ def varz_payload(api_name: str) -> Dict[str, Any]:
             build["jax"] = sys.modules["jax"].__version__
         except Exception:  # noqa: BLE001
             pass
-    return {
+    payload = {
         "build": build,
         "config": {
             "api_name": api_name,
@@ -190,19 +203,33 @@ def varz_payload(api_name: str) -> Dict[str, Any]:
         "exemplars": _tracing.get_exemplars(),
         "metrics": _metrics.get_registry().snapshot(),
     }
+    if federation is not None:
+        payload["cluster"] = federation.cluster_payload()
+    return payload
 
 
 def write_debug_response(handler: BaseHTTPRequestHandler, route: str,
-                         api_name: str) -> None:
+                         api_name: str,
+                         federation: Optional[Any] = None) -> None:
     """Answer any debug route in-band (never queued: these must work
-    even when the batching worker or every backend worker is wedged)."""
+    even when the batching worker or every backend worker is wedged).
+    ``federation`` is the gateway's :class:`MetricsFederator`: it extends
+    ``/metrics`` with the merged ``cluster_*`` families, ``/varz`` with
+    the scrape-health section, and backs ``/debug/cluster``."""
     if route == "metrics":
-        write_metrics_response(handler)
+        write_metrics_response(
+            handler, b"" if federation is None else federation.render_metrics())
         return
     if route == "healthz":
         payload: Any = healthz_payload()
     elif route == "varz":
-        payload = varz_payload(api_name)
+        payload = varz_payload(api_name, federation)
+    elif route == "cluster":
+        payload = (federation.cluster_payload() if federation is not None
+                   else {"federation": None,
+                         "note": "no federation in this process (cluster "
+                                 "view lives on the distributed-serving "
+                                 "gateway)"})
     else:
         payload = _flight.snapshot()
     body = json.dumps(payload, default=repr).encode("utf-8")
@@ -560,7 +587,21 @@ class ServingQuery:
 
     def _run(self) -> None:
         api = self.server.api_name
+        # watchdog heartbeat: the batch loop iterates at least once per
+        # max_latency even when idle, so a silent heartbeat means the
+        # transform (or the model under it) is wedged — exactly the state
+        # that used to surface only as client 504s
+        # 120 s site override: the first batch may pay a cold XLA compile
+        # inside transform(), which is slow-but-alive, not wedged
+        hb = _watchdog.register(f"serving_batch:{api}", stall_seconds=120.0)
+        try:
+            self._run_batches(api, hb)
+        finally:
+            hb.close()
+
+    def _run_batches(self, api: str, hb) -> None:
         while not self._stop.is_set():
+            hb.beat()
             batch = self.server.get_batch(self.max_batch, self.max_latency,
                                           self.eager)
             if not batch:
@@ -599,6 +640,10 @@ class ServingQuery:
                     time.perf_counter() - t0)
             except Exception as e:
                 survivors = [r for r in batch if self.server.requeue(r)]
+                logger.error("batch transform failed: %s: %s",
+                             type(e).__name__, e, api=api,
+                             batch_size=len(batch),
+                             requeued=len(survivors))
                 _flight.record("batch_error", api=api,
                                batch_size=len(batch),
                                requeued=len(survivors),

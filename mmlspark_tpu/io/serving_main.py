@@ -98,6 +98,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     from ..observability import flight as _flight
+    from ..observability import logging as _logging
     from ..observability import tracing as _tracing
     from .distributed_serving import (GatewayServer, ServiceRegistry,
                                       WorkerInfo)
@@ -112,7 +113,11 @@ def main(argv=None) -> int:
     if args.slow_request_seconds is not None:
         _tracing.set_slow_threshold(args.slow_request_seconds)
     _flight.set_default_fields(role=args.role)
+    # log records from this process carry the role too, so merged log
+    # streams from a pod separate gateway lines from worker lines
+    _logging.set_default_fields(role=args.role)
     _flight.install()
+    log = _logging.get_logger("mmlspark_tpu.io.serving_main")
 
     registry = ServiceRegistry(args.registry)
     stop = threading.Event()
@@ -137,8 +142,12 @@ def main(argv=None) -> int:
                           port=server.port, api_name=args.api_name)
         query.start()
         registry.register(info)
-        print(f"worker {info.worker_id} serving on "
-              f"{server.host}:{server.port}", flush=True)
+        # console, not the JSON funnel: orchestration (docker entrypoints,
+        # tests) parses this exact ready-line from stdout
+        _logging.console(f"worker {info.worker_id} serving on "
+                         f"{server.host}:{server.port}")
+        log.info("worker ready", worker_id=info.worker_id,
+                 host=server.host, port=server.port, model=args.model)
         try:
             stop.wait()
         finally:
@@ -148,7 +157,9 @@ def main(argv=None) -> int:
 
     gateway = GatewayServer(registry, args.host, args.port, args.api_name)
     gateway.start()
-    print(f"gateway on {gateway.host}:{gateway.port}", flush=True)
+    _logging.console(f"gateway on {gateway.host}:{gateway.port}")
+    log.info("gateway ready", host=gateway.host, port=gateway.port,
+             registry=args.registry)
     try:
         stop.wait()
     finally:

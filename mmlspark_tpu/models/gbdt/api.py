@@ -25,6 +25,7 @@ from ...core.params import (HasFeaturesCol, HasGroupCol, HasInitScoreCol,
 from ...core.pipeline import Estimator, Model
 from ...observability import metrics as _metrics
 from ...observability import spans as _spans
+from ...observability import watchdog as _watchdog
 from .booster import Booster, LightGBMDataset, _densify, train_booster
 from .growth import GrowConfig, resolve_growth_backend
 
@@ -376,14 +377,23 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
                 and os.environ.get("MMLSPARK_TPU_TELEMETRY_ROUNDS") == "1"):
             return None
         cls = type(self).__name__
+        import time as _time
+        last = [_time.perf_counter()]
 
         def cb(it: int, round_metrics: dict) -> None:
             vals = {k: float(v) for k, v in round_metrics.items()}
             _spans.instant("boost_round", model=cls, iteration=it, **vals)
             _metrics.safe_counter("gbdt_boost_rounds_total", model=cls).inc()
+            # live training-health sentinels: per-round loss (NaN /
+            # divergence) and round wall time (throughput collapse)
+            now = _time.perf_counter()
+            _watchdog.report_training_metric(cls, it, seconds=now - last[0])
+            last[0] = now
             for k, v in vals.items():
                 _metrics.safe_gauge("gbdt_round_metric",
                                     model=cls, metric=k).set(v)
+                _watchdog.report_training_metric(cls, it, loss=v,
+                                                 metric_name=k)
         return cb
 
     def _publish_booster_telemetry(self, booster: Booster) -> None:
@@ -404,12 +414,19 @@ class _LightGBMParams(HasLabelCol, HasFeaturesCol, HasWeightCol, HasInitScoreCol
             if series:
                 _metrics.safe_gauge("gbdt_train_metric", model=cls,
                                     metric=str(mname)).set(float(series[-1]))
+        # post-fit health audit: NaN / divergence anywhere in the metric
+        # history flips training_health{model} — this is the path that
+        # covers the fused single-dispatch fits, which have no rounds
+        _watchdog.scan_eval_history(cls, booster.eval_history)
         from ...observability.device import device_memory_gauges
         device_memory_gauges()
 
     def _fit_booster(self, dataset: Dataset, objective: str, num_class: int,
                      objective_kwargs: Optional[dict] = None) -> Booster:
         cls = type(self).__name__
+        # fresh sentinel windows for this estimator's health stream (the
+        # booster-level "gbdt" stream resets inside train_booster)
+        _watchdog.reset_training_health(cls)
         with _spans.span(f"{self.uid}.train_booster",
                          metric_label=f"{cls}.train_booster",
                          objective=objective, num_class=num_class):

@@ -30,6 +30,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ...observability import flight as _flight
 from ...observability import metrics as _metrics
 from ...observability import spans as _spans
+from ...observability import watchdog as _watchdog
+from ...observability.logging import console as _console
 from ...utils import compile_cache as _compile_cache
 from ...ops.binning import QuantileBinner, bin_cols_device
 from ...parallel import mesh as meshlib
@@ -88,7 +90,10 @@ class _PhaseTimer:
     def mark(self, name: str) -> None:
         if self.on:
             now = time.perf_counter()
-            print(f"[gbdt-timing] {name}: {now - self._t:.3f}s", flush=True)
+            # console, not the JSON funnel: MMLSPARK_TPU_TIMING=1 is an
+            # explicit operator request that must print regardless of the
+            # telemetry kill switch
+            _console(f"[gbdt-timing] {name}: {now - self._t:.3f}s")
             self._t = now
 
 
@@ -1279,6 +1284,9 @@ def train_booster(
     # before the first program of this fit traces, so serving workers and
     # repeat CLI fits skip the cold multi-second XLA compile
     _compile_cache.ensure()
+    # each fit starts with clean training-health sentinel windows — a
+    # diverging fit yesterday must not poison today's gauge
+    _watchdog.reset_training_health("gbdt")
     # resolve backend-adaptive tri-states ("auto" hist_subtraction /
     # compact_selector) to concrete values up front: cfg flows into the
     # checkpoint fingerprint and every compiled-program cache key below,
@@ -1885,67 +1893,84 @@ def train_booster(
         # falls through to the shared finalize/truncate/rf-scale epilogue
 
     base_key = jax.random.PRNGKey(seed)
-    for it in ([] if fuse_es else range(iterations_done, num_iterations)):
-        key, bag_key = _iter_keys(base_key, it)
-        scores_d, vscores_d_new, trees_packed, metrics = step(
-            Xbt_d, y_d, w_d, vmask_d, scores_d,
-            Xvb_d if has_valid else dummy, yv_d if has_valid else dummy,
-            wv_d if has_valid else dummy, vscores_d if has_valid else dummy,
-            key, bag_key, np.float32(it))
-        if has_valid:
-            vscores_d = vscores_d_new
-        trees_host = unpack_trees(np.asarray(trees_packed), (K,),
-                                  2 * cfg.num_leaves - 1,
-                                  bitset_words(cfg.num_bins))
-        for k in range(K):
-            all_trees.append(jax.tree_util.tree_map(lambda a: a[k], trees_host))
+    # watchdog: one beat + one duration report per boosting round — a host
+    # loop wedged on a stuck dispatch stops beating and gets stack-dumped;
+    # a round suddenly 5x slower than its window trips the throughput
+    # sentinel (fused paths have no rounds; scan_eval_history covers them)
+    hb = _watchdog.register("gbdt_round_loop", stall_seconds=120.0) \
+        if not fuse_es else _watchdog.NOOP_HEARTBEAT
+    t_round = time.perf_counter()
+    try:
+        for it in ([] if fuse_es else range(iterations_done, num_iterations)):
+            hb.beat()
+            key, bag_key = _iter_keys(base_key, it)
+            scores_d, vscores_d_new, trees_packed, metrics = step(
+                Xbt_d, y_d, w_d, vmask_d, scores_d,
+                Xvb_d if has_valid else dummy, yv_d if has_valid else dummy,
+                wv_d if has_valid else dummy, vscores_d if has_valid else dummy,
+                key, bag_key, np.float32(it))
+            if has_valid:
+                vscores_d = vscores_d_new
+            trees_host = unpack_trees(np.asarray(trees_packed), (K,),
+                                      2 * cfg.num_leaves - 1,
+                                      bitset_words(cfg.num_bins))
+            for k in range(K):
+                all_trees.append(jax.tree_util.tree_map(lambda a: a[k], trees_host))
 
-        if provide_training_metric and (it % metric_eval_period == 0
-                                        or it == num_iterations - 1):
-            # the train history records what the device step computes —
-            # with metric='auc' that is the objective default, so key by
-            # the device metric name, not the early-stopping one
-            history.setdefault(f"training_{device_metric_name}", []).append(
-                float(metrics["train"]))
+            if provide_training_metric and (it % metric_eval_period == 0
+                                            or it == num_iterations - 1):
+                # the train history records what the device step computes —
+                # with metric='auc' that is the objective default, so key by
+                # the device metric name, not the early-stopping one
+                history.setdefault(f"training_{device_metric_name}", []).append(
+                    float(metrics["train"]))
 
-        if has_valid and (it % metric_eval_period == 0 or it == num_iterations - 1):
-            if auc_host:
-                # exact weighted tie-handled AUC from the downloaded
-                # validation margin (rank statistics don't psum)
-                from .objectives import auc_weighted
-                # (no rf rescale: AUC is rank-based, invariant under the
-                # strictly increasing average-so-far transform)
-                m = auc_weighted(np.asarray(vscores_d)[:nv, 0], yv, wv)
-            else:
-                m = float(metrics["valid"])
-            history[metric_name].append(m)
-            improved = (m > best_metric + es_tol if higher_is_better
-                        else m < best_metric - es_tol)
-            if improved:
-                best_metric, best_iter, rounds_no_improve = m, it, 0
-            else:
-                rounds_no_improve += 1
-            if iteration_callback is not None:
-                iteration_callback(it, {metric_name: m})
-            if early_stopping_rounds > 0 and rounds_no_improve >= early_stopping_rounds:
-                break
-        elif iteration_callback is not None:
-            iteration_callback(it, {})
+            if has_valid and (it % metric_eval_period == 0 or it == num_iterations - 1):
+                if auc_host:
+                    # exact weighted tie-handled AUC from the downloaded
+                    # validation margin (rank statistics don't psum)
+                    from .objectives import auc_weighted
+                    # (no rf rescale: AUC is rank-based, invariant under the
+                    # strictly increasing average-so-far transform)
+                    m = auc_weighted(np.asarray(vscores_d)[:nv, 0], yv, wv)
+                else:
+                    m = float(metrics["valid"])
+                history[metric_name].append(m)
+                _watchdog.report_training_metric("gbdt", it, loss=m,
+                                                 metric_name=metric_name)
+                improved = (m > best_metric + es_tol if higher_is_better
+                            else m < best_metric - es_tol)
+                if improved:
+                    best_metric, best_iter, rounds_no_improve = m, it, 0
+                else:
+                    rounds_no_improve += 1
+                if iteration_callback is not None:
+                    iteration_callback(it, {metric_name: m})
+                if early_stopping_rounds > 0 and rounds_no_improve >= early_stopping_rounds:
+                    break
+            elif iteration_callback is not None:
+                iteration_callback(it, {})
+            now_round = time.perf_counter()
+            _watchdog.report_training_metric("gbdt", it,
+                                             seconds=now_round - t_round)
+            t_round = now_round
 
-        if (ckpt_mgr is not None and checkpoint_period > 0
-                and (it + 1) % checkpoint_period == 0
-                and it + 1 < num_iterations):
-            ckpt_mgr.save(it, {"model": _finalize(all_trees).model_string(),
-                               "iteration": it,
-                               "fingerprint": ckpt_fingerprint,
-                               "prior_iterations":
-                                   0 if user_init_booster is None
-                                   else user_init_booster.num_iterations,
-                               "best_metric": best_metric,
-                               "best_iter": best_iter,
-                               "rounds_no_improve": rounds_no_improve,
-                               "history": history})
+            if (ckpt_mgr is not None and checkpoint_period > 0
+                    and (it + 1) % checkpoint_period == 0
+                    and it + 1 < num_iterations):
+                ckpt_mgr.save(it, {"model": _finalize(all_trees).model_string(),
+                                   "iteration": it,
+                                   "fingerprint": ckpt_fingerprint,
+                                   "prior_iterations":
+                                       0 if user_init_booster is None
+                                       else user_init_booster.num_iterations,
+                                   "best_metric": best_metric,
+                                   "best_iter": best_iter,
+                                   "rounds_no_improve": rounds_no_improve,
+                                   "history": history})
 
+    finally:
+        hb.close()
     booster = _finalize(all_trees)
     # early-stop truncation applies to fresh runs and checkpoint resumes
     # alike (the checkpoint's trees carry global iteration indices); only a
@@ -2209,44 +2234,56 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
         return _scale_booster_values(booster,
                                      np.repeat(scales[:n_done], K))
 
-    for it in range(num_iterations):
-        key = jax.random.fold_in(base_key, it)
-        bag_step = it // max(bagging_freq, 1) if use_bagging else 0
-        bag_key = jax.random.fold_in(base_key, 1_000_003 + bag_step)
-        contribs_d, vcontribs_new, trees_packed = dstep(
-            Xbt_d, y_d, w_d, vmask_d, contribs_d,
-            jnp.asarray(eff_rows[it]),
-            Xvb_d if has_valid else dummy,
-            vcontribs_d if has_valid else dummy,
-            key, bag_key, np.int32(it))
-        if has_valid:
-            vcontribs_d = vcontribs_new
-        trees_host = unpack_trees(np.asarray(trees_packed), (K,),
-                                  2 * cfg.num_leaves - 1,
-                                  bitset_words(cfg.num_bins))
-        for k in range(K):
-            all_trees.append(jax.tree_util.tree_map(lambda a: a[k],
-                                                    trees_host))
-        scales = post_rows[it].copy()
+    hb = _watchdog.register("gbdt_dart_round_loop", stall_seconds=120.0)
+    t_round = time.perf_counter()
+    try:
+        for it in range(num_iterations):
+            hb.beat()
+            key = jax.random.fold_in(base_key, it)
+            bag_step = it // max(bagging_freq, 1) if use_bagging else 0
+            bag_key = jax.random.fold_in(base_key, 1_000_003 + bag_step)
+            contribs_d, vcontribs_new, trees_packed = dstep(
+                Xbt_d, y_d, w_d, vmask_d, contribs_d,
+                jnp.asarray(eff_rows[it]),
+                Xvb_d if has_valid else dummy,
+                vcontribs_d if has_valid else dummy,
+                key, bag_key, np.int32(it))
+            if has_valid:
+                vcontribs_d = vcontribs_new
+            trees_host = unpack_trees(np.asarray(trees_packed), (K,),
+                                      2 * cfg.num_leaves - 1,
+                                      bitset_words(cfg.num_bins))
+            for k in range(K):
+                all_trees.append(jax.tree_util.tree_map(lambda a: a[k],
+                                                        trees_host))
+            scales = post_rows[it].copy()
 
-        if has_valid and (it % metric_eval_period == 0
-                          or it == num_iterations - 1):
-            m = float(deval(vcontribs_d, jnp.asarray(scales), yv_d, wv_d))
-            history[metric_name].append(m)
-            improved = (m > best_metric + es_tol if higher_is_better
-                        else m < best_metric - es_tol)
-            if improved:
-                best_metric, best_iter, rounds_no_improve = m, it, 0
-            else:
-                rounds_no_improve += 1
-            if iteration_callback is not None:
-                iteration_callback(it, {metric_name: m})
-            if (early_stopping_rounds > 0
-                    and rounds_no_improve >= early_stopping_rounds):
-                break
-        elif iteration_callback is not None:
-            iteration_callback(it, {})
+            if has_valid and (it % metric_eval_period == 0
+                              or it == num_iterations - 1):
+                m = float(deval(vcontribs_d, jnp.asarray(scales), yv_d, wv_d))
+                history[metric_name].append(m)
+                _watchdog.report_training_metric("gbdt", it, loss=m,
+                                                 metric_name=metric_name)
+                improved = (m > best_metric + es_tol if higher_is_better
+                            else m < best_metric - es_tol)
+                if improved:
+                    best_metric, best_iter, rounds_no_improve = m, it, 0
+                else:
+                    rounds_no_improve += 1
+                if iteration_callback is not None:
+                    iteration_callback(it, {metric_name: m})
+                if (early_stopping_rounds > 0
+                        and rounds_no_improve >= early_stopping_rounds):
+                    break
+            elif iteration_callback is not None:
+                iteration_callback(it, {})
+            now_round = time.perf_counter()
+            _watchdog.report_training_metric("gbdt", it,
+                                             seconds=now_round - t_round)
+            t_round = now_round
 
+    finally:
+        hb.close()
     booster = _finalize_trees(all_trees, binner, max_bin, K, base, objective,
                               depth_cap, objective_kwargs, best_iter, history,
                               None)

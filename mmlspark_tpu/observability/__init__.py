@@ -1,6 +1,6 @@
-"""Unified telemetry: metrics, spans, tracing, flight recorder, device.
+"""Unified telemetry: metrics, spans, tracing, flight, logs, watchdog.
 
-Five pieces, one flag:
+Eight pieces, one flag:
 
 - :mod:`.metrics` — process-wide ``MetricsRegistry`` (Counter / Gauge /
   Histogram with labels), snapshot-to-dict, Prometheus text renderer.
@@ -13,6 +13,15 @@ Five pieces, one flag:
 - :mod:`.flight` — bounded crash-safe ring buffer of structured events,
   dumped on unhandled exception, SIGUSR2, or demand (``/debug/flight``).
 - :mod:`.device` — ``device_memory_gauges()`` sampling live HBM stats.
+- :mod:`.logging` — structured JSON log funnel (``get_logger``): records
+  carry trace ids + process identity, mirror into the flight ring, and
+  rate-limit per logger; the ONLY sanctioned textual output path.
+- :mod:`.watchdog` — heartbeat stall detection for hot loops (all-thread
+  stack + flight dumps on stall) and training-health sentinels
+  (NaN/divergence/throughput collapse -> ``training_health`` gauge).
+- :mod:`.federation` — the distributed gateway's cluster view: scrape
+  every worker's ``/metrics``, merge under a ``worker`` label, expose
+  ``/debug/cluster`` scrape health.
 
 ``metrics.set_enabled(False)`` turns every instrumentation site in the
 framework into a cheap no-op (profiling.py's never-break-the-pipeline
@@ -31,7 +40,9 @@ from .spans import (clear_trace, current_span, dump_trace,  # noqa: F401
                     get_trace_events, instant, set_default_attrs, span,
                     span_fn)
 from .device import device_memory_gauges  # noqa: F401
-from . import flight, tracing  # noqa: F401
+from .logging import console, get_logger  # noqa: F401
+from . import federation, flight, tracing, watchdog  # noqa: F401
+from . import logging as logging  # noqa: F401,PLC0414 — the funnel module
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -41,6 +52,7 @@ __all__ = [
     "span", "span_fn", "instant", "dump_trace", "get_trace_events",
     "clear_trace", "set_default_attrs", "current_span",
     "TraceContext", "TRACEPARENT_HEADER", "REQUEST_ID_HEADER",
-    "tracing", "flight",
+    "tracing", "flight", "logging", "watchdog", "federation",
+    "get_logger", "console",
     "device_memory_gauges",
 ]

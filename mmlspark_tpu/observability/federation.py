@@ -1,0 +1,403 @@
+"""Cluster metrics federation: one scrape surface for a worker fleet.
+
+Each serving worker exposes its own in-band ``/metrics``; on a pod that
+means operators scrape N addresses and mentally merge them. This module
+gives the distributed-serving gateway the cluster view: a background
+:class:`MetricsFederator` periodically scrapes every registered worker's
+``/metrics``, parses the Prometheus text exposition, and merges families
+under a ``worker`` label:
+
+- **counters** — exported per worker (``worker="host:port"``) AND as a
+  cluster sum (no ``worker`` label);
+- **gauges** — per worker only (a summed queue depth hides the one
+  wedged worker the gauge exists to show);
+- **histograms** — bucket-merged across workers (bucket counts, sum and
+  count are additive).
+
+Merged families are renamed ``cluster_<name>`` so the gateway's own
+process metrics and the fleet view coexist in one exposition without
+family collisions. Scrape health itself is part of the product:
+``cluster_scrape_ok{worker=...}`` / ``cluster_scrape_age_seconds`` ride
+the same payload, and ``/debug/cluster`` reports per-worker scrape
+status, staleness, consecutive failures, and the gateway's last
+failover.
+
+Kill-switch contract: the scrape loop checks ``metrics.enabled()`` every
+tick and does nothing while disabled (and the gateway only routes debug
+paths while enabled), so federation adds zero behavior to a disabled
+deployment.
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import metrics as _metrics
+
+__all__ = [
+    "parse_prometheus_text", "merge_worker_families", "render_families",
+    "MetricsFederator", "DEFAULT_INTERVAL_SECONDS",
+]
+
+_INTERVAL_ENV = "MMLSPARK_TPU_FEDERATION_INTERVAL_SECONDS"
+DEFAULT_INTERVAL_SECONDS = 5.0
+
+#: family name -> (kind, [(labels, value)]) — histogram "values" are
+#: dicts {"buckets": {le_str: count}, "sum": float, "count": float}
+Families = Dict[str, Tuple[str, List[Tuple[Dict[str, str], Any]]]]
+
+
+def _parse_labels(body: str) -> Dict[str, str]:
+    """``a="x",b="y"`` -> dict. Handles escaped quotes/backslashes."""
+    out: Dict[str, str] = {}
+    i, n = 0, len(body)
+    while i < n:
+        eq = body.find("=", i)
+        if eq < 0:
+            break
+        key = body[i:eq].strip().strip(",")
+        j = eq + 1
+        if j >= n or body[j] != '"':
+            break
+        j += 1
+        val: List[str] = []
+        while j < n and body[j] != '"':
+            if body[j] == "\\" and j + 1 < n:
+                nxt = body[j + 1]
+                val.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+                j += 2
+            else:
+                val.append(body[j])
+                j += 1
+        out[key] = "".join(val)
+        i = j + 1
+    return out
+
+
+def _parse_sample(line: str) -> Optional[Tuple[str, Dict[str, str], float]]:
+    brace = line.find("{")
+    if brace >= 0:
+        close = line.rfind("}")
+        if close < brace:
+            return None
+        name = line[:brace].strip()
+        labels = _parse_labels(line[brace + 1:close])
+        rest = line[close + 1:].strip()
+    else:
+        parts = line.split(None, 1)
+        if len(parts) != 2:
+            return None
+        name, rest = parts[0], parts[1]
+        labels = {}
+    try:
+        value = float(rest.split()[0].replace("+Inf", "inf")
+                      .replace("-Inf", "-inf"))
+    except (ValueError, IndexError):
+        return None
+    return name, labels, value
+
+
+def parse_prometheus_text(text: str) -> Families:
+    """Total parse of a text exposition (format 0.0.4) into families.
+
+    Histogram ``_bucket``/``_sum``/``_count`` samples are folded back
+    into one histogram entry per label set. Unknown/malformed lines are
+    skipped — a half-written scrape must never break the federator.
+    """
+    kinds: Dict[str, str] = {}
+    flat: List[Tuple[str, Dict[str, str], float]] = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                kinds[parts[2]] = parts[3]
+            continue
+        parsed = _parse_sample(line)
+        if parsed is not None:
+            flat.append(parsed)
+
+    out: Families = {}
+    hist: Dict[str, Dict[Tuple, Dict[str, Any]]] = {}
+    for name, labels, value in flat:
+        base = None
+        part = None
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and \
+                    kinds.get(name[: -len(suffix)]) == "histogram":
+                base, part = name[: -len(suffix)], suffix
+                break
+        if base is not None:
+            le = labels.pop("le", None)
+            key = tuple(sorted(labels.items()))
+            slot = hist.setdefault(base, {}).setdefault(
+                key, {"labels": dict(labels), "buckets": {},
+                      "sum": 0.0, "count": 0.0})
+            if part == "_bucket" and le is not None:
+                slot["buckets"][le] = value
+            elif part == "_sum":
+                slot["sum"] = value
+            elif part == "_count":
+                slot["count"] = value
+            continue
+        kind = kinds.get(name, "gauge")
+        if kind == "histogram":
+            continue                      # bare histogram base name: skip
+        fam = out.setdefault(name, (kind, []))
+        fam[1].append((labels, value))
+    for base, rows in hist.items():
+        fam = out.setdefault(base, ("histogram", []))
+        for slot in rows.values():
+            fam[1].append((slot["labels"],
+                           {"buckets": slot["buckets"], "sum": slot["sum"],
+                            "count": slot["count"]}))
+    return out
+
+
+def _labels_key(labels: Dict[str, str]) -> Tuple:
+    return tuple(sorted(labels.items()))
+
+
+def merge_worker_families(
+        per_worker: Dict[str, Families]) -> Families:
+    """Merge scrapes from several workers into one ``cluster_``-prefixed
+    family set, per the counter/gauge/histogram rules in the module doc."""
+    merged: Families = {}
+
+    def fam(name: str, kind: str):
+        return merged.setdefault(f"cluster_{name}", (kind, []))
+
+    # counters: per-worker series + cluster sum per original label set
+    sums: Dict[str, Dict[Tuple, Tuple[Dict[str, str], float]]] = {}
+    for worker, families in sorted(per_worker.items()):
+        for name, (kind, rows) in sorted(families.items()):
+            if kind == "counter":
+                f = fam(name, "counter")
+                acc = sums.setdefault(name, {})
+                for labels, value in rows:
+                    f[1].append(({**labels, "worker": worker}, value))
+                    key = _labels_key(labels)
+                    prev = acc.get(key, (labels, 0.0))
+                    acc[key] = (prev[0], prev[1] + float(value))
+            elif kind == "histogram":
+                f = fam(name, "histogram")
+                for labels, h in rows:
+                    # fold into the existing aggregate row for this label set
+                    row = next((r for r in f[1]
+                                if _labels_key(r[0]) == _labels_key(labels)),
+                               None)
+                    if row is None:
+                        f[1].append((dict(labels),
+                                     {"buckets": dict(h["buckets"]),
+                                      "sum": float(h["sum"]),
+                                      "count": float(h["count"])}))
+                    else:
+                        agg = row[1]
+                        for le, c in h["buckets"].items():
+                            agg["buckets"][le] = \
+                                agg["buckets"].get(le, 0.0) + float(c)
+                        agg["sum"] += float(h["sum"])
+                        agg["count"] += float(h["count"])
+            else:                                     # gauges: per-worker
+                f = fam(name, "gauge")
+                for labels, value in rows:
+                    f[1].append(({**labels, "worker": worker}, value))
+    for name, acc in sums.items():
+        f = merged[f"cluster_{name}"]
+        for labels, total in acc.values():
+            f[1].append((dict(labels), total))
+    return merged
+
+
+def _le_sort_key(le: str) -> float:
+    try:
+        return float(le.replace("+Inf", "inf"))
+    except ValueError:
+        return float("inf")
+
+
+def render_families(families: Families) -> str:
+    """Families back to text exposition (the federated half of the
+    gateway's ``/metrics`` body)."""
+    lines: List[str] = []
+    for name, (kind, rows) in sorted(families.items()):
+        lines.append(f"# TYPE {name} {kind}")
+        for labels, value in sorted(
+                rows, key=lambda r: _labels_key(r[0])):
+            if kind == "histogram":
+                for le in sorted(value["buckets"], key=_le_sort_key):
+                    lines.append(_metrics._sample(
+                        f"{name}_bucket", {**labels, "le": le},
+                        value["buckets"][le]))
+                lines.append(_metrics._sample(f"{name}_sum", labels,
+                                              value["sum"]))
+                lines.append(_metrics._sample(f"{name}_count", labels,
+                                              value["count"]))
+            else:
+                lines.append(_metrics._sample(name, labels, value))
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class _WorkerState:
+    __slots__ = ("label", "families", "last_attempt", "last_success",
+                 "consecutive_failures", "error")
+
+    def __init__(self, label: str):
+        self.label = label
+        self.families: Families = {}
+        self.last_attempt = 0.0
+        self.last_success = 0.0
+        self.consecutive_failures = 0
+        self.error: Optional[str] = None
+
+
+class MetricsFederator:
+    """Background scraper + merger over a dynamic worker set.
+
+    ``targets`` returns the current ``[(label, host, port), ...]`` —
+    the gateway passes a closure over its :class:`ServiceRegistry`, so
+    worker churn is picked up on the next sweep without coordination.
+    """
+
+    def __init__(self, targets: Callable[[], List[Tuple[str, str, int]]],
+                 interval: Optional[float] = None, timeout: float = 2.0):
+        import os
+        self.targets = targets
+        if interval is None:
+            try:
+                interval = float(os.environ.get(_INTERVAL_ENV, "")
+                                 or DEFAULT_INTERVAL_SECONDS)
+            except ValueError:
+                interval = DEFAULT_INTERVAL_SECONDS
+        self.interval = max(0.05, float(interval))
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._workers: Dict[str, _WorkerState] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: set by the gateway on failover (surfaced in /debug/cluster)
+        self.last_failover: Optional[Dict[str, Any]] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "MetricsFederator":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="mmlspark-federation", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            if not _metrics.enabled():
+                continue
+            try:
+                self.scrape_once()
+            except Exception:  # noqa: BLE001 — the sweep must never die
+                pass
+
+    # -- scraping ------------------------------------------------------------
+    def scrape_once(self) -> None:
+        """One synchronous sweep over the current target set (tests call
+        this directly for determinism)."""
+        targets = list(self.targets())
+        seen = set()
+        for label, host, port in targets:
+            seen.add(label)
+            st = self._worker(label)
+            st.last_attempt = time.time()
+            try:
+                conn = http.client.HTTPConnection(host, int(port),
+                                                  timeout=self.timeout)
+                conn.request("GET", "/metrics")
+                resp = conn.getresponse()
+                body = resp.read()
+                conn.close()
+                if resp.status != 200:
+                    raise OSError(f"HTTP {resp.status}")
+                st.families = parse_prometheus_text(
+                    body.decode("utf-8", "replace"))
+                st.last_success = time.time()
+                st.consecutive_failures = 0
+                st.error = None
+                _metrics.safe_counter("federation_scrapes_total",
+                                      worker=label, outcome="ok").inc()
+            except Exception as e:  # noqa: BLE001 — a sick worker is data
+                st.consecutive_failures += 1
+                st.error = f"{type(e).__name__}: {e}"
+                _metrics.safe_counter("federation_scrapes_total",
+                                      worker=label, outcome="error").inc()
+        with self._lock:
+            # deregistered workers leave the cluster view at the sweep
+            # AFTER they leave the registry — no ghost series
+            for label in list(self._workers):
+                if label not in seen:
+                    del self._workers[label]
+
+    def _worker(self, label: str) -> _WorkerState:
+        with self._lock:
+            st = self._workers.get(label)
+            if st is None:
+                st = self._workers[label] = _WorkerState(label)
+            return st
+
+    # -- export --------------------------------------------------------------
+    def _scrape_health_families(self) -> Families:
+        now = time.time()
+        ok_rows: List[Tuple[Dict[str, str], Any]] = []
+        age_rows: List[Tuple[Dict[str, str], Any]] = []
+        with self._lock:
+            states = list(self._workers.values())
+        for st in states:
+            ok_rows.append(({"worker": st.label},
+                            1.0 if st.error is None and st.last_success
+                            else 0.0))
+            age_rows.append(({"worker": st.label},
+                             round(now - st.last_success, 3)
+                             if st.last_success else -1.0))
+        return {"cluster_scrape_ok": ("gauge", ok_rows),
+                "cluster_scrape_age_seconds": ("gauge", age_rows)}
+
+    def render_metrics(self) -> bytes:
+        """The federated suffix of the gateway's ``/metrics`` body:
+        merged worker families + scrape-health gauges."""
+        with self._lock:
+            per_worker = {label: st.families
+                          for label, st in self._workers.items()
+                          if st.families}
+        merged = merge_worker_families(per_worker)
+        merged.update(self._scrape_health_families())
+        return render_families(merged).encode("utf-8")
+
+    def cluster_payload(self) -> Dict[str, Any]:
+        """``/debug/cluster`` body: per-worker scrape health + staleness
+        + the gateway's last failover."""
+        now = time.time()
+        workers: Dict[str, Any] = {}
+        with self._lock:
+            states = list(self._workers.items())
+        for label, st in states:
+            workers[label] = {
+                "ok": st.error is None and st.last_success > 0,
+                "last_attempt": st.last_attempt or None,
+                "last_success": st.last_success or None,
+                "staleness_seconds": (round(now - st.last_success, 3)
+                                      if st.last_success else None),
+                "consecutive_failures": st.consecutive_failures,
+                "error": st.error,
+                "families": len(st.families),
+            }
+        return {"time": now, "interval_seconds": self.interval,
+                "workers": workers, "last_failover": self.last_failover}

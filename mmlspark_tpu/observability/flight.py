@@ -186,21 +186,23 @@ _installed_signum: Optional[int] = None
 
 def _on_signal(signum, frame) -> None:  # noqa: ARG001 — signal signature
     try:
+        from . import logging as _logging  # lazy: logging imports flight
         record("signal_dump", signum=int(signum))
         path = dump()
-        print(f"[flight] dumped {len(events())} events to {path}",
-              file=sys.stderr, flush=True)
+        _logging.console(f"[flight] dumped {len(events())} events to {path}",
+                         err=True)
     except Exception:  # noqa: BLE001 — a dump hook must never kill the host
         pass
 
 
 def _on_unhandled(exc_type, exc, tb) -> None:
     try:
+        from . import logging as _logging  # lazy: logging imports flight
         record("unhandled_exception",
                error=f"{exc_type.__name__}: {exc}")
         path = dump()
-        print(f"[flight] unhandled exception; dumped to {path}",
-              file=sys.stderr, flush=True)
+        _logging.console(f"[flight] unhandled exception; dumped to {path}",
+                         err=True)
     except Exception:  # noqa: BLE001
         pass
     hook = _prev_excepthook or sys.__excepthook__

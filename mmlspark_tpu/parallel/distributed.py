@@ -86,6 +86,7 @@ def _tag_spans_with_process_index() -> None:
     here: jax.distributed.initialize has already run."""
     try:
         from ..observability import flight as _flight
+        from ..observability import logging as _logging
         from ..observability import metrics as _metrics
         from ..observability import spans as _spans
         if not _metrics.enabled():
@@ -95,11 +96,16 @@ def _tag_spans_with_process_index() -> None:
             return
         idx = jax.process_index()
         _spans.set_default_attrs(process_index=idx)
-        # same stamp on flight events, so merged post-mortem dumps from
-        # several hosts separate by process the way trace dumps do
+        # same stamp on flight events AND log records, so merged
+        # post-mortem dumps / log streams from several hosts separate by
+        # process the way trace dumps do
         _flight.set_default_fields(process_index=idx)
+        _logging.set_default_fields(process_index=idx)
         _flight.record("distributed_init", process_index=idx,
                        process_count=jax.process_count())
+        _logging.get_logger("mmlspark_tpu.parallel").info(
+            "distributed runtime initialized", process_index=idx,
+            process_count=jax.process_count())
     except Exception:  # noqa: BLE001 — telemetry must never break init
         pass
 
@@ -139,6 +145,14 @@ def barrier(name: str = "barrier") -> None:
         if jax.process_count() == 1:
             return                      # single process: barrier is a no-op
         raise RuntimeError("no distributed client; call initialize() first")
+    from ..observability import watchdog as _watchdog
     from ..observability.spans import span as _span
-    with _span(f"barrier.{name}", metric_label="barrier", barrier=name):
+    # watchdog heartbeat across the wait: a peer that never arrives makes
+    # this process hang here — the stalled-barrier state the watchdog
+    # exists to flag (stuck collectives, not crashes, are how pods fail).
+    # 90 s floor: a wait up to the barrier's own 60 s timeout is legal
+    # (one host finishing a long compile late); only a wait_at_barrier
+    # that overruns its contract — a stuck coordination RPC — flags.
+    with _watchdog.register(f"barrier:{name}", stall_seconds=90.0), \
+            _span(f"barrier.{name}", metric_label="barrier", barrier=name):
         client.wait_at_barrier(name, timeout_in_ms=60_000)

@@ -14,7 +14,6 @@ interleaving (each equal-size shard sees every label).
 from __future__ import annotations
 
 import contextlib
-import logging
 import pickle
 import time
 import unicodedata
@@ -28,7 +27,9 @@ from ..core.params import (HasInputCol, HasInputCols, HasLabelCol, HasOutputCol,
 from ..core.pipeline import (Estimator, Model, PipelineStage, Transformer,
                              load_stage, save_stage)
 
-logger = logging.getLogger("mmlspark_tpu")
+from ..observability.logging import get_logger
+
+logger = get_logger("mmlspark_tpu")
 
 
 class DropColumns(Transformer):

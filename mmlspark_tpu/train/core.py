@@ -18,7 +18,11 @@ from ..core.params import (HasFeaturesCol, HasLabelCol, Param, TypeConverters)
 from ..core.pipeline import Estimator, Model, Transformer
 from ..featurize.core import Featurize, ValueIndexer
 from ..observability import metrics as _metrics
+from ..observability import watchdog as _watchdog
+from ..observability.logging import get_logger
 from ..observability.spans import span as _span
+
+logger = get_logger("mmlspark_tpu.train")
 
 
 class TrainClassifier(Estimator, HasLabelCol):
@@ -59,10 +63,18 @@ class TrainClassifier(Estimator, HasLabelCol):
             ds = feat_model.transform(ds)
         inner = self.get_or_default("model").copy(
             {"labelCol": label, "featuresCol": fcol})
-        with _span(f"{self.uid}.fit_inner",
-                   metric_label="TrainClassifier.fit_inner",
-                   inner=type(inner).__name__):
+        # watchdog heartbeat over the blocking inner fit: a wedged
+        # estimator (stuck collective, hung native call) gets flagged
+        # with full stacks instead of hanging the training job mutely
+        with _watchdog.register("train_classifier_fit",
+                                stall_seconds=600.0), \
+                _span(f"{self.uid}.fit_inner",
+                      metric_label="TrainClassifier.fit_inner",
+                      inner=type(inner).__name__):
             fitted = inner.fit(ds)
+        logger.info("TrainClassifier fit complete",
+                    inner=type(inner).__name__,
+                    rows=len(ds), classes=len(levels) if levels else None)
         model = TrainedClassifierModel(featurizer=feat_model, inner=fitted,
                                        levels=levels)
         self._copy_params_to(model)
@@ -157,10 +169,14 @@ class TrainRegressor(Estimator, HasLabelCol):
             ds = feat_model.transform(dataset)
         inner = self.get_or_default("model").copy(
             {"labelCol": label, "featuresCol": fcol})
-        with _span(f"{self.uid}.fit_inner",
-                   metric_label="TrainRegressor.fit_inner",
-                   inner=type(inner).__name__):
+        with _watchdog.register("train_regressor_fit",
+                                stall_seconds=600.0), \
+                _span(f"{self.uid}.fit_inner",
+                      metric_label="TrainRegressor.fit_inner",
+                      inner=type(inner).__name__):
             fitted = inner.fit(ds)
+        logger.info("TrainRegressor fit complete",
+                    inner=type(inner).__name__, rows=len(ds))
         model = TrainedRegressorModel(featurizer=feat_model, inner=fitted)
         self._copy_params_to(model)
         return model

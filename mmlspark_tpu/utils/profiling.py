@@ -27,10 +27,11 @@ recorded) rather than failing the pipeline it instruments.
 from __future__ import annotations
 
 import contextlib
-import logging
 from typing import Any, Dict, Iterator, Optional
 
-logger = logging.getLogger(__name__)
+from ..observability.logging import get_logger
+
+logger = get_logger(__name__)
 
 __all__ = ["trace", "annotate", "annotate_fn", "device_memory_stats"]
 

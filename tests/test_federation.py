@@ -1,0 +1,299 @@
+"""Gateway metrics federation: parser, merge rules, and the live cluster.
+
+Covers observability/federation.py:
+
+* the Prometheus text parser round-trips the registry's own renderer
+  (counters, gauges, labeled histograms, escapes);
+* merge rules: counters per-worker + summed, gauges per-worker only,
+  histograms bucket-merged;
+* scrape health bookkeeping incl. failures and worker churn;
+* the acceptance scenario: a REAL 3-process deployment (gateway + two
+  serving_main workers over a shared file registry) serves requests and
+  the gateway's single /metrics payload shows per-``worker`` labels and
+  correctly summed counters; /debug/cluster reports both scrapes healthy.
+"""
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.observability import federation, metrics, spans
+from mmlspark_tpu.observability.federation import (MetricsFederator,
+                                                   merge_worker_families,
+                                                   parse_prometheus_text,
+                                                   render_families)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    prev = metrics.set_enabled(True)
+    metrics.reset()
+    spans.clear_trace()
+    yield
+    metrics.set_enabled(prev)
+    metrics.reset()
+    spans.clear_trace()
+
+
+class TestParser:
+    def test_round_trips_own_renderer(self):
+        metrics.counter("reqs_total", api="a", code="200").inc(5)
+        metrics.gauge("depth", api="a").set(3.5)
+        h = metrics.histogram("lat_seconds", api="a")
+        h.observe(0.003)
+        h.observe(2.0)
+        fams = parse_prometheus_text(
+            metrics.get_registry().render_prometheus())
+        assert fams["reqs_total"][0] == "counter"
+        assert fams["reqs_total"][1] == [
+            ({"api": "a", "code": "200"}, 5.0)]
+        assert fams["depth"][1] == [({"api": "a"}, 3.5)]
+        kind, rows = fams["lat_seconds"]
+        assert kind == "histogram" and len(rows) == 1
+        labels, hist = rows[0]
+        assert labels == {"api": "a"}
+        assert hist["count"] == 2 and hist["sum"] == pytest.approx(2.003)
+        assert hist["buckets"]["+Inf"] == 2
+        assert hist["buckets"]["0.005"] == 1
+
+    def test_escaped_label_values(self):
+        metrics.counter("odd_total", path='a"b\\c\nd').inc()
+        fams = parse_prometheus_text(
+            metrics.get_registry().render_prometheus())
+        assert fams["odd_total"][1] == [({"path": 'a"b\\c\nd'}, 1.0)]
+
+    def test_garbage_lines_are_skipped(self):
+        fams = parse_prometheus_text(
+            "# HELP x whatever\nnot a sample\nx{unclosed 3\n"
+            "# TYPE ok counter\nok 2\n")
+        assert fams["ok"] == ("counter", [({}, 2.0)])
+
+
+class TestMergeRules:
+    def _families(self, n):
+        return parse_prometheus_text(
+            f"# TYPE req_total counter\nreq_total{{api=\"a\"}} {n}\n"
+            f"# TYPE depth gauge\ndepth {n}\n"
+            "# TYPE lat histogram\n"
+            f'lat_bucket{{le="1"}} {n}\nlat_bucket{{le="+Inf"}} {n + 1}\n'
+            f"lat_sum 3.0\nlat_count {n + 1}\n")
+
+    def test_counters_gauges_histograms(self):
+        merged = merge_worker_families({"w1": self._families(2),
+                                        "w2": self._families(3)})
+        kind, rows = merged["cluster_req_total"]
+        assert kind == "counter"
+        as_map = {federation._labels_key(lb): v for lb, v in rows}
+        assert as_map[(("api", "a"), ("worker", "w1"))] == 2.0
+        assert as_map[(("api", "a"), ("worker", "w2"))] == 3.0
+        assert as_map[(("api", "a"),)] == 5.0          # the cluster sum
+        # gauges: per-worker ONLY (no meaningless sum)
+        grows = merged["cluster_depth"][1]
+        assert sorted(v for _, v in grows) == [2.0, 3.0]
+        assert all("worker" in lb for lb, _ in grows)
+        # histograms: bucket-merged aggregate
+        kind, hrows = merged["cluster_lat"]
+        assert kind == "histogram" and len(hrows) == 1
+        _, hist = hrows[0]
+        assert hist["buckets"] == {"1": 5.0, "+Inf": 7.0}
+        assert hist["sum"] == 6.0 and hist["count"] == 7.0
+        # and the rendering is valid exposition text
+        text = render_families(merged)
+        assert 'cluster_req_total{api="a"} 5' in text
+        assert 'cluster_lat_bucket{le="+Inf"} 7' in text
+
+
+class TestFederatorScrapes:
+    def test_scrape_merge_failure_and_churn(self):
+        from mmlspark_tpu.io.serving import ServingServer
+
+        metrics.counter("served_total", api="x").inc(4)
+        srv = ServingServer("localhost", 0, "x").start()
+        targets = [("w1", srv.host, srv.port),
+                   ("dead", "localhost", 1)]       # nothing listens on :1
+        fed = MetricsFederator(lambda: targets, interval=999)
+        try:
+            fed.scrape_once()
+            body = fed.render_metrics().decode()
+            assert 'cluster_served_total{api="x",worker="w1"} 4' in body
+            assert 'cluster_scrape_ok{worker="w1"} 1' in body
+            assert 'cluster_scrape_ok{worker="dead"} 0' in body
+            payload = fed.cluster_payload()
+            assert payload["workers"]["w1"]["ok"] is True
+            assert payload["workers"]["w1"]["staleness_seconds"] < 60
+            assert payload["workers"]["dead"]["ok"] is False
+            assert payload["workers"]["dead"]["consecutive_failures"] == 1
+            assert payload["workers"]["dead"]["error"]
+            # churn: a deregistered worker leaves the view next sweep
+            targets[:] = [("w1", srv.host, srv.port)]
+            fed.scrape_once()
+            assert "dead" not in fed.cluster_payload()["workers"]
+        finally:
+            fed.stop()
+            srv.stop()
+
+    def test_disabled_sweep_is_inert(self):
+        calls = []
+
+        def targets():
+            calls.append(1)
+            return []
+
+        fed = MetricsFederator(targets, interval=0.05)
+        metrics.set_enabled(False)
+        try:
+            fed.start()
+            time.sleep(0.3)
+        finally:
+            fed.stop()
+            metrics.set_enabled(True)
+        assert calls == []                 # never even asked for targets
+
+
+def _wait_for(proc, pattern, timeout=90):
+    import queue
+    import re
+    import threading
+
+    q = queue.Queue()
+
+    def reader():
+        for line in proc.stdout:
+            q.put(line)
+
+    threading.Thread(target=reader, daemon=True).start()
+    out = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            line = q.get(timeout=0.25)
+        except queue.Empty:
+            continue
+        out.append(line)
+        m = re.search(pattern, line)
+        if m:
+            return m, out
+    raise AssertionError(f"pattern {pattern!r} not seen in {out}")
+
+
+def _get(host, port, path, timeout=30):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+class TestThreeProcessCluster:
+    def test_gateway_federates_two_real_workers(self, tmp_path):
+        """The acceptance scenario: 2 worker processes + 1 gateway process
+        over a shared file registry. One federated /metrics payload shows
+        per-worker labels AND a cluster sum equal to the requests served;
+        /debug/cluster shows both scrapes healthy."""
+        from mmlspark_tpu.core.dataset import Dataset
+        from mmlspark_tpu.models.gbdt.api import LightGBMRegressor
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(200, 4)).astype(np.float32)
+        y = (X @ np.array([1.0, -2.0, 0.5, 0.0])).astype(np.float32)
+        model = LightGBMRegressor(numIterations=3, numLeaves=7,
+                                  minDataInLeaf=5).fit(
+            Dataset({"features": X, "label": y}))
+        model_file = tmp_path / "model.txt"
+        model_file.write_text(model.get_native_model())
+        registry = tmp_path / "registry"
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = ROOT
+        env["MMLSPARK_TPU_FEDERATION_INTERVAL_SECONDS"] = "0.3"
+        procs = []
+        try:
+            for _ in range(2):
+                w = subprocess.Popen(
+                    [sys.executable, "-m", "mmlspark_tpu.io.serving_main",
+                     "worker", "--model", str(model_file),
+                     "--registry", str(registry),
+                     "--host", "localhost", "--port", "0"],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True, env=env)
+                procs.append(w)
+                _wait_for(w, r"worker \w+ serving on")
+            gateway = subprocess.Popen(
+                [sys.executable, "-m", "mmlspark_tpu.io.serving_main",
+                 "gateway", "--registry", str(registry),
+                 "--host", "localhost", "--port", "0"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env)
+            procs.append(gateway)
+            m, _ = _wait_for(gateway, r"gateway on ([\w.]+):(\d+)")
+            host, port = m.group(1), int(m.group(2))
+
+            n_requests = 6
+            for i in range(n_requests):
+                conn = http.client.HTTPConnection(host, port, timeout=30)
+                conn.request("POST", "/serving", body=json.dumps(
+                    {"features": X[i].tolist()}))
+                r = conn.getresponse()
+                assert r.status == 200, r.read()
+                r.read()
+                conn.close()
+
+            # one federated payload: per-worker labels + the true sum
+            # (poll: the scrape loop runs every 0.3 s)
+            def parse_cluster():
+                status, body = _get(host, port, "/metrics")
+                assert status == 200
+                fams = parse_prometheus_text(body.decode())
+                return fams.get("cluster_serving_responses_total",
+                                ("counter", []))[1]
+
+            deadline = time.monotonic() + 60
+            rows = []
+            while time.monotonic() < deadline:
+                rows = parse_cluster()
+                total = [v for lb, v in rows
+                         if "worker" not in lb and lb.get("code") == "200"]
+                if total and total[0] == float(n_requests):
+                    break
+                time.sleep(0.3)
+            per_worker = {lb["worker"]: v for lb, v in rows
+                          if "worker" in lb and lb.get("code") == "200"}
+            assert len(per_worker) == 2, rows
+            assert sum(per_worker.values()) == float(n_requests), rows
+            agg = [v for lb, v in rows
+                   if "worker" not in lb and lb.get("code") == "200"]
+            assert agg == [float(n_requests)], rows
+            # both workers took some traffic (least-inflight round robin)
+            assert all(v > 0 for v in per_worker.values()), per_worker
+
+            # the gateway's own families still render in the same payload
+            status, body = _get(host, port, "/metrics")
+            assert b"# TYPE gateway_responses_total counter" in body
+
+            # /debug/cluster: both scrapes healthy, no failover yet
+            status, body = _get(host, port, "/debug/cluster")
+            assert status == 200
+            cluster = json.loads(body)
+            assert len(cluster["workers"]) == 2
+            for w in cluster["workers"].values():
+                assert w["ok"] is True, cluster
+                assert w["consecutive_failures"] == 0
+            assert cluster["last_failover"] is None
+
+            # /varz carries the cluster section too
+            status, body = _get(host, port, "/varz")
+            assert json.loads(body)["cluster"]["workers"]
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.wait(timeout=30)

@@ -73,7 +73,8 @@ def test_observability_has_no_top_level_framework_imports():
                 offenders.append(f"{os.path.basename(path)}: "
                                  f"{'.' * level}{mod}")
             elif level == 1 and top not in (
-                    "metrics", "spans", "device", "tracing", "flight", ""):
+                    "metrics", "spans", "device", "tracing", "flight",
+                    "logging", "watchdog", "federation", ""):
                 offenders.append(f"{os.path.basename(path)}: .{mod}")
     assert not offenders, (
         "observability must defer framework imports into function bodies "
@@ -373,6 +374,60 @@ def test_auto_sentinel_resolved_before_program_cache_keys():
     assert _first_lineno(gc, is_resolver_call) is not None, (
         "_grow_config must resolve 'auto' before handing GrowConfig to "
         "direct consumers (the sweep path bypasses train_booster)")
+
+
+_LOG_FUNNEL = os.path.join("observability", "logging.py")
+
+
+def test_no_raw_text_output_outside_logging_funnel():
+    """``observability/logging.py`` is the ONE textual-output path for the
+    framework: structured records via ``get_logger`` (JSON lines +
+    flight ring + rate limit + trace ids) and ``console()`` for the few
+    sanctioned CLI ready-lines. A bare ``print(`` or
+    ``sys.stderr/stdout.write`` anywhere else under ``mmlspark_tpu/``
+    bypasses all of that — records with no trace identity, no collection
+    path, and no kill-switch discipline."""
+    offenders = []
+    for path in _py_files(_PKG_ROOT):
+        if os.path.relpath(path, _PKG_ROOT) == _LOG_FUNNEL:
+            continue
+        for node in ast.walk(_parse(path)):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "print":
+                offenders.append((os.path.relpath(path, _PKG_ROOT),
+                                  node.lineno, "print("))
+            elif (isinstance(node, ast.Attribute)
+                    and node.attr == "write"
+                    and isinstance(node.value, ast.Attribute)
+                    and node.value.attr in ("stderr", "stdout")
+                    and isinstance(node.value.value, ast.Name)
+                    and node.value.value.id == "sys"):
+                offenders.append((os.path.relpath(path, _PKG_ROOT),
+                                  node.lineno,
+                                  f"sys.{node.value.attr}.write"))
+    assert not offenders, (
+        "textual output must route through observability.logging "
+        f"(get_logger / console): {offenders}")
+
+
+def test_no_stdlib_getlogger_outside_logging_funnel():
+    """Framework code must log through ``observability.logging.get_logger``
+    — records then carry trace ids, rate limiting, and the flight-ring
+    mirror. A direct stdlib ``logging.getLogger`` creates a parallel,
+    unstructured stream that the kill switch and collectors never see."""
+    offenders = []
+    for path in _py_files(_PKG_ROOT):
+        if os.path.relpath(path, _PKG_ROOT) == _LOG_FUNNEL:
+            continue
+        for node in ast.walk(_parse(path)):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr == "getLogger":
+                offenders.append((os.path.relpath(path, _PKG_ROOT),
+                                  node.lineno))
+    assert not offenders, (
+        "use observability.logging.get_logger, not stdlib "
+        f"logging.getLogger: {offenders}")
 
 
 def test_trace_header_names_come_from_tracing_module():

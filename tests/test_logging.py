@@ -1,0 +1,251 @@
+"""Structured log funnel: records, trace correlation, rate limit, kill switch.
+
+Covers observability/logging.py's contracts:
+
+* records are JSON lines carrying level/logger/msg + structured fields,
+  printf-style args format like stdlib loggers, default fields stamp on;
+* trace-id correlation: a record emitted inside an active TraceContext
+  carries that context's ids (and so do the flight-ring mirrors);
+* per-logger rate limiting with a drop counter and a suppression notice;
+* kill switch: disabled -> zero output, zero flight events, zero registry
+  families — proven on a live serving round-trip whose transform logs.
+"""
+
+import http.client
+import json
+import os
+
+import pytest
+
+from mmlspark_tpu.observability import flight, metrics, spans, tracing
+from mmlspark_tpu.observability import logging as obslog
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry(tmp_path):
+    prev = metrics.set_enabled(True)
+    metrics.reset()
+    spans.clear_trace()
+    flight.clear()
+    obslog._reset_for_tests()
+    yield
+    obslog._reset_for_tests()
+    metrics.set_enabled(prev)
+    metrics.reset()
+    spans.clear_trace()
+    flight.clear()
+
+
+def _records(path):
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+class TestRecords:
+    def test_json_records_with_fields_and_printf_args(self, tmp_path):
+        sink = tmp_path / "log.jsonl"
+        obslog.set_log_file(str(sink))
+        lg = obslog.get_logger("test.records")
+        lg.info("fit took %.2fs on %s", 1.5, "cpu", rows=100)
+        lg.warning("plain")
+        recs = _records(sink)
+        assert len(recs) == 2
+        assert recs[0]["msg"] == "fit took 1.50s on cpu"
+        assert recs[0]["level"] == "info"
+        assert recs[0]["logger"] == "test.records"
+        assert recs[0]["rows"] == 100
+        assert recs[0]["pid"] == os.getpid()
+        assert recs[1]["level"] == "warning"
+        # counters track emissions per level
+        assert metrics.get_registry().counter(
+            "log_records_total", level="info").value == 1
+
+    def test_level_threshold_filters(self, tmp_path):
+        sink = tmp_path / "log.jsonl"
+        obslog.set_log_file(str(sink))
+        lg = obslog.get_logger("test.levels")
+        assert obslog.get_level() == "info"     # default
+        lg.debug("invisible")
+        prev = obslog.set_level("debug")
+        assert prev == "info"
+        lg.debug("visible")
+        obslog.set_level("error")
+        lg.warning("filtered")
+        lg.error("kept")
+        msgs = [r["msg"] for r in _records(sink)]
+        assert msgs == ["visible", "kept"]
+
+    def test_default_fields_stamp_and_unset(self, tmp_path):
+        sink = tmp_path / "log.jsonl"
+        obslog.set_log_file(str(sink))
+        obslog.set_default_fields(process_index=3, role="worker")
+        obslog.get_logger("t").info("a")
+        obslog.set_default_fields(role=None)
+        obslog.get_logger("t").info("b")
+        recs = _records(sink)
+        assert recs[0]["process_index"] == 3 and recs[0]["role"] == "worker"
+        assert recs[1]["process_index"] == 3 and "role" not in recs[1]
+
+    def test_bad_format_and_unserializable_fields_never_raise(self, tmp_path):
+        sink = tmp_path / "log.jsonl"
+        obslog.set_log_file(str(sink))
+        lg = obslog.get_logger("t")
+        lg.info("%d things", "not-a-number")         # bad printf
+        lg.info("obj", blob=object())                # non-JSON field
+        recs = _records(sink)
+        assert len(recs) == 2
+        assert "not-a-number" in recs[0]["msg"]
+        assert "object object" in recs[1]["blob"]    # repr fallback
+
+
+class TestTraceCorrelation:
+    def test_record_carries_active_trace_ids(self, tmp_path):
+        sink = tmp_path / "log.jsonl"
+        obslog.set_log_file(str(sink))
+        ctx = tracing.new_context()
+        with tracing.use(ctx):
+            obslog.get_logger("t").info("inside")
+        obslog.get_logger("t").info("outside")
+        recs = _records(sink)
+        assert recs[0]["trace_id"] == ctx.trace_id
+        assert recs[0]["span_id"] == ctx.span_id
+        assert "trace_id" not in recs[1]
+
+    def test_flight_ring_mirror_carries_trace_ids(self):
+        ctx = tracing.new_context()
+        with tracing.use(ctx):
+            obslog.get_logger("t").error("boom", site="x")
+        evs = [e for e in flight.events() if e["kind"] == "log"]
+        assert len(evs) == 1
+        assert evs[0]["msg"] == "boom"
+        assert evs[0]["level"] == "error"
+        assert evs[0]["site"] == "x"
+        assert evs[0]["trace_id"] == ctx.trace_id
+
+
+class TestRateLimit:
+    def test_cap_drop_counter_and_suppression_notice(self, tmp_path):
+        sink = tmp_path / "log.jsonl"
+        obslog.set_log_file(str(sink))
+        obslog.set_rate_limit(5)
+        lg = obslog.get_logger("test.hot")
+        for i in range(25):
+            lg.info("spam %d", i)
+        recs = _records(sink)
+        assert len(recs) == 5                       # window cap holds
+        dropped = metrics.get_registry().counter(
+            "log_records_dropped_total", logger="test.hot").value
+        assert dropped == 20
+        # the next window reopens with ONE suppression notice
+        lg._win[0] -= 2.0                           # age the window out
+        lg.info("after")
+        msgs = [r["msg"] for r in _records(sink)]
+        assert any("suppressed 20 records" in m for m in msgs)
+        assert msgs[-1] == "after"
+
+    def test_other_loggers_unaffected(self, tmp_path):
+        sink = tmp_path / "log.jsonl"
+        obslog.set_log_file(str(sink))
+        obslog.set_rate_limit(2)
+        hot, cold = obslog.get_logger("hot"), obslog.get_logger("cold")
+        for i in range(10):
+            hot.info("h%d", i)
+        cold.info("c")
+        msgs = [r["msg"] for r in _records(sink)]
+        assert msgs == ["h0", "h1", "c"]
+
+    def test_zero_disables_limiting(self, tmp_path):
+        sink = tmp_path / "log.jsonl"
+        obslog.set_log_file(str(sink))
+        obslog.set_rate_limit(0)
+        lg = obslog.get_logger("t")
+        for i in range(300):
+            lg.info("m%d", i)
+        assert len(_records(sink)) == 300
+
+
+class TestConsole:
+    def test_console_bypasses_kill_switch(self, tmp_path, capsys):
+        metrics.set_enabled(False)
+        obslog.console("worker abc serving on host:1")
+        obslog.console("note", err=True)
+        out = capsys.readouterr()
+        assert out.out == "worker abc serving on host:1\n"
+        assert out.err == "note\n"
+
+
+def _echo_transform(ds):
+    # a transform that logs per batch — the disabled path must silence it
+    obslog.get_logger("test.serving").info("batch", n=len(ds["id"]))
+    return ds.with_column(
+        "reply", [{"entity": {"ok": True}, "statusCode": 200}
+                  for _ in ds["id"]])
+
+
+class TestDisabledByteIdentity:
+    def test_live_serving_round_trip_disabled_is_inert(self, tmp_path):
+        """set_enabled(False) before the server starts: the round-trip
+        behaves exactly like uninstrumented code — no trace echo header,
+        no log bytes, no flight events, registry untouched."""
+        from mmlspark_tpu.io.serving import serve
+
+        sink = tmp_path / "log.jsonl"
+        obslog.set_log_file(str(sink))
+        metrics.set_enabled(False)
+        metrics.reset()
+        flight.clear()
+        q = (serve().address("localhost", 0, "quiet")
+             .batch(max_batch=8, max_latency_ms=5)
+             .transform(_echo_transform).start())
+        try:
+            conn = http.client.HTTPConnection(q.server.host, q.server.port,
+                                              timeout=10)
+            conn.request("POST", "/quiet", body=b"{}")
+            resp = conn.getresponse()
+            body = resp.read()
+            headers = {k.lower() for k, _ in resp.getheaders()}
+            conn.close()
+            assert resp.status == 200
+            assert json.loads(body) == {"ok": True}
+            assert "x-request-id" not in headers
+            # byte-level silence on every output surface
+            assert _records(sink) == []
+            assert flight.events() == []
+            assert metrics.get_registry().snapshot() == {}
+            # and the watchdog never started for the disabled server
+            from mmlspark_tpu.observability import watchdog
+            assert all(h["site"] != "serving_batch:quiet"
+                       for h in watchdog.heartbeats())
+        finally:
+            metrics.set_enabled(True)
+            q.stop()
+
+    def test_enabled_round_trip_does_log(self, tmp_path):
+        # control experiment for the test above: same server, enabled —
+        # the transform's record reaches the sink with trace correlation
+        from mmlspark_tpu.io.serving import serve
+
+        sink = tmp_path / "log.jsonl"
+        obslog.set_log_file(str(sink))
+        q = (serve().address("localhost", 0, "loud")
+             .batch(max_batch=8, max_latency_ms=5)
+             .transform(_echo_transform).start())
+        try:
+            conn = http.client.HTTPConnection(q.server.host, q.server.port,
+                                              timeout=10)
+            conn.request("POST", "/loud", body=b"{}")
+            resp = conn.getresponse()
+            rid = dict((k.lower(), v) for k, v in resp.getheaders()).get(
+                "x-request-id")
+            resp.read()
+            conn.close()
+            assert resp.status == 200 and rid
+            recs = [r for r in _records(sink) if r["msg"] == "batch"]
+            assert len(recs) == 1
+            # the batch thread re-activates the request's trace, so the
+            # transform's log line carries the request's trace id
+            assert recs[0]["trace_id"] == rid
+        finally:
+            q.stop()

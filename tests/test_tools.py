@@ -105,6 +105,59 @@ def test_serving_main_worker_and_gateway(tmp_path):
             p.wait(timeout=10)
 
 
+class TestBenchRegression:
+    """tools/bench_regression.py compares the two newest BENCH_r*.json
+    and gates on >20% throughput drops — exercised on synthetic fixtures
+    (the real rounds carry relay jitter and must not gate the suite)."""
+
+    def _write_round(self, d, n, line):
+        # the driver wrapper shape: bench stdout lives in "tail", last
+        # JSON line wins
+        (d / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+            "n": n, "rc": 0,
+            "tail": "noise line\n" + json.dumps(line) + "\n"}))
+
+    def _run(self, d, *extra):
+        return subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "bench_regression.py"),
+             str(d), *extra],
+            capture_output=True, text=True, timeout=60)
+
+    def test_pass_and_regression_exit_codes(self, tmp_path):
+        base = {"metric": "gbdt_trees_per_sec", "value": 10.0,
+                "gbdt_predict_rows_per_sec": 1000.0,
+                "broken_rows_per_sec": -1.0,       # failed secondary: skip
+                "serving_p50_ms": 1.0}             # not a throughput key
+        self._write_round(tmp_path, 1, base)
+        ok = dict(base, value=8.5, gbdt_predict_rows_per_sec=900.0,
+                  serving_p50_ms=100.0)            # 15%/10% drops: fine
+        self._write_round(tmp_path, 2, ok)
+        r = self._run(tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "OK" in r.stdout
+
+        bad = dict(base, gbdt_predict_rows_per_sec=500.0)   # 50% drop
+        self._write_round(tmp_path, 3, bad)
+        r = self._run(tmp_path)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "gbdt_predict_rows_per_sec" in r.stdout
+
+    def test_value_gated_only_on_matching_metric(self, tmp_path):
+        self._write_round(tmp_path, 1, {
+            "metric": "gbdt_trees_per_sec_1M_rows_28f", "value": 30.0})
+        # a CPU-fallback round must not gate against a TPU round's value
+        self._write_round(tmp_path, 2, {
+            "metric": "gbdt_trees_per_sec_50k_rows_28f_CPU_FALLBACK",
+            "value": 3.0})
+        r = self._run(tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_single_round_is_a_pass(self, tmp_path):
+        self._write_round(tmp_path, 1, {"metric": "m", "value": 1.0})
+        r = self._run(tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+
 def test_docker_tree_well_formed():
     for rel in ("docker/minimal/Dockerfile", "docker/serving/Dockerfile"):
         text = open(os.path.join(TOOLS, rel)).read()

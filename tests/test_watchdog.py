@@ -1,0 +1,257 @@
+"""Watchdog: stall detection on hung loops + training-health sentinels.
+
+Covers observability/watchdog.py end to end:
+
+* a deliberately hung fake batch thread is flagged within one sampling
+  period past the stall threshold — counter, flight event with ALL
+  thread stacks, and a flight-ring dump on disk;
+* flagging is once-per-episode and re-arms after the heartbeat resumes;
+* heartbeats from dead threads deregister instead of stalling forever;
+* NaN loss / divergence / throughput collapse flip the
+  ``training_health`` gauge and leave flight events;
+* a real (synthetic NaN-loss) LightGBMRegressor fit flips the gauge;
+* kill switch: registration is a no-op and no sampler thread starts.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.observability import flight, metrics, spans, watchdog
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry(tmp_path, monkeypatch):
+    monkeypatch.setenv("MMLSPARK_TPU_FLIGHT_DIR", str(tmp_path / "dumps"))
+    prev = metrics.set_enabled(True)
+    metrics.reset()
+    spans.clear_trace()
+    flight.clear()
+    watchdog.stop()
+    watchdog.reset_training_health()
+    prev_stall = watchdog.set_stall_seconds(0.3)
+    prev_int = watchdog.set_interval_seconds(0.1)
+    yield
+    watchdog.stop()
+    watchdog.reset_training_health()
+    watchdog.set_stall_seconds(prev_stall)
+    watchdog.set_interval_seconds(prev_int)
+    metrics.set_enabled(prev)
+    metrics.reset()
+    spans.clear_trace()
+    flight.clear()
+
+
+def _stall_count(site):
+    return metrics.get_registry().counter(
+        "watchdog_stalls_total", site=site).value
+
+
+def _wait_until(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _fake_batch_thread(hang_evt, release_evt, beats=3):
+    """A stand-in serving batch loop: beats a few times, then wedges on
+    an event — exactly the shape of a hung transform."""
+    hb = watchdog.register("fake_batch")
+    try:
+        for _ in range(beats):
+            hb.beat()
+            time.sleep(0.01)
+        hang_evt.set()
+        release_evt.wait(timeout=30)     # the deliberate hang
+        hb.beat()                         # recovery beat
+        release_evt.wait(timeout=0)
+    finally:
+        hb.close()
+
+
+class TestStallDetection:
+    def test_hung_fake_batch_thread_flagged_with_stacks_and_dump(self):
+        hang, release = threading.Event(), threading.Event()
+        t = threading.Thread(target=_fake_batch_thread,
+                             args=(hang, release), daemon=True)
+        t0 = time.monotonic()
+        t.start()
+        try:
+            assert hang.wait(10)
+            # flagged within stall + a couple of sampling periods
+            assert _wait_until(lambda: _stall_count("fake_batch") >= 1,
+                               timeout=10)
+            detect = time.monotonic() - t0
+            assert detect < 0.3 + 10 * 0.1 + 2.0   # loose CI bound
+            evs = [e for e in flight.events()
+                   if e["kind"] == "watchdog_stall"]
+            assert len(evs) == 1
+            ev = evs[0]
+            assert ev["site"] == "fake_batch"
+            assert ev["age_seconds"] >= 0.3
+            assert ev["beats"] == 3
+            # ALL thread stacks, including the hung thread's wait site
+            joined = "".join(ev["stacks"].values())
+            assert "_fake_batch_thread" in joined
+            assert "release_evt.wait" in joined
+            # the flight ring was dumped to disk
+            dumps = os.listdir(os.environ["MMLSPARK_TPU_FLIGHT_DIR"])
+            assert any(d.startswith("flight-") for d in dumps)
+            # once per episode: more sampling periods, still one flag
+            time.sleep(0.5)
+            assert _stall_count("fake_batch") == 1
+        finally:
+            release.set()
+            t.join(timeout=10)
+
+    def test_rearm_after_recovery(self):
+        hb = watchdog.register("bouncy")
+        try:
+            assert _wait_until(lambda: _stall_count("bouncy") == 1)
+            hb.beat()                                # recover
+            assert _wait_until(lambda: any(
+                e["kind"] == "watchdog_recovered"
+                for e in flight.events()))
+            assert _wait_until(lambda: _stall_count("bouncy") == 2)
+        finally:
+            hb.close()
+
+    def test_dead_thread_deregisters_instead_of_stalling(self):
+        out = {}
+
+        def short_lived():
+            out["hb"] = watchdog.register("leaky")   # no close(): crashed
+
+        t = threading.Thread(target=short_lived)
+        t.start()
+        t.join()
+        assert _wait_until(lambda: all(
+            h["site"] != "leaky" for h in watchdog.heartbeats()))
+        assert _stall_count("leaky") == 0
+
+    def test_site_floor_raises_threshold(self):
+        # framework loops pass stall_seconds floors (cold compiles are
+        # slow-but-alive): effective threshold = max(site, global)
+        hb = watchdog.register("patient", stall_seconds=30.0)
+        try:
+            time.sleep(0.6)               # well past the 0.3 s global
+            assert _stall_count("patient") == 0
+        finally:
+            hb.close()
+
+    def test_disabled_registration_is_noop(self):
+        watchdog.stop()
+        metrics.set_enabled(False)
+        hb = watchdog.register("quiet")
+        hb.beat()
+        with watchdog.register("quiet2"):
+            pass
+        assert hb is watchdog.NOOP_HEARTBEAT
+        assert not watchdog.running()
+        assert watchdog.heartbeats() == []
+        metrics.set_enabled(True)
+
+
+class TestTrainingHealth:
+    def _gauge(self, model):
+        return metrics.get_registry().gauge("training_health",
+                                            model=model).value
+
+    def test_healthy_then_nan_flips_gauge(self):
+        watchdog.report_training_metric("m", 0, loss=0.5,
+                                        metric_name="binary_logloss")
+        assert self._gauge("m") == 1.0
+        watchdog.report_training_metric("m", 1, loss=float("nan"),
+                                        metric_name="binary_logloss")
+        assert self._gauge("m") == 0.0
+        assert not watchdog.training_healthy("m")
+        evs = [e for e in flight.events() if e["kind"] == "training_health"]
+        assert evs and evs[-1]["event"] == "nan_loss"
+        assert metrics.get_registry().counter(
+            "training_health_events_total", model="m",
+            kind="nan_loss").value == 1
+
+    def test_divergence_over_window(self):
+        for it in range(8):
+            watchdog.report_training_metric("d", it, loss=1.0 - it * 0.01,
+                                            metric_name="rmse")
+        assert self._gauge("d") == 1.0
+        watchdog.report_training_metric("d", 8, loss=5.0,
+                                        metric_name="rmse")
+        assert self._gauge("d") == 0.0
+        evs = [e for e in flight.events() if e["kind"] == "training_health"]
+        assert evs[-1]["event"] == "loss_divergence"
+
+    def test_higher_is_better_metrics_skip_divergence(self):
+        for it in range(8):
+            watchdog.report_training_metric("a", it, loss=0.9,
+                                            metric_name="auc")
+        watchdog.report_training_metric("a", 8, loss=0.99,
+                                        metric_name="auc")
+        assert self._gauge("a") == 1.0
+
+    def test_throughput_collapse(self):
+        for it in range(8):
+            watchdog.report_training_metric("t", it, seconds=0.1)
+        watchdog.report_training_metric("t", 8, seconds=2.0)
+        assert self._gauge("t") == 0.0
+        evs = [e for e in flight.events() if e["kind"] == "training_health"]
+        assert evs[-1]["event"] == "throughput_collapse"
+
+    def test_reset_restores_health(self):
+        watchdog.report_training_metric("r", 0, loss=float("inf"),
+                                        metric_name="rmse")
+        assert not watchdog.training_healthy("r")
+        watchdog.reset_training_health("r")
+        assert watchdog.training_healthy("r")
+        watchdog.report_training_metric("r", 0, loss=1.0,
+                                        metric_name="rmse")
+        assert self._gauge("r") == 1.0
+
+    def test_scan_eval_history_catches_fused_path_nan(self):
+        assert watchdog.scan_eval_history(
+            "f", {"rmse": [1.0, 0.5, float("nan")]}) is False
+        assert self._gauge("f") == 0.0
+        assert watchdog.scan_eval_history("g", {"rmse": [1.0, 0.5]}) is True
+        assert self._gauge("g") == 1.0
+
+    def test_disabled_reports_are_inert(self):
+        metrics.set_enabled(False)
+        watchdog.report_training_metric("q", 0, loss=float("nan"),
+                                        metric_name="rmse")
+        assert watchdog.scan_eval_history(
+            "q", {"rmse": [float("nan")]}) is True
+        metrics.set_enabled(True)
+        assert metrics.get_registry().snapshot() == {}
+        assert flight.events() == []
+
+
+class TestNaNLossFit:
+    def test_synthetic_nan_loss_fit_flips_training_health(self):
+        """A real LightGBMRegressor fit on a label vector containing inf:
+        the per-round training metric goes non-finite and the post-fit
+        history audit flips training_health{model=LightGBMRegressor}."""
+        from mmlspark_tpu.core.dataset import Dataset
+        from mmlspark_tpu.models.gbdt.api import LightGBMRegressor
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(64, 4)).astype(np.float32)
+        y = (X @ np.array([1.0, -1.0, 0.5, 0.0])).astype(np.float32)
+        y[0] = np.inf                       # the poisoned label
+        ds = Dataset({"features": X, "label": y})
+        model = LightGBMRegressor(
+            numIterations=3, numLeaves=4, maxBin=15, minDataInLeaf=1,
+            isProvideTrainingMetric=True,    # host loop: metric per round
+        ).set(labelCol="label", featuresCol="features")
+        model.fit(ds)
+        assert metrics.get_registry().gauge(
+            "training_health", model="LightGBMRegressor").value == 0.0
+        assert not watchdog.training_healthy("LightGBMRegressor")
+        evs = [e for e in flight.events() if e["kind"] == "training_health"]
+        assert any(e["event"] == "nan_loss" for e in evs)

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Round-over-round bench regression gate.
+
+Compares the two newest ``BENCH_r*.json`` files in the repo root (or the
+directory given as the first argument): each file is a driver wrapper
+object whose ``tail`` holds the bench run's stdout, where the LAST JSON
+line is the round's metrics (bench.py's last-line-wins convention; a
+bare JSON-line file is accepted too). Throughput keys shared by both
+rounds — ``value`` (when both rounds report the same ``metric`` name)
+and every ``*_per_sec`` / ``*_rps`` key — must not drop more than the
+threshold (default 20%). Keys that are missing, non-numeric, or <= 0 in
+either round (failed secondaries report -1) are skipped.
+
+Exit status: 0 = no regression (or fewer than two rounds to compare),
+1 = at least one key regressed, 2 = usage/parse error. Wired as a fast
+test in ``tests/test_tools.py`` on synthetic fixtures; run it by hand
+after a bench round::
+
+    python tools/bench_regression.py            # repo root
+    python tools/bench_regression.py --threshold 0.1 /path/to/rounds
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+#: throughput keys: higher is better, eligible for the regression gate
+_RATE_RE = re.compile(r".*(_per_sec|_rps)$")
+
+
+def _bench_line(path: str) -> Optional[Dict]:
+    """The round's metrics dict: last parseable JSON object line of the
+    wrapper's ``tail`` (or of the raw file)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = f.read()
+    except OSError as e:
+        print(f"bench_regression: cannot read {path}: {e}", file=sys.stderr)
+        return None
+    text = raw
+    try:
+        obj = json.loads(raw)
+        if isinstance(obj, dict) and "metric" in obj:
+            return obj                      # already a bare bench line
+        if isinstance(obj, dict) and isinstance(obj.get("tail"), str):
+            text = obj["tail"]
+    except json.JSONDecodeError:
+        pass                                # treat the file as line-oriented
+    last = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict):
+            last = parsed
+    return last
+
+
+def _rounds(directory: str) -> List[Tuple[int, str]]:
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError as e:
+        print(f"bench_regression: cannot list {directory}: {e}",
+              file=sys.stderr)
+        return out
+    for name in names:
+        m = _ROUND_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    return sorted(out)
+
+
+def _comparable_keys(prev: Dict, cur: Dict) -> List[str]:
+    keys = [k for k in cur
+            if _RATE_RE.match(k) and k in prev]
+    # the headline "value" compares only when both rounds measured the
+    # same metric (a TPU round must not be gated against a CPU fallback)
+    if prev.get("metric") == cur.get("metric") and "value" in prev \
+            and "value" in cur:
+        keys.append("value")
+    return sorted(set(keys))
+
+
+def compare(prev: Dict, cur: Dict, threshold: float) -> List[str]:
+    """Human-readable regression lines (empty = pass)."""
+    out = []
+    for key in _comparable_keys(prev, cur):
+        try:
+            old, new = float(prev[key]), float(cur[key])
+        except (TypeError, ValueError):
+            continue
+        if old <= 0 or new <= 0:
+            continue                      # -1 sentinel / failed secondary
+        drop = (old - new) / old
+        if drop > threshold:
+            out.append(f"{key}: {old:g} -> {new:g} "
+                       f"({drop * 100:.1f}% drop > {threshold * 100:.0f}%)")
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="bench_regression")
+    p.add_argument("directory", nargs="?",
+                   default=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))),
+                   help="directory holding BENCH_r*.json (default: repo root)")
+    p.add_argument("--threshold", type=float, default=0.2,
+                   help="max allowed fractional drop (default 0.2 = 20%%)")
+    args = p.parse_args(argv)
+
+    rounds = _rounds(args.directory)
+    if len(rounds) < 2:
+        print(f"bench_regression: {len(rounds)} round(s) in "
+              f"{args.directory}; nothing to compare")
+        return 0
+    (n_prev, p_prev), (n_cur, p_cur) = rounds[-2], rounds[-1]
+    prev, cur = _bench_line(p_prev), _bench_line(p_cur)
+    if prev is None or cur is None:
+        print(f"bench_regression: no parseable bench line in "
+              f"{p_prev if prev is None else p_cur}", file=sys.stderr)
+        return 2
+    regressions = compare(prev, cur, args.threshold)
+    if regressions:
+        print(f"bench_regression: r{n_cur:02d} regressed vs r{n_prev:02d}:")
+        for line in regressions:
+            print(f"  {line}")
+        return 1
+    keys = _comparable_keys(prev, cur)
+    print(f"bench_regression: r{n_cur:02d} vs r{n_prev:02d} OK "
+          f"({len(keys)} shared throughput keys within "
+          f"{args.threshold * 100:.0f}%)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
