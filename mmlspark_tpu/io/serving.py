@@ -384,16 +384,29 @@ class ServingServer:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ServingServer":
-        if not self._started:
+        # the thread starts under the lock: releasing between the flag
+        # flip and start() opens a window where a concurrent stop()
+        # closes the socket first and the thread serves a dead fd
+        with self._lock:
+            if self._started:
+                return self
+            # flag only after the thread is really running: if start()
+            # raises (e.g. restarting a stopped server's used thread),
+            # a False flag keeps every retry failing loudly instead of
+            # silently no-opping against a dead instance
             self._thread.start()
             self._started = True
         return self
 
     def stop(self) -> None:
-        if self._started:
-            self._httpd.shutdown()
-            self._httpd.server_close()
+        # flip the flag under the lock, but shut down outside it: a
+        # handler thread blocked on _lock must never hold up shutdown
+        with self._lock:
+            if not self._started:
+                return
             self._started = False
+        self._httpd.shutdown()
+        self._httpd.server_close()
 
     @property
     def url(self) -> str:

@@ -1835,7 +1835,7 @@ def train_booster(
     fuse_es = (has_valid and iteration_callback is None and ckpt_mgr is None
                and iterations_done == 0 and metric_eval_period == 1
                and not provide_training_metric and not auc_host
-               and not os.environ.get("MMLSPARK_TPU_DISABLE_FUSED_VALID"))
+               and not os.environ.get("MMLSPARK_TPU_DISABLE_FUSED_VALID"))  # graftlint: disable=resolve-before-cache-key (gates the fused path off entirely; never feeds a key)
     if fuse_es:
         fuse_key = (cache_key, num_iterations, seed, early_stopping_rounds,
                     es_tol, "fused_valid")
@@ -1911,7 +1911,7 @@ def train_booster(
                 key, bag_key, np.float32(it))
             if has_valid:
                 vscores_d = vscores_d_new
-            trees_host = unpack_trees(np.asarray(trees_packed), (K,),
+            trees_host = unpack_trees(np.asarray(trees_packed), (K,),  # graftlint: disable=hot-path-host-sync (deliberate: one tree download per round grows the host forest)
                                       2 * cfg.num_leaves - 1,
                                       bitset_words(cfg.num_bins))
             for k in range(K):
@@ -1923,7 +1923,7 @@ def train_booster(
                 # with metric='auc' that is the objective default, so key by
                 # the device metric name, not the early-stopping one
                 history.setdefault(f"training_{device_metric_name}", []).append(
-                    float(metrics["train"]))
+                    float(metrics["train"]))  # graftlint: disable=hot-path-host-sync (deliberate per-eval-period metric download)
 
             if has_valid and (it % metric_eval_period == 0 or it == num_iterations - 1):
                 if auc_host:
@@ -1932,9 +1932,9 @@ def train_booster(
                     from .objectives import auc_weighted
                     # (no rf rescale: AUC is rank-based, invariant under the
                     # strictly increasing average-so-far transform)
-                    m = auc_weighted(np.asarray(vscores_d)[:nv, 0], yv, wv)
+                    m = auc_weighted(np.asarray(vscores_d)[:nv, 0], yv, wv)  # graftlint: disable=hot-path-host-sync (deliberate: host AUC needs the validation margin)
                 else:
-                    m = float(metrics["valid"])
+                    m = float(metrics["valid"])  # graftlint: disable=hot-path-host-sync (deliberate per-eval-period metric download)
                 history[metric_name].append(m)
                 _watchdog.report_training_metric("gbdt", it, loss=m,
                                                  metric_name=metric_name)
@@ -2161,7 +2161,7 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
     # paid a tunnel round-trip).
     fuse_dart = (iteration_callback is None
                  and (not has_valid or metric_eval_period == 1)
-                 and not os.environ.get("MMLSPARK_TPU_DISABLE_FUSED_DART"))
+                 and not os.environ.get("MMLSPARK_TPU_DISABLE_FUSED_DART"))  # graftlint: disable=resolve-before-cache-key (gates the fused path off entirely; never feeds a key)
     if fuse_dart:
         fuse_key = (cache_key, num_iterations, seed, early_stopping_rounds,
                     es_tol, "dart_fused")
@@ -2250,7 +2250,7 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
                 key, bag_key, np.int32(it))
             if has_valid:
                 vcontribs_d = vcontribs_new
-            trees_host = unpack_trees(np.asarray(trees_packed), (K,),
+            trees_host = unpack_trees(np.asarray(trees_packed), (K,),  # graftlint: disable=hot-path-host-sync (deliberate: one tree download per round grows the host forest)
                                       2 * cfg.num_leaves - 1,
                                       bitset_words(cfg.num_bins))
             for k in range(K):
@@ -2260,7 +2260,7 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
 
             if has_valid and (it % metric_eval_period == 0
                               or it == num_iterations - 1):
-                m = float(deval(vcontribs_d, jnp.asarray(scales), yv_d, wv_d))
+                m = float(deval(vcontribs_d, jnp.asarray(scales), yv_d, wv_d))  # graftlint: disable=hot-path-host-sync (deliberate per-eval-period metric download)
                 history[metric_name].append(m)
                 _watchdog.report_training_metric("gbdt", it, loss=m,
                                                  metric_name=metric_name)

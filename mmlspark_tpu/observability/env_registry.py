@@ -1,0 +1,216 @@
+"""Central registry of every ``MMLSPARK_TPU_*`` environment variable.
+
+One declarative table, three consumers:
+
+* **graftlint** (``env-var-registry`` rule): a ``MMLSPARK_TPU_*``
+  literal anywhere in the package that is not declared here — or an
+  entry here that nothing reads — fails the lint, so the table cannot
+  drift from the code.
+* **docs**: the env-var tables in ``docs/observability.md`` and
+  ``docs/performance.md`` are generated from this table by
+  ``tools/gen_env_docs.py`` (``--check`` gates drift in CI).
+* **humans**: ``python -c "from mmlspark_tpu.observability import
+  env_registry as e; print(e.render_markdown())"``.
+
+Entries read outside the Python package declare it: ``where="native"``
+(the C++ host runtime) — the lint then exempts them from the
+must-be-read-in-package check. Keep ``doc`` to one line; defaults are
+the *effective* defaults (what an unset variable behaves like), quoted
+as the reader would type them.
+
+Stdlib-only on purpose: observability modules are imported by every
+layer and must stay cycle-free (the ``obs-import-cycle`` rule).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+__all__ = ["EnvVar", "REGISTRY", "get", "names", "render_markdown",
+           "SECTIONS"]
+
+
+#: section id -> docs file the generated table lives in
+SECTIONS: Dict[str, str] = {"observability": "docs/observability.md",
+                            "performance": "docs/performance.md"}
+
+#: who reads an entry: "python" (the package — lint-checked), "native"
+#: (the C++ host runtime, exempt from the must-be-read check)
+_WHERE = ("python", "native")
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    #: exact variable name (the string literal read sites use)
+    name: str
+    #: effective default when unset, as a human-readable value
+    default: str
+    #: one-line purpose, rendered into the docs tables
+    doc: str
+    #: docs table this entry renders into
+    section: str = "observability"
+    #: who reads it: "python" (the package — lint-checked), "native"
+    #: (the C++ host runtime)
+    where: str = "python"
+
+    def __post_init__(self) -> None:
+        # a typo'd section silently drops the knob from every generated
+        # docs table, and a typo'd where silently exempts it from the
+        # staleness check — both defeat the single-source-of-truth
+        # contract, so they fail at import instead
+        if self.section not in SECTIONS:
+            raise ValueError(f"{self.name}: unknown section "
+                             f"{self.section!r} (known: {sorted(SECTIONS)})")
+        if self.where not in _WHERE:
+            raise ValueError(f"{self.name}: unknown where "
+                             f"{self.where!r} (known: {list(_WHERE)})")
+        if not self.name.startswith("MMLSPARK_TPU_"):
+            raise ValueError(f"{self.name}: registry entries must be "
+                             "MMLSPARK_TPU_* variables")
+
+
+REGISTRY: Tuple[EnvVar, ...] = (
+    # -- logging -----------------------------------------------------------
+    EnvVar(name="MMLSPARK_TPU_LOG_LEVEL", default="info",
+           doc="log funnel threshold: `debug`/`info`/`warning`/`error` "
+               "(runtime: `logging.set_level`)"),
+    EnvVar(name="MMLSPARK_TPU_LOG_FILE", default="(stderr)",
+           doc="append JSON log lines to this file instead of stderr; an "
+               "unopenable path degrades to stderr with one console "
+               "notice (runtime: `logging.set_log_file`)"),
+    EnvVar(name="MMLSPARK_TPU_LOG_RATE", default="200",
+           doc="per-logger records/second cap, 0 = unlimited; overflow "
+               "bumps `log_records_dropped_total{logger=...}` and emits "
+               "one suppression notice when the window reopens"),
+    # -- tracing / flight recorder ----------------------------------------
+    EnvVar(name="MMLSPARK_TPU_MAX_TRACE_EVENTS", default="100000",
+           doc="span ring-buffer capacity; oldest events drop once full "
+               "(`trace_events_dropped_total`; runtime: "
+               "`spans.set_max_trace_events`)"),
+    EnvVar(name="MMLSPARK_TPU_SLOW_REQUEST_SECONDS", default="1.0",
+           doc="requests slower than this record a {metric, seconds, "
+               "trace_id} exemplar + `slow_requests_total` (runtime: "
+               "`tracing.set_slow_threshold`)"),
+    EnvVar(name="MMLSPARK_TPU_FLIGHT_EVENTS", default="4096",
+           doc="flight-recorder ring capacity (runtime: "
+               "`flight.set_capacity`)"),
+    EnvVar(name="MMLSPARK_TPU_FLIGHT_DIR", default="(system temp dir)",
+           doc="directory flight-ring dumps land in (crash, SIGUSR2, "
+               "watchdog stall, `/debug/flight`)"),
+    # -- federation / watchdog --------------------------------------------
+    EnvVar(name="MMLSPARK_TPU_FEDERATION_INTERVAL_SECONDS", default="5.0",
+           doc="gateway metrics-federation sweep period over registered "
+               "workers"),
+    EnvVar(name="MMLSPARK_TPU_WATCHDOG_STALL_SECONDS", default="30",
+           doc="global heartbeat stall threshold; per-site floors take "
+               "the max (runtime: `watchdog.set_stall_seconds`)"),
+    EnvVar(name="MMLSPARK_TPU_WATCHDOG_INTERVAL_SECONDS",
+           default="stall/4, clamped to [0.05 s, 5 s]",
+           doc="watchdog sampling period (runtime: "
+               "`watchdog.set_interval_seconds`)"),
+    EnvVar(name="MMLSPARK_TPU_WATCHDOG_LOSS_WINDOW", default="8",
+           doc="training-health sentinel window length (divergence / "
+               "throughput-collapse detection)"),
+    EnvVar(name="MMLSPARK_TPU_TELEMETRY_ROUNDS", default="(off)",
+           doc="`1` enables the per-boost-round telemetry callback — "
+               "forces the host training loop, so the fused "
+               "single-dispatch paths stay the default"),
+    # -- training / histogram engine --------------------------------------
+    EnvVar(name="MMLSPARK_TPU_HIST_ENGINE", default="auto",
+           section="performance",
+           doc="histogram engine: `pallas` (TPU MXU kernel) / `onehot` "
+               "(XLA matmul) / `scatter` (segment-sum; CPU/GPU) / "
+               "`auto` (resolve per backend before any cache key)"),
+    EnvVar(name="MMLSPARK_TPU_PALLAS_INTERPRET", default="(off)",
+           section="performance",
+           doc="run the Pallas histogram kernel through the interpreter "
+               "on CPU (CI leg: packing/layout bugs surface without TPU "
+               "hardware)"),
+    EnvVar(name="MMLSPARK_TPU_DISABLE_PALLAS_HIST", default="(off)",
+           section="performance",
+           doc="set to force the non-Pallas engines even on TPU"),
+    EnvVar(name="MMLSPARK_TPU_HIST_UNROLL_MAX", default="128",
+           section="performance",
+           doc="Pallas kernel unroll cap; 0 keeps the dynamic fori_loop "
+               "everywhere (escape hatch for pathological Mosaic "
+               "compiles)"),
+    EnvVar(name="MMLSPARK_TPU_COMPILE_CACHE_DIR", default="(off)",
+           section="performance",
+           doc="wires jax's persistent compilation cache to this "
+               "directory (read once per process, first call wins; "
+               "compile flight events carry the active value)"),
+    EnvVar(name="MMLSPARK_TPU_DISABLE_FUSED_VALID", default="(off)",
+           section="performance",
+           doc="set to force the host round loop instead of the fused "
+               "on-device early-stopping training path"),
+    EnvVar(name="MMLSPARK_TPU_DISABLE_FUSED_DART", default="(off)",
+           section="performance",
+           doc="set to force the host round loop for DART training"),
+    EnvVar(name="MMLSPARK_TPU_TIMING", default="(off)",
+           section="performance",
+           doc="`1` prints a wall-time phase breakdown per "
+               "`train_booster` call (console output by design — an "
+               "explicit operator request, independent of the telemetry "
+               "kill switch)"),
+    EnvVar(name="MMLSPARK_TPU_BINNED_CACHE", default="1",
+           section="performance",
+           doc="`0` disables the binned-device-dataset fit cache (the "
+               "cache pins up to two [F, n] int32 matrices in device "
+               "memory; `clear_binned_dataset_cache()` releases them)"),
+    # -- streaming / serving ----------------------------------------------
+    EnvVar(name="MMLSPARK_TPU_DISABLE_PREFETCH", default="(off)",
+           section="performance",
+           doc="`1`/`true`/`yes` degrades every streaming adopter to the "
+               "plain sequential loop (no background reader thread)"),
+    # -- explainability ----------------------------------------------------
+    EnvVar(name="MMLSPARK_TPU_SHAP_HOST", default="(auto by backend)",
+           section="performance",
+           doc="`1` forces the host TreeSHAP recursion (the reference "
+               "the device path is pinned against)"),
+    EnvVar(name="MMLSPARK_TPU_SHAP_DEVICE", default="(auto by backend)",
+           section="performance",
+           doc="`1` forces the fixed-shape device TreeSHAP program "
+               "(default on TPU; loses to host engines on XLA CPU)"),
+    EnvVar(name="MMLSPARK_TPU_SHAP_NATIVE", default="1",
+           section="performance",
+           doc="`0` disables the native C++ TreeSHAP engine inside the "
+               "host path (falls back to vectorized numpy recursion)"),
+    # -- native host runtime ----------------------------------------------
+    EnvVar(name="MMLSPARK_TPU_NATIVE_CACHE",
+           default="(per-user dir under system temp, mode 0700)",
+           section="performance",
+           doc="cache directory for the compile-on-use native host "
+               "runtime `.so`"),
+    EnvVar(name="MMLSPARK_TPU_DISABLE_NATIVE", default="(off)",
+           section="performance",
+           doc="set to skip loading/compiling the native host runtime "
+               "entirely (pure-Python fallbacks)"),
+    EnvVar(name="MMLSPARK_TPU_NATIVE_THREADS", default="(hardware "
+           "concurrency, budget-clamped)", section="performance",
+           where="native",
+           doc="caps the native TreeSHAP thread pool (read by the C++ "
+               "runtime; threads are also clamped to the 256 MiB arena "
+               "budget)"),
+)
+
+_BY_NAME: Dict[str, EnvVar] = {v.name: v for v in REGISTRY}
+
+
+def get(name: str) -> Optional[EnvVar]:
+    return _BY_NAME.get(name)
+
+
+def names() -> frozenset:
+    return frozenset(_BY_NAME)
+
+
+def render_markdown(section: Optional[str] = None) -> str:
+    """GitHub-markdown table of the registry (one ``section``, or all)."""
+    rows = [v for v in REGISTRY
+            if section is None or v.section == section]
+    out = ["| Variable | Default | Purpose |",
+           "| --- | --- | --- |"]
+    for v in rows:
+        out.append(f"| `{v.name}` | {v.default} | {v.doc} |")
+    return "\n".join(out)

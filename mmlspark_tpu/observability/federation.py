@@ -285,22 +285,32 @@ class MetricsFederator:
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "MetricsFederator":
-        if self._thread is None or not self._thread.is_alive():
-            self._stop.clear()
-            self._thread = threading.Thread(
-                target=self._run, name="mmlspark-federation", daemon=True)
-            self._thread.start()
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                # fresh event per sweeper generation: a start() racing a
+                # concurrent stop() must not clear the event the old
+                # (not-yet-joined) sweeper is watching — reusing one
+                # event could un-stop it and leave two sweepers running
+                self._stop = threading.Event()
+                self._thread = threading.Thread(
+                    target=self._run, args=(self._stop,),
+                    name="mmlspark-federation", daemon=True)
+                self._thread.start()
         return self
 
     def stop(self) -> None:
-        self._stop.set()
-        t = self._thread
+        # swap the handles under the lock, signal + join outside it: the
+        # sweep thread takes _lock in scrape_once, so joining under it
+        # could stall stop() for a full scrape timeout
+        with self._lock:
+            stop, t = self._stop, self._thread
+            self._thread = None
+        stop.set()
         if t is not None and t.is_alive():
             t.join(timeout=5)
-        self._thread = None
 
-    def _run(self) -> None:
-        while not self._stop.wait(self.interval):
+    def _run(self, stop: threading.Event) -> None:
+        while not stop.wait(self.interval):
             if not _metrics.enabled():
                 continue
             try:

@@ -1,0 +1,857 @@
+"""Seeded-violation corpus for graftlint.
+
+Every rule is fed a known-bad snippet (a mutated copy of the original
+offending pattern its ``tests/test_lint.py`` ancestor guarded against)
+and must report the exact rule id at the exact file:line — plus a
+suppressed variant proving ``# graftlint: disable=<rule>`` works. This
+is the regression harness for the port: a guard that silently stopped
+matching its original bad pattern fails here, not in production review.
+
+Infrastructure tests (CLI exit codes, JSON shape, lint-rot conversion,
+file-level suppression, the env-docs generator) ride along at the end.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.graftlint import core  # noqa: E402
+
+core.load_checkers()
+
+
+def run_rule(tmp_path, rule, files):
+    """Write ``files`` (rel -> source) under ``tmp_path``, run one rule,
+    return (active, suppressed) findings."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    repo = core.Repo(str(tmp_path))
+    return core.run(repo, rules=[rule])
+
+
+def hits(findings, rule, path=None):
+    return [f for f in findings if f.rule == rule
+            and (path is None or f.path == path)]
+
+
+# --------------------------------------------------------------------------
+# shared anchor fragments (each rule's checker refuses to run without the
+# real code it guards — seeds must reproduce those anchors)
+# --------------------------------------------------------------------------
+
+OBS_LOGGING = """\
+    def get_logger(name):
+        return name
+
+    def console(msg):
+        import sys
+        sys.stderr.write(msg)
+"""
+
+IO_SERVING = """\
+    def write_http_response(handler, status):
+        handler.send_response(status)
+"""
+
+STREAMING_CLEAN = """\
+    def stream_apply(chunks, fn):
+        out = []
+        for c in chunks:
+            out.append(fn(c))
+        return out
+"""
+
+BEAT_LOOPS_CLEAN = """\
+    def run_loop(hb, items, work):
+        for it in items:
+            hb.beat()
+            work(it)
+
+    def run_loop2(hb, items, work):
+        while items:
+            hb.beat()
+            work(items.pop())
+"""
+
+
+# --------------------------------------------------------------------------
+# funnel rules
+# --------------------------------------------------------------------------
+
+class TestFunnelRules:
+    def test_raw_output(self, tmp_path):
+        active, suppressed = run_rule(tmp_path, "raw-output-funnel", {
+            "mmlspark_tpu/observability/logging.py": OBS_LOGGING,
+            "mmlspark_tpu/worker.py": """\
+                import sys
+
+                def f():
+                    print("hi")
+                    sys.stderr.write("x")
+                    print("ok")  # graftlint: disable=raw-output-funnel (test)
+            """})
+        got = hits(active, "raw-output-funnel", "mmlspark_tpu/worker.py")
+        assert [(f.line) for f in got] == [4, 5], active
+        assert [f.line for f in suppressed] == [6]
+
+    def test_stdlib_getlogger(self, tmp_path):
+        active, suppressed = run_rule(tmp_path, "stdlib-getlogger", {
+            "mmlspark_tpu/observability/logging.py": OBS_LOGGING,
+            "mmlspark_tpu/worker.py": """\
+                import logging
+
+                log = logging.getLogger(__name__)
+                ok = logging.getLogger("x")  # graftlint: disable=stdlib-getlogger (test)
+            """})
+        assert [f.line for f in
+                hits(active, "stdlib-getlogger",
+                     "mmlspark_tpu/worker.py")] == [3]
+        assert [f.line for f in suppressed] == [4]
+
+    def test_send_response(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "response-funnel", {
+            "mmlspark_tpu/io/serving.py": IO_SERVING,
+            "mmlspark_tpu/io/handler.py": """\
+                class H:
+                    def do_GET(self):
+                        self.send_response(200)
+            """})
+        got = hits(active, "response-funnel", "mmlspark_tpu/io/handler.py")
+        assert [f.line for f in got] == [3], active
+        # the funnel function itself is sanctioned
+        assert not hits(active, "response-funnel",
+                        "mmlspark_tpu/io/serving.py")
+
+    def test_shard_map(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "shard-map-funnel", {
+            "mmlspark_tpu/parallel/compat.py": "def shard_map():\n    pass\n",
+            "mmlspark_tpu/mesh_user.py": """\
+                import jax
+                from jax.experimental.shard_map import shard_map
+
+                def f(g):
+                    return jax.shard_map(g)
+            """,
+            "tests/test_seeded.py": """\
+                import jax
+
+                def check(g):
+                    return jax.shard_map(g)
+            """})
+        assert [f.line for f in
+                hits(active, "shard-map-funnel",
+                     "mmlspark_tpu/mesh_user.py")] == [2, 5]
+        # tests/ are in scope: the funnel guards the whole repo
+        assert [f.line for f in
+                hits(active, "shard-map-funnel",
+                     "tests/test_seeded.py")] == [4]
+
+    def test_trace_header_literal(self, tmp_path):
+        active, suppressed = run_rule(tmp_path, "trace-header-literal", {
+            "mmlspark_tpu/observability/tracing.py":
+                'TRACEPARENT_HEADER = "traceparent"\n',
+            "mmlspark_tpu/io/hop.py": """\
+                H = "traceparent"
+                R = "X-Request-Id"
+                OK = "x-request-id"  # graftlint: disable=trace-header-literal (test)
+            """})
+        got = hits(active, "trace-header-literal", "mmlspark_tpu/io/hop.py")
+        assert [f.line for f in got] == [1, 2], active
+        assert [f.line for f in suppressed] == [3]
+
+
+# --------------------------------------------------------------------------
+# metric rules
+# --------------------------------------------------------------------------
+
+_TEN_GOOD_METRICS = "\n".join(
+    f'    counter("good_{w}_total").inc()'
+    for w in ("a", "b", "c", "d", "e", "f", "g", "h", "i", "j"))
+
+
+class TestMetricRules:
+    def test_name_format(self, tmp_path):
+        active, suppressed = run_rule(tmp_path, "metric-name-format", {
+            "mmlspark_tpu/wiring.py": (
+                "def wire(counter):\n" + _TEN_GOOD_METRICS + "\n"
+                '    counter("Bad-Name").inc()\n'
+                '    counter("also.bad").inc()'
+                '  # graftlint: disable=metric-name-format (test)\n')})
+        got = hits(active, "metric-name-format")
+        assert [f.line for f in got] == [12], active
+        assert "Bad-Name" in got[0].message
+        assert len(suppressed) == 1
+
+    def test_kind_unique(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "metric-kind-unique", {
+            "mmlspark_tpu/wiring.py": """\
+                def wire(counter, gauge, safe_counter):
+                    counter("dup_total").inc()
+                    safe_counter("dup_total").inc()     # same kind: fine
+                    gauge("dup_total").set(1.0)         # kind conflict
+            """})
+        got = hits(active, "metric-kind-unique")
+        assert [f.line for f in got] == [4], active
+        assert "dup_total" in got[0].message
+
+
+# --------------------------------------------------------------------------
+# import-cycle rule
+# --------------------------------------------------------------------------
+
+def test_obs_import_cycle(tmp_path):
+    active, suppressed = run_rule(tmp_path, "obs-import-cycle", {
+        "mmlspark_tpu/observability/metrics.py": "enabled = lambda: True\n",
+        "mmlspark_tpu/observability/bad.py": """\
+            import os
+            from mmlspark_tpu import core
+            from ..io import serving
+            from .metrics import enabled
+            from .weird import x
+            from .flight import record  # graftlint: disable=obs-import-cycle (not a violation, proves line-suppression keys on the import line)
+
+            def lazy():
+                from ..models import gbdt   # deferred: legal
+        """})
+    got = hits(active, "obs-import-cycle",
+               "mmlspark_tpu/observability/bad.py")
+    assert [f.line for f in got] == [2, 3, 5], active
+
+
+# --------------------------------------------------------------------------
+# hot-path-host-sync
+# --------------------------------------------------------------------------
+
+class TestHotPathHostSync:
+    def test_streaming_chunk_loop(self, tmp_path):
+        active, suppressed = run_rule(tmp_path, "hot-path-host-sync", {
+            "mmlspark_tpu/io/streaming.py": """\
+                import numpy as np
+                from numpy import asarray
+
+                def stream_apply(chunks, fn):
+                    out = []
+                    for c in chunks:
+                        out.append(np.asarray(fn(c)))
+                        x = float(c)
+                        z = asarray(c)
+                        y = np.asarray(c)  # graftlint: disable=hot-path-host-sync (test)
+                    return out
+
+                def helper_outside_is_legal(chunks, score):
+                    for c in chunks:
+                        score(c)
+            """,
+            "mmlspark_tpu/runner.py": BEAT_LOOPS_CLEAN})
+        got = hits(active, "hot-path-host-sync",
+                   "mmlspark_tpu/io/streaming.py")
+        # the bare-import form ('from numpy import asarray') flags too —
+        # the coverage the pre-graftlint guard had
+        assert [f.line for f in got] == [7, 8, 9], active
+        assert [f.line for f in suppressed] == [10]
+
+    def test_nested_loop_reports_once(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "hot-path-host-sync", {
+            "mmlspark_tpu/io/streaming.py": """\
+                import numpy as np
+
+                def stream_apply(chunks, fn):
+                    for c in chunks:
+                        for row in c:
+                            np.asarray(row)
+            """,
+            "mmlspark_tpu/runner.py": BEAT_LOOPS_CLEAN})
+        got = hits(active, "hot-path-host-sync",
+                   "mmlspark_tpu/io/streaming.py")
+        # both the inner and outer loop bodies contain the call; one
+        # defect must be one finding
+        assert [f.line for f in got] == [6], active
+
+    def test_nested_function_loop_reports_once(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "hot-path-host-sync", {
+            "mmlspark_tpu/io/streaming.py": STREAMING_CLEAN,
+            "mmlspark_tpu/runner.py": BEAT_LOOPS_CLEAN,
+            "mmlspark_tpu/train_loop.py": """\
+                import numpy as np
+
+                def outer(hb, steps, step):
+                    def inner():
+                        for it in steps:
+                            hb.beat()
+                            np.asarray(step(it))
+                    return inner
+            """})
+        got = hits(active, "hot-path-host-sync",
+                   "mmlspark_tpu/train_loop.py")
+        # the loop belongs to inner() only — the module walk visiting
+        # outer() must not scan it a second time (which also double-
+        # counted the lint-rot hot-loop anchor)
+        assert [(f.line, f.message.count("inner()")) for f in got] \
+            == [(7, 1)], active
+
+    def test_beat_registered_loop(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "hot-path-host-sync", {
+            "mmlspark_tpu/io/streaming.py": STREAMING_CLEAN,
+            "mmlspark_tpu/runner.py": BEAT_LOOPS_CLEAN,
+            "mmlspark_tpu/train_loop.py": """\
+                import numpy as np
+
+                def round_loop(hb, steps, step):
+                    for it in steps:
+                        hb.beat()
+                        out = step(it)
+                        host = np.asarray(out)
+                    return host
+
+                def plain_loop_is_not_hot(steps, step):
+                    for it in steps:
+                        x = float(step(it))
+                    return x
+            """})
+        got = hits(active, "hot-path-host-sync",
+                   "mmlspark_tpu/train_loop.py")
+        assert [f.line for f in got] == [7], active
+        assert "watchdog-registered" in got[0].message
+
+    def test_jit_functions(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "hot-path-host-sync", {
+            "mmlspark_tpu/io/streaming.py": STREAMING_CLEAN,
+            "mmlspark_tpu/runner.py": BEAT_LOOPS_CLEAN,
+            "mmlspark_tpu/kernels.py": """\
+                import jax
+                import numpy as np
+
+                @jax.jit
+                def traced(x):
+                    return x.item()
+
+                def run(x):
+                    return np.asarray(x)
+
+                step = jax.jit(run)
+
+                def not_compiled(x):
+                    return float(np.asarray(x))
+            """})
+        got = hits(active, "hot-path-host-sync", "mmlspark_tpu/kernels.py")
+        assert [f.line for f in got] == [6, 9], active
+        assert all("jit-compiled" in f.message for f in got)
+
+
+# --------------------------------------------------------------------------
+# trees-as-arguments
+# --------------------------------------------------------------------------
+
+_BOOSTER_PREDICT = """\
+    import numpy as np
+    import jax.numpy as jnp
+
+    class Booster:
+        def predict(self, X):
+            return self._predict_device(X)
+
+        def predict_raw(self, X):
+            return self._predict_device(X)
+
+        def _predict_device(self, X):
+            return self._device_forest_args()
+
+        def _device_forest_args(self):
+            packed = np.asarray(self.trees)        # host staging: legal
+            return {}
+"""
+
+
+def test_trees_as_arguments(tmp_path):
+    bad = _BOOSTER_PREDICT.replace(
+        "        return {}",
+        "        return jnp.asarray(self.trees)")
+    active, _sup = run_rule(tmp_path, "trees-as-arguments", {
+        "mmlspark_tpu/models/gbdt/booster.py": bad})
+    got = hits(active, "trees-as-arguments")
+    assert [f.line for f in got] == [16], active
+    assert "bakes the forest" in got[0].message
+    # the all-legal variant is clean
+    active, _sup = run_rule(tmp_path, "trees-as-arguments", {
+        "mmlspark_tpu/models/gbdt/booster.py": _BOOSTER_PREDICT})
+    assert not active
+
+
+# --------------------------------------------------------------------------
+# resolve-before-cache-key
+# --------------------------------------------------------------------------
+
+_BOOSTER_PIN_OK = """\
+    def resolve_growth_backend(cfg):
+        return cfg
+
+    def _cached_program(key, build):
+        return build()
+
+    def train_booster(cfg):
+        cfg = resolve_growth_backend(cfg)
+        cache_key = (cfg,)
+        return _cached_program(cache_key, lambda: cfg)
+"""
+
+_API_PIN_OK = """\
+    def resolve_growth_backend(cfg):
+        return cfg
+
+    def _grow_config(params):
+        return resolve_growth_backend(params)
+"""
+
+
+class TestResolveBeforeCacheKey:
+    def test_general_env_read_after_key(self, tmp_path):
+        active, suppressed = run_rule(
+            tmp_path, "resolve-before-cache-key", {
+                "mmlspark_tpu/models/gbdt/booster.py": _BOOSTER_PIN_OK,
+                "mmlspark_tpu/models/gbdt/api.py": _API_PIN_OK,
+                "mmlspark_tpu/engine.py": """\
+                    import os
+
+                    _PROGRAM_CACHE = {}
+
+                    def build(n):
+                        cache_key = ("p", n)
+                        prog = _PROGRAM_CACHE.get(cache_key)
+                        flavor = os.environ.get("X")
+                        mode = resolve_mode(n)
+                        ok = os.environ.get("Y")  # graftlint: disable=resolve-before-cache-key (test)
+                        return prog, flavor, mode
+
+                    def clean(n):
+                        mode = resolve_mode(n)
+                        cache_key = ("p", n, mode)
+                        return _PROGRAM_CACHE.get(cache_key)
+                """})
+        got = hits(active, "resolve-before-cache-key",
+                   "mmlspark_tpu/engine.py")
+        assert [f.line for f in got] == [8, 9], active
+        assert "os.environ" in got[0].message
+        assert "resolve_mode" in got[1].message
+        assert [f.line for f in suppressed] == [10]
+
+    def test_anchored_pin_inversion(self, tmp_path):
+        inverted = _BOOSTER_PIN_OK.replace(
+            "        cfg = resolve_growth_backend(cfg)\n"
+            "        cache_key = (cfg,)",
+            "        cache_key = (cfg,)\n"
+            "        cfg = resolve_growth_backend(cfg)")
+        assert inverted != _BOOSTER_PIN_OK
+        active, _sup = run_rule(tmp_path, "resolve-before-cache-key", {
+            "mmlspark_tpu/models/gbdt/booster.py": inverted,
+            "mmlspark_tpu/models/gbdt/api.py": _API_PIN_OK})
+        booster_hits = hits(active, "resolve-before-cache-key",
+                            "mmlspark_tpu/models/gbdt/booster.py")
+        assert booster_hits, active
+        assert any("before the first cache-key" in f.message
+                   or "before the key is built" in f.message
+                   for f in booster_hits)
+
+    def test_missing_grow_config_resolver(self, tmp_path):
+        api_bad = "def _grow_config(params):\n    return params\n"
+        active, _sup = run_rule(tmp_path, "resolve-before-cache-key", {
+            "mmlspark_tpu/models/gbdt/booster.py": _BOOSTER_PIN_OK,
+            "mmlspark_tpu/models/gbdt/api.py": api_bad})
+        got = hits(active, "resolve-before-cache-key",
+                   "mmlspark_tpu/models/gbdt/api.py")
+        assert len(got) == 1 and "_grow_config" in got[0].message
+
+
+# --------------------------------------------------------------------------
+# resource-leak
+# --------------------------------------------------------------------------
+
+def test_resource_leak(tmp_path):
+    active, suppressed = run_rule(tmp_path, "resource-leak", {
+        "mmlspark_tpu/loops.py": """\
+            def ok_with(_watchdog):
+                with _watchdog.register("a") as hb:
+                    hb.beat()
+
+            def ok_conditional_finally(_watchdog, live):
+                hb = _watchdog.register("b") if live else _watchdog.NOOP
+                try:
+                    hb.beat()
+                finally:
+                    hb.close()
+
+            def leaky(_watchdog):
+                hb = _watchdog.register("c")
+                hb.beat()
+                hb.close()
+
+            def spans_ok(_spans):
+                with _spans.span("one"):
+                    pass
+                with _spans.span("two"):
+                    pass
+                with _spans.span("three"):
+                    pass
+                with _spans.span("four"):
+                    pass
+
+            def span_leak(_spans):
+                s = _spans.span("five")
+                return s
+
+            def span_suppressed(_spans):
+                s = _spans.span("six")  # graftlint: disable=resource-leak (test)
+                return s
+        """})
+    got = hits(active, "resource-leak")
+    assert [f.line for f in got] == [13, 28], active
+    assert "ghost" in got[0].message
+    assert [f.line for f in suppressed] == [32]
+
+
+# --------------------------------------------------------------------------
+# lock-discipline
+# --------------------------------------------------------------------------
+
+_SIGNAL_RLOCK_OK = """\
+    import signal
+    import threading
+
+    _ring = threading.RLock()
+
+    def _dump():
+        with _ring:
+            pass
+
+    def _on_sig(signum, frame):
+        _dump()
+
+    def install():
+        signal.signal(signal.SIGUSR2, _on_sig)
+"""
+
+
+class TestLockDiscipline:
+    def test_unguarded_shared_attr(self, tmp_path):
+        active, suppressed = run_rule(tmp_path, "lock-discipline", {
+            "mmlspark_tpu/sig.py": _SIGNAL_RLOCK_OK,
+            "mmlspark_tpu/box.py": """\
+                import threading
+
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._n = 0
+                        self._name = "x"
+
+                    def bump(self):
+                        self._n += 1
+
+                    def reset(self):
+                        with self._lock:
+                            self._n = 0
+
+                    def rename(self, v):
+                        self._name = v  # graftlint: disable=lock-discipline (test)
+
+                    def rename2(self, v):
+                        with self._lock:
+                            self._name = v
+
+                    def single_writer_is_fine(self):
+                        self._only_here = 1
+            """})
+        got = hits(active, "lock-discipline", "mmlspark_tpu/box.py")
+        assert [f.line for f in got] == [10], active
+        assert "Box._n" in got[0].message
+        assert [f.line for f in suppressed] == [17]
+
+    def test_tuple_unpack_mutation_counts(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "lock-discipline", {
+            "mmlspark_tpu/sig.py": _SIGNAL_RLOCK_OK,
+            "mmlspark_tpu/box.py": """\
+                import threading
+
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._t = None
+
+                    def start(self):
+                        with self._lock:
+                            self._t = object()
+
+                    def stop(self):
+                        self._t, old = None, self._t
+                        return old
+            """})
+        got = hits(active, "lock-discipline", "mmlspark_tpu/box.py")
+        # a tuple-unpacking write (self._t, x = ...) is a mutation like
+        # any other — it must count toward the >=2-methods rule AND flag
+        # when outside the lock
+        assert [f.line for f in got] == [13], active
+        assert "Box._t" in got[0].message
+
+    def test_signal_handler_needs_rlock(self, tmp_path):
+        bad = _SIGNAL_RLOCK_OK.replace("threading.RLock()",
+                                       "threading.Lock()")
+        active, _sup = run_rule(tmp_path, "lock-discipline", {
+            "mmlspark_tpu/sig.py": bad,
+            "mmlspark_tpu/box.py": """\
+                import threading
+
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+            """})
+        got = hits(active, "lock-discipline", "mmlspark_tpu/sig.py")
+        assert [f.line for f in got] == [7], active
+        assert "RLock" in got[0].message
+        # a non-stdlib .signal() (event emitter, scheduler) must NOT
+        # mark its callback as signal-reachable
+        active, _sup = run_rule(tmp_path, "lock-discipline", {
+            "mmlspark_tpu/sig.py": _SIGNAL_RLOCK_OK,
+            "mmlspark_tpu/box.py": """\
+                import threading
+
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+            """,
+            "mmlspark_tpu/emitter.py": """\
+                import threading
+
+                _plain = threading.Lock()
+
+                def worker():
+                    with _plain:
+                        pass
+
+                def wire(bus):
+                    bus.signal("done", worker)
+            """})
+        assert not any(f.path == "<graftlint>" for f in active), active
+        assert not hits(active, "lock-discipline", "mmlspark_tpu/emitter.py")
+        # ...and the RLock original is clean
+        active, _sup = run_rule(tmp_path, "lock-discipline", {
+            "mmlspark_tpu/sig.py": _SIGNAL_RLOCK_OK,
+            "mmlspark_tpu/box.py": """\
+                import threading
+
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+            """})
+        assert not hits(active, "lock-discipline", "mmlspark_tpu/sig.py")
+
+
+# --------------------------------------------------------------------------
+# env-var-registry
+# --------------------------------------------------------------------------
+
+_SEED_REGISTRY = """\
+    from dataclasses import dataclass
+
+    @dataclass(frozen=True)
+    class EnvVar:
+        name: str
+        default: str
+        doc: str
+        section: str = "observability"
+        where: str = "python"
+
+    REGISTRY = (
+""" + "\n".join(
+    f'        EnvVar(name="MMLSPARK_TPU_V{i}", default="", doc="v{i}"),'
+    for i in range(9)) + """
+        EnvVar(name="MMLSPARK_TPU_UNUSED", default="", doc="stale"),
+        EnvVar(name="MMLSPARK_TPU_NODOC", default="", doc=""),
+        EnvVar(name="MMLSPARK_TPU_NATIVE_ONLY", default="", doc="cpp",
+               where="native"),
+    )
+"""
+
+
+def test_env_var_registry(tmp_path):
+    active, suppressed = run_rule(tmp_path, "env-var-registry", {
+        "mmlspark_tpu/observability/env_registry.py": _SEED_REGISTRY,
+        "mmlspark_tpu/reader.py": """\
+            import os
+
+            _KNOWN = ["MMLSPARK_TPU_V0", "MMLSPARK_TPU_V1",
+                      "MMLSPARK_TPU_V2", "MMLSPARK_TPU_V3",
+                      "MMLSPARK_TPU_V4", "MMLSPARK_TPU_V5",
+                      "MMLSPARK_TPU_V6", "MMLSPARK_TPU_V7",
+                      "MMLSPARK_TPU_V8", "MMLSPARK_TPU_NODOC"]
+
+            def read():
+                vals = [os.environ.get(n) for n in _KNOWN]
+                rogue = os.environ.get("MMLSPARK_TPU_ROGUE")
+                ok = os.environ.get("MMLSPARK_TPU_ALSO_ROGUE")  # graftlint: disable=env-var-registry (test)
+                return vals, rogue, ok
+        """})
+    reader_hits = hits(active, "env-var-registry", "mmlspark_tpu/reader.py")
+    assert [f.line for f in reader_hits] == [11], active
+    assert "MMLSPARK_TPU_ROGUE" in reader_hits[0].message
+    reg_hits = hits(active, "env-var-registry",
+                    "mmlspark_tpu/observability/env_registry.py")
+    msgs = " | ".join(f.message for f in reg_hits)
+    assert "MMLSPARK_TPU_UNUSED" in msgs       # declared but never read
+    assert "MMLSPARK_TPU_NODOC" in msgs        # declared without a doc
+    assert "MMLSPARK_TPU_NATIVE_ONLY" not in msgs   # where="native": exempt
+    assert [f.line for f in suppressed] == [12]
+    assert "MMLSPARK_TPU_V0" not in msgs      # declared AND read: clean
+
+
+# --------------------------------------------------------------------------
+# infrastructure
+# --------------------------------------------------------------------------
+
+class TestInfrastructure:
+    def test_file_level_suppression(self, tmp_path):
+        active, suppressed = run_rule(tmp_path, "raw-output-funnel", {
+            "mmlspark_tpu/observability/logging.py": OBS_LOGGING,
+            "mmlspark_tpu/demo.py": """\
+                # graftlint: disable-file=raw-output-funnel
+                def f():
+                    print("a")
+                    print("b")
+            """})
+        assert not active
+        assert [f.line for f in suppressed] == [3, 4]
+
+    def test_unknown_rule_raises(self, tmp_path):
+        (tmp_path / "mmlspark_tpu").mkdir()
+        repo = core.Repo(str(tmp_path))
+        with pytest.raises(ValueError, match="no-such-rule"):
+            core.run(repo, rules=["no-such-rule"])
+
+    def test_rot_becomes_finding(self, tmp_path):
+        # trees-as-arguments without booster.py: the guard's anchor is
+        # gone, which must FAIL the run, not silently pass
+        (tmp_path / "mmlspark_tpu").mkdir()
+        repo = core.Repo(str(tmp_path))
+        active, _sup = core.run(repo, rules=["trees-as-arguments"])
+        assert len(active) == 1
+        assert active[0].rule == "trees-as-arguments"
+        assert "lint-rot" in active[0].message
+
+    def test_rot_keeps_earlier_findings(self, tmp_path):
+        # checkers yield real violations before raising their rot check —
+        # the rot finding must be ADDED, not mask what was already found
+        active, _sup = run_rule(tmp_path, "lock-discipline", {
+            "mmlspark_tpu/box.py": """\
+                import threading
+
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._n = 0
+
+                    def bump(self):
+                        self._n += 1
+
+                    def reset(self):
+                        with self._lock:
+                            self._n = 0
+            """})
+        # no signal.signal anywhere -> the rule's handler anchor rots,
+        # but the unguarded Box._n write must still be reported
+        rules = [f.rule for f in active]
+        assert rules == ["lock-discipline", "lock-discipline"], active
+        assert any("Box._n" in f.message for f in active), active
+        assert any("lint-rot" in f.message for f in active), active
+
+    def test_duplicate_rule_runs_once(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "raw-output-funnel", {
+            "mmlspark_tpu/observability/logging.py": OBS_LOGGING,
+            "mmlspark_tpu/worker.py": "def f():\n    print('x')\n"})
+        repo = core.Repo(str(tmp_path))
+        twice, _sup = core.run(repo, rules=["raw-output-funnel",
+                                            "raw-output-funnel"])
+        assert len(twice) == len(active) == 1, twice
+
+    def test_env_registry_validates_entries(self):
+        from mmlspark_tpu.observability.env_registry import EnvVar
+        with pytest.raises(ValueError, match="unknown section"):
+            EnvVar(name="MMLSPARK_TPU_X", default="0", doc="d",
+                   section="perfomance")
+        with pytest.raises(ValueError, match="unknown where"):
+            EnvVar(name="MMLSPARK_TPU_X", default="0", doc="d",
+                   where="pyhton")
+        with pytest.raises(ValueError, match="MMLSPARK_TPU_"):
+            EnvVar(name="GRAFT_BENCH_X", default="0", doc="d")
+
+    def test_parse_error_is_a_finding(self, tmp_path):
+        p = tmp_path / "mmlspark_tpu" / "broken.py"
+        p.parent.mkdir(parents=True)
+        p.write_text("def f(:\n")
+        repo = core.Repo(str(tmp_path))
+        active, _sup = core.run(repo, rules=[])
+        assert [f.rule for f in active] == ["parse-error"]
+
+    def test_cli_list_rules_and_json(self, tmp_path):
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "--list-rules"],
+            capture_output=True, text=True, timeout=120, cwd=ROOT)
+        assert r.returncode == 0, r.stderr
+        for rule in ("raw-output-funnel", "hot-path-host-sync",
+                     "lock-discipline", "env-var-registry"):
+            assert rule in r.stdout
+        # seeded bad repo: non-zero exit + machine-readable findings
+        pkg = tmp_path / "mmlspark_tpu"
+        (pkg / "observability").mkdir(parents=True)
+        (pkg / "observability" / "logging.py").write_text(
+            textwrap.dedent(OBS_LOGGING))
+        (pkg / "bad.py").write_text("def f():\n    print('x')\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "--json",
+             "--rule", "raw-output-funnel", str(tmp_path)],
+            capture_output=True, text=True, timeout=120, cwd=ROOT)
+        assert r.returncode == 1, r.stdout + r.stderr
+        data = json.loads(r.stdout)
+        assert data["findings"][0]["rule"] == "raw-output-funnel"
+        assert data["findings"][0]["path"] == "mmlspark_tpu/bad.py"
+        assert data["findings"][0]["line"] == 2
+
+    def test_cli_clean_on_this_repo(self):
+        """The acceptance criterion: the shipped tree lints clean."""
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "--json"],
+            capture_output=True, text=True, timeout=300, cwd=ROOT)
+        assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-2000:]
+        data = json.loads(r.stdout)
+        assert data["findings"] == []
+        assert len(data["rules"]) >= 14
+
+    def test_env_docs_generator_in_sync(self):
+        """docs tables are generated from the registry; --check gates
+        drift (the satellite's one-source-of-truth contract)."""
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools",
+                                          "gen_env_docs.py"), "--check"],
+            capture_output=True, text=True, timeout=120, cwd=ROOT)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_env_registry_render(self):
+        from mmlspark_tpu.observability import env_registry
+        md = env_registry.render_markdown()
+        for v in env_registry.REGISTRY:
+            assert v.name in md
+        obs = env_registry.render_markdown("observability")
+        assert "MMLSPARK_TPU_LOG_LEVEL" in obs
+        assert "MMLSPARK_TPU_HIST_ENGINE" not in obs
+        assert env_registry.get("MMLSPARK_TPU_LOG_RATE").default == "200"
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -1,90 +1,50 @@
-"""Fast source-level lint for the telemetry layer.
+"""Tier-1 bridge for graftlint (thin runner — the rules moved out).
 
-Two invariants keep the observability subsystem safe to import from every
-other layer:
+The 12 ad-hoc AST guards that used to live here are now declarative
+checkers in ``tools/graftlint/`` (one rule each; see
+``docs/static_analysis.md`` for the old-guard -> rule mapping). This
+shim runs the full pass as one parameterized test per rule, so a
+violation fails tier-1 with the exact rule id and file:line — identical
+coverage, one engine, one parse per file.
 
-* **No import cycle.** Every package (core, io, train, models, ...)
-  imports ``mmlspark_tpu.observability`` at module top level, so
-  observability itself must never import those packages back at top level
-  — its only framework dependency (``utils.profiling``) is deferred into
-  function bodies. Enforced by AST walk + a fresh-interpreter import.
-* **Valid metric names.** Every metric name passed as a literal to
-  ``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` must match
-  ``[a-z_]+`` or the Prometheus text rendering stops parsing.
+The only guard that stays here is the *runtime* complement of
+``obs-import-cycle``: a fresh interpreter importing the telemetry layer
+standalone, proving the static rule's conclusion (no jax, no framework)
+against the real import system.
 """
 
-import ast
 import os
-import re
 import subprocess
 import sys
 
 import pytest
 
-_PKG_ROOT = os.path.join(os.path.dirname(__file__), "..", "mmlspark_tpu")
-_NAME_RE = re.compile(r"^[a-z_]+$")
-_METRIC_FACTORIES = {"counter", "gauge", "histogram",
-                     "safe_counter", "safe_gauge", "safe_histogram"}
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.graftlint import core  # noqa: E402
+
+core.load_checkers()
 
 
-def _py_files(root):
-    for dirpath, _dirnames, filenames in os.walk(root):
-        for fn in sorted(filenames):
-            if fn.endswith(".py"):
-                yield os.path.join(dirpath, fn)
+@pytest.fixture(scope="module")
+def repo():
+    """One parsed tree shared by every per-rule test."""
+    return core.Repo(ROOT)
 
 
-def _parse(path):
-    with open(path, encoding="utf-8") as f:
-        return ast.parse(f.read(), filename=path)
-
-
-def _top_level_imports(tree):
-    """(module, level) pairs imported at module scope (not inside defs)."""
-    out = []
-    for node in ast.iter_child_nodes(tree):
-        # top-level try/if wrappers around imports still count
-        stack = [node]
-        while stack:
-            n = stack.pop()
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                              ast.ClassDef, ast.Lambda)):
-                continue
-            if isinstance(n, ast.Import):
-                out.extend((a.name, 0) for a in n.names)
-            elif isinstance(n, ast.ImportFrom):
-                out.append((n.module or "", n.level))
-            else:
-                stack.extend(ast.iter_child_nodes(n))
-    return out
-
-
-def test_observability_has_no_top_level_framework_imports():
-    """observability/* may import stdlib and its own siblings at top level,
-    nothing else from mmlspark_tpu — that is what makes 'every layer
-    imports observability' cycle-free by construction."""
-    obs_dir = os.path.join(_PKG_ROOT, "observability")
-    offenders = []
-    for path in _py_files(obs_dir):
-        for mod, level in _top_level_imports(_parse(path)):
-            top = mod.split(".")[0]
-            if level >= 2 or top == "mmlspark_tpu":
-                # parent-relative (..) or absolute framework import
-                offenders.append(f"{os.path.basename(path)}: "
-                                 f"{'.' * level}{mod}")
-            elif level == 1 and top not in (
-                    "metrics", "spans", "device", "tracing", "flight",
-                    "logging", "watchdog", "federation", ""):
-                offenders.append(f"{os.path.basename(path)}: .{mod}")
-    assert not offenders, (
-        "observability must defer framework imports into function bodies "
-        f"(import-cycle guard); found top-level: {offenders}")
+@pytest.mark.parametrize("rule", sorted(core.REGISTRY))
+def test_rule_clean(repo, rule):
+    active, _suppressed = core.run(repo, rules=[rule])
+    assert not active, "\n".join(
+        f"{f.location()}: {f.rule}: {f.message}" for f in active)
 
 
 def test_observability_imports_standalone():
     """A fresh interpreter can import the telemetry layer on its own —
-    the runtime proof of the AST rule above (and it keeps the import
-    cheap: no jax, no framework)."""
+    the runtime proof of the obs-import-cycle rule (and it keeps the
+    import cheap: no jax, no framework)."""
     proc = subprocess.run(
         [sys.executable, "-c",
          "import sys\n"
@@ -92,364 +52,9 @@ def test_observability_imports_standalone():
          "assert 'jax' not in sys.modules, 'observability imported jax'\n"
          "o.counter('lint_total').inc()\n"
          "print(o.get_registry().render_prometheus())"],
-        capture_output=True, text=True, timeout=120,
-        cwd=os.path.dirname(_PKG_ROOT))
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
     assert proc.returncode == 0, proc.stderr
     assert "lint_total 1" in proc.stdout
-
-
-def _literal_metric_names():
-    """Every string literal passed as the metric name to a
-    counter/gauge/histogram call anywhere under mmlspark_tpu/."""
-    found = []
-    for path in _py_files(_PKG_ROOT):
-        for node in ast.walk(_parse(path)):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = node.func
-            name = fn.attr if isinstance(fn, ast.Attribute) else \
-                fn.id if isinstance(fn, ast.Name) else None
-            if name not in _METRIC_FACTORIES or not node.args:
-                continue
-            first = node.args[0]
-            if isinstance(first, ast.Constant) and \
-                    isinstance(first.value, str):
-                found.append((os.path.relpath(path, _PKG_ROOT),
-                              node.lineno, first.value))
-    return found
-
-
-def test_metric_name_literals_are_prometheus_safe():
-    names = _literal_metric_names()
-    # the instrumentation exists: an empty scan would mean this lint is
-    # silently matching nothing
-    assert len(names) >= 10, names
-    bad = [(p, ln, n) for p, ln, n in names if not _NAME_RE.match(n)]
-    assert not bad, f"metric names must match [a-z_]+: {bad}"
-
-
-def test_metric_names_unique_per_kind():
-    """One metric name, one kind — the registry raises at runtime on a
-    kind conflict; catch it at lint time across the whole tree."""
-    kinds = {}
-    conflicts = []
-    for path in _py_files(_PKG_ROOT):
-        for node in ast.walk(_parse(path)):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = node.func
-            kind = fn.attr if isinstance(fn, ast.Attribute) else \
-                fn.id if isinstance(fn, ast.Name) else None
-            if kind not in _METRIC_FACTORIES or not node.args:
-                continue
-            kind = kind.removeprefix("safe_")  # same family either way
-            first = node.args[0]
-            if isinstance(first, ast.Constant) and \
-                    isinstance(first.value, str):
-                prev = kinds.setdefault(first.value, kind)
-                if prev != kind:
-                    conflicts.append((first.value, prev, kind))
-    assert not conflicts, conflicts
-
-
-def _loop_body_calls(fn_node):
-    """Call nodes inside For/While bodies of ``fn_node``, excluding nested
-    function/lambda bodies (helpers DEFINED outside the loop and merely
-    called inside it are the sanctioned pattern)."""
-    calls = []
-    for node in ast.walk(fn_node):
-        if not isinstance(node, (ast.For, ast.While)):
-            continue
-        stack = list(node.body) + list(node.orelse)
-        while stack:
-            n = stack.pop()
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                              ast.Lambda)):
-                continue
-            if isinstance(n, ast.Call):
-                calls.append(n)
-            stack.extend(ast.iter_child_nodes(n))
-    return calls
-
-
-def test_streaming_chunk_loops_have_no_host_syncs():
-    """Hot-path guard for the double-buffered streaming loops
-    (io/streaming.py): ``np.asarray`` / ``float()`` inside a per-chunk
-    loop body is a host sync that serializes device compute against the
-    loop and defeats the prefetch overlap. Materialization belongs in a
-    helper defined OUTSIDE the loop (e.g. ``_score``), where it is one
-    deliberate, testable sync per chunk."""
-    tree = _parse(os.path.join(_PKG_ROOT, "io", "streaming.py"))
-    fns = [n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)]
-    assert any(f.name == "stream_apply" for f in fns)
-    offenders = []
-    for fn in fns:
-        for call in _loop_body_calls(fn):
-            callee = call.func
-            name = callee.attr if isinstance(callee, ast.Attribute) else \
-                callee.id if isinstance(callee, ast.Name) else None
-            if name in ("asarray", "float"):
-                offenders.append((fn.name, call.lineno, name))
-    assert not offenders, (
-        "host syncs inside per-chunk streaming loop bodies "
-        f"(move into a pre-loop helper): {offenders}")
-
-
-def test_booster_predict_path_takes_trees_as_arguments():
-    """Hot-path guard for the device-resident predictor
-    (models/gbdt/booster.py): the forest must ride as jit ARGUMENTS, not
-    constants — ``jnp.asarray(self.trees...)`` (or a device_put of them)
-    anywhere in the predictor build path would bake the trees into the
-    executable, making it per-Booster and bringing back the
-    recompile-after-unpickle serving stall this PR removed."""
-    tree = _parse(os.path.join(_PKG_ROOT, "models", "gbdt", "booster.py"))
-    predict_path = {"predict", "predict_raw", "_predict_device",
-                    "_device_forest_args", "_device_active",
-                    "_build_predict_program", "_predict_program"}
-    fns = [n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)
-           and n.name in predict_path]
-    # the predictor build path exists — an empty scan would mean this
-    # lint silently matches nothing
-    assert len(fns) >= 4, sorted(f.name for f in fns)
-    offenders = []
-    for fn in fns:
-        for call in ast.walk(fn):
-            if not isinstance(call, ast.Call):
-                continue
-            callee = call.func
-            name = callee.attr if isinstance(callee, ast.Attribute) else \
-                callee.id if isinstance(callee, ast.Name) else None
-            if name not in ("asarray", "array", "device_put"):
-                continue
-            # numpy host-side staging (np.asarray) is allowed; only
-            # device placement of the raw tree arrays is baking
-            mod = callee.value.id if (isinstance(callee, ast.Attribute)
-                                      and isinstance(callee.value,
-                                                     ast.Name)) else None
-            if mod == "np":
-                continue
-            for arg in ast.walk(ast.Module(body=[ast.Expr(a) for a
-                                                 in call.args],
-                                           type_ignores=[])):
-                if isinstance(arg, ast.Attribute) and arg.attr == "trees":
-                    offenders.append((fn.name, call.lineno))
-                    break
-    assert not offenders, (
-        "predictor build path must pass trees as packed jit arguments, "
-        f"not bake them via jnp.asarray/device_put: {offenders}")
-
-
-def _functions_containing(tree):
-    """Map every AST node to its innermost enclosing function name."""
-    owner = {}
-
-    def walk(node, fn_name):
-        for child in ast.iter_child_nodes(node):
-            name = fn_name
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                name = child.name
-            owner[child] = name
-            walk(child, name)
-
-    owner[tree] = None
-    walk(tree, None)
-    return owner
-
-
-def test_io_handlers_route_through_shared_response_helper():
-    """Every do_GET/do_POST branch in io/ must emit its response through
-    serving.py's ``write_http_response`` — the shared status-counter
-    funnel — so no handler branch can skip Content-Length, the
-    per-status counters, or future response policy. A raw
-    ``send_response`` call anywhere else under io/ is the violation."""
-    io_dir = os.path.join(_PKG_ROOT, "io")
-    offenders = []
-    seen_helper = False
-    for path in _py_files(io_dir):
-        tree = _parse(path)
-        owner = _functions_containing(tree)
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "send_response"):
-                continue
-            fn = owner.get(node)
-            if fn == "write_http_response" and \
-                    os.path.basename(path) == "serving.py":
-                seen_helper = True
-                continue
-            offenders.append((os.path.relpath(path, _PKG_ROOT),
-                              node.lineno, fn))
-    assert seen_helper, "write_http_response helper not found in serving.py"
-    assert not offenders, (
-        "io/ handlers must route responses through "
-        f"serving.write_http_response: {offenders}")
-
-
-def test_shard_map_routes_through_compat_funnel():
-    """``parallel/compat.py`` is the ONE place the jax shard_map API skew
-    (jax.shard_map vs jax.experimental.shard_map.shard_map, check_vma vs
-    check_rep) is resolved. A bare ``jax.shard_map(`` — or a direct
-    experimental import — anywhere else reintroduces the version skew
-    that cost 240 tier-1 tests before the funnel existed."""
-    compat_rel = os.path.join("parallel", "compat.py")
-    repo_root = os.path.dirname(_PKG_ROOT)
-    scan = list(_py_files(_PKG_ROOT))
-    scan += list(_py_files(os.path.join(repo_root, "tests")))
-    scan += list(_py_files(os.path.join(repo_root, "tools")))
-    for fn in ("__graft_entry__.py", "bench.py", "graft_test_env.py"):
-        p = os.path.join(repo_root, fn)
-        if os.path.exists(p):
-            scan.append(p)
-    offenders = []
-    for path in scan:
-        if os.path.relpath(path, _PKG_ROOT) == compat_rel:
-            continue
-        for node in ast.walk(_parse(path)):
-            if (isinstance(node, ast.Attribute)
-                    and node.attr == "shard_map"
-                    and isinstance(node.value, ast.Name)
-                    and node.value.id == "jax"):
-                offenders.append((os.path.relpath(path, repo_root),
-                                  node.lineno, "jax.shard_map"))
-            elif (isinstance(node, ast.ImportFrom) and node.module
-                    and node.module.startswith("jax.experimental.shard_map")):
-                offenders.append((os.path.relpath(path, repo_root),
-                                  node.lineno, f"from {node.module} import"))
-    assert not offenders, (
-        "shard_map must be imported from mmlspark_tpu.parallel.compat "
-        f"(the version-skew funnel): {offenders}")
-
-
-def _first_lineno(fn_node, match):
-    """Smallest lineno inside ``fn_node`` for which ``match(node)``."""
-    best = None
-    for node in ast.walk(fn_node):
-        if match(node):
-            ln = getattr(node, "lineno", None)
-            if ln is not None and (best is None or ln < best):
-                best = ln
-    return best
-
-
-def test_auto_sentinel_resolved_before_program_cache_keys():
-    """GrowConfig's backend-adaptive tri-states (hist_subtraction /
-    compact_selector = "auto") must be resolved to concrete values BEFORE
-    the config reaches any compiled-program cache key: an unresolved
-    sentinel would alias programs across backends. Source-level pin:
-    ``train_booster`` calls ``resolve_growth_backend`` before its first
-    ``cache_key`` construction / ``_cached_program`` call, and the
-    estimator layer's ``_grow_config`` routes through the resolver too.
-    (tests/test_histogram_engines.py proves it at runtime by scanning the
-    live step-cache keys after a default-config fit.)"""
-    booster_py = os.path.join(_PKG_ROOT, "models", "gbdt", "booster.py")
-    tree = _parse(booster_py)
-    tb = next(n for n in ast.walk(tree)
-              if isinstance(n, ast.FunctionDef) and n.name == "train_booster")
-
-    def is_resolver_call(n):
-        return (isinstance(n, ast.Call)
-                and isinstance(n.func, ast.Name)
-                and n.func.id == "resolve_growth_backend")
-
-    def is_cache_use(n):
-        if isinstance(n, ast.Assign):
-            return any(isinstance(t, ast.Name) and "cache_key" in t.id
-                       for t in n.targets)
-        return (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
-                and n.func.id == "_cached_program")
-
-    resolver_ln = _first_lineno(tb, is_resolver_call)
-    cache_ln = _first_lineno(tb, is_cache_use)
-    assert resolver_ln is not None, \
-        "train_booster no longer resolves the 'auto' tri-states"
-    assert cache_ln is not None, "lint matched no cache-key construction"
-    assert resolver_ln < cache_ln, (
-        f"resolve_growth_backend (line {resolver_ln}) must run before the "
-        f"first cache-key construction (line {cache_ln})")
-
-    api_py = os.path.join(_PKG_ROOT, "models", "gbdt", "api.py")
-    gc = next(n for n in ast.walk(_parse(api_py))
-              if isinstance(n, ast.FunctionDef) and n.name == "_grow_config")
-    assert _first_lineno(gc, is_resolver_call) is not None, (
-        "_grow_config must resolve 'auto' before handing GrowConfig to "
-        "direct consumers (the sweep path bypasses train_booster)")
-
-
-_LOG_FUNNEL = os.path.join("observability", "logging.py")
-
-
-def test_no_raw_text_output_outside_logging_funnel():
-    """``observability/logging.py`` is the ONE textual-output path for the
-    framework: structured records via ``get_logger`` (JSON lines +
-    flight ring + rate limit + trace ids) and ``console()`` for the few
-    sanctioned CLI ready-lines. A bare ``print(`` or
-    ``sys.stderr/stdout.write`` anywhere else under ``mmlspark_tpu/``
-    bypasses all of that — records with no trace identity, no collection
-    path, and no kill-switch discipline."""
-    offenders = []
-    for path in _py_files(_PKG_ROOT):
-        if os.path.relpath(path, _PKG_ROOT) == _LOG_FUNNEL:
-            continue
-        for node in ast.walk(_parse(path)):
-            if isinstance(node, ast.Call) and \
-                    isinstance(node.func, ast.Name) and \
-                    node.func.id == "print":
-                offenders.append((os.path.relpath(path, _PKG_ROOT),
-                                  node.lineno, "print("))
-            elif (isinstance(node, ast.Attribute)
-                    and node.attr == "write"
-                    and isinstance(node.value, ast.Attribute)
-                    and node.value.attr in ("stderr", "stdout")
-                    and isinstance(node.value.value, ast.Name)
-                    and node.value.value.id == "sys"):
-                offenders.append((os.path.relpath(path, _PKG_ROOT),
-                                  node.lineno,
-                                  f"sys.{node.value.attr}.write"))
-    assert not offenders, (
-        "textual output must route through observability.logging "
-        f"(get_logger / console): {offenders}")
-
-
-def test_no_stdlib_getlogger_outside_logging_funnel():
-    """Framework code must log through ``observability.logging.get_logger``
-    — records then carry trace ids, rate limiting, and the flight-ring
-    mirror. A direct stdlib ``logging.getLogger`` creates a parallel,
-    unstructured stream that the kill switch and collectors never see."""
-    offenders = []
-    for path in _py_files(_PKG_ROOT):
-        if os.path.relpath(path, _PKG_ROOT) == _LOG_FUNNEL:
-            continue
-        for node in ast.walk(_parse(path)):
-            if isinstance(node, ast.Attribute) and \
-                    node.attr == "getLogger":
-                offenders.append((os.path.relpath(path, _PKG_ROOT),
-                                  node.lineno))
-    assert not offenders, (
-        "use observability.logging.get_logger, not stdlib "
-        f"logging.getLogger: {offenders}")
-
-
-def test_trace_header_names_come_from_tracing_module():
-    """The wire contract lives in observability/tracing.py
-    (TRACEPARENT_HEADER / REQUEST_ID_HEADER); a string literal at any
-    other call site can drift per hop and silently break cross-process
-    stitching."""
-    header_names = {"traceparent", "x-request-id"}
-    tracing_py = os.path.join("observability", "tracing.py")
-    offenders = []
-    for path in _py_files(_PKG_ROOT):
-        if os.path.relpath(path, _PKG_ROOT) == tracing_py:
-            continue
-        for node in ast.walk(_parse(path)):
-            if isinstance(node, ast.Constant) and \
-                    isinstance(node.value, str) and \
-                    node.value.strip().lower() in header_names:
-                offenders.append((os.path.relpath(path, _PKG_ROOT),
-                                  node.lineno, node.value))
-    assert not offenders, (
-        "trace header names must come from observability.tracing "
-        f"constants, not literals: {offenders}")
 
 
 if __name__ == "__main__":

@@ -157,6 +157,29 @@ class TestBenchRegression:
         r = self._run(tmp_path)
         assert r.returncode == 0, r.stdout + r.stderr
 
+    def test_round_missing_metric_key_never_gates_value(self, tmp_path):
+        # a round that lost its "metric" name (wrapper crash mid-write)
+        # must not have its "value" gated against anything — and must
+        # not crash the comparison
+        self._write_round(tmp_path, 1, {"value": 30.0,
+                                        "gbdt_predict_rows_per_sec": 100.0})
+        self._write_round(tmp_path, 2, {"value": 3.0,
+                                        "gbdt_predict_rows_per_sec": 95.0})
+        r = self._run(tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_compare_tolerates_missing_keys(self):
+        sys.path.insert(0, TOOLS)
+        try:
+            import bench_regression as br
+        finally:
+            sys.path.remove(TOOLS)
+        # public helper, arbitrary dicts: a key present in one round
+        # only is skipped, not a KeyError
+        assert br.compare({"x_per_sec": 10.0, "metric": "m", "value": 1.0},
+                          {"metric": "m", "value": 1.0},
+                          threshold=0.2) == []
+
 
 def test_docker_tree_well_formed():
     for rel in ("docker/minimal/Dockerfile", "docker/serving/Dockerfile"):

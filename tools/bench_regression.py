@@ -85,9 +85,12 @@ def _comparable_keys(prev: Dict, cur: Dict) -> List[str]:
     keys = [k for k in cur
             if _RATE_RE.match(k) and k in prev]
     # the headline "value" compares only when both rounds measured the
-    # same metric (a TPU round must not be gated against a CPU fallback)
-    if prev.get("metric") == cur.get("metric") and "value" in prev \
-            and "value" in cur:
+    # same, explicitly named metric (a TPU round must not be gated
+    # against a CPU fallback, and a round that lost its "metric" key
+    # must not be gated against anything)
+    if "metric" in prev and "metric" in cur \
+            and prev["metric"] == cur["metric"] \
+            and "value" in prev and "value" in cur:
         keys.append("value")
     return sorted(set(keys))
 
@@ -99,6 +102,9 @@ def compare(prev: Dict, cur: Dict, threshold: float) -> List[str]:
         try:
             old, new = float(prev[key]), float(cur[key])
         except (TypeError, ValueError):
+            # non-numeric value (wrapper noise) — skip, never crash.
+            # Keys missing from either round never reach here:
+            # _comparable_keys only returns keys present in both.
             continue
         if old <= 0 or new <= 0:
             continue                      # -1 sentinel / failed secondary

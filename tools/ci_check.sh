@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Standalone static-analysis lane (no pytest, no jax): graftlint over
+# the whole tree with machine-readable output, plus the env-var docs
+# drift gate. Exit nonzero on any unsuppressed finding or drifted table.
+#
+#   tools/ci_check.sh            # human summary + JSON artifact
+#   GRAFTLINT_JSON=out.json tools/ci_check.sh
+set -u -o pipefail
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+JSON_OUT="${GRAFTLINT_JSON:-}"
+
+rc=0
+
+if [ -n "$JSON_OUT" ]; then
+    if ! (cd "$ROOT" && python -m tools.graftlint --json > "$JSON_OUT"); then
+        rc=1
+    fi
+    # a crash/usage error (exit 2) leaves no JSON — don't traceback on it
+    if [ -s "$JSON_OUT" ]; then
+        n=$(python - "$JSON_OUT" <<'EOF'
+import json, sys
+print(len(json.load(open(sys.argv[1]))["findings"]))
+EOF
+)
+        echo "graftlint: $n finding(s) -> $JSON_OUT"
+    else
+        echo "graftlint: no JSON produced (crash or usage error)" >&2
+    fi
+else
+    (cd "$ROOT" && python -m tools.graftlint) || rc=1
+fi
+
+(cd "$ROOT" && python tools/gen_env_docs.py --check) || rc=1
+
+if [ "$rc" -ne 0 ]; then
+    echo "ci_check: FAILED (graftlint findings or env-docs drift)" >&2
+else
+    echo "ci_check: clean"
+fi
+exit "$rc"

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Regenerate the env-var tables in docs from the central registry.
+
+``mmlspark_tpu/observability/env_registry.py`` is the single source of
+truth for every ``MMLSPARK_TPU_*`` knob (graftlint's
+``env-var-registry`` rule pins code to it). This script rewrites the
+table between the ``<!-- env-registry:begin section=... -->`` /
+``<!-- env-registry:end -->`` markers in each docs file named by
+``env_registry.SECTIONS``::
+
+    python tools/gen_env_docs.py           # rewrite docs in place
+    python tools/gen_env_docs.py --check   # exit 1 on drift (CI)
+
+Exit status: 0 = in sync (or rewritten), 1 = drift under --check,
+2 = markers missing / usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import List, Optional
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from mmlspark_tpu.observability import env_registry  # noqa: E402
+
+
+def _marker_re(section: str) -> "re.Pattern[str]":
+    return re.compile(
+        r"(<!-- env-registry:begin section=" + re.escape(section)
+        + r" -->\n).*?(\n<!-- env-registry:end -->)", re.DOTALL)
+
+
+def splice(text: str, section: str) -> Optional[str]:
+    """Text with the section's table regenerated, or None when the
+    markers are absent."""
+    table = env_registry.render_markdown(section)
+    pat = _marker_re(section)
+    if not pat.search(text):
+        return None
+    return pat.sub(lambda m: m.group(1) + table + m.group(2), text)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="gen_env_docs")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 if any docs table differs from the "
+                        "registry instead of rewriting")
+    args = p.parse_args(argv)
+
+    drift = []
+    for section, rel in sorted(env_registry.SECTIONS.items()):
+        path = os.path.join(ROOT, rel)
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            print(f"gen_env_docs: cannot read {rel}: {e}", file=sys.stderr)
+            return 2
+        new = splice(text, section)
+        if new is None:
+            print(f"gen_env_docs: {rel} has no "
+                  f"'env-registry:begin section={section}' markers",
+                  file=sys.stderr)
+            return 2
+        if new != text:
+            drift.append(rel)
+            if not args.check:
+                with open(path, "w", encoding="utf-8") as f:
+                    f.write(new)
+    if args.check and drift:
+        print("gen_env_docs: docs drifted from env_registry.py in: "
+              + ", ".join(drift) + " — run python tools/gen_env_docs.py")
+        return 1
+    print(f"gen_env_docs: {len(env_registry.SECTIONS)} tables "
+          + ("checked, in sync" if args.check else
+             (f"rewritten ({', '.join(drift)})" if drift
+              else "already in sync")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,18 @@
+"""graftlint — static enforcement of the framework's invariants.
+
+Usage::
+
+    python -m tools.graftlint                 # whole repo, human output
+    python -m tools.graftlint --json          # machine output (CI)
+    python -m tools.graftlint --rule raw-output-funnel --rule lock-discipline
+    python -m tools.graftlint --list-rules
+
+See ``docs/static_analysis.md`` for the rule catalogue and
+``tools/graftlint/core.py`` for the checker API.
+"""
+
+from .core import (Checker, CheckerRotError, Finding, Module,  # noqa: F401
+                   REGISTRY, Repo, load_checkers, register, run)
+
+__all__ = ["Checker", "CheckerRotError", "Finding", "Module", "REGISTRY",
+           "Repo", "load_checkers", "register", "run"]

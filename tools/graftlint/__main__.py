@@ -1,0 +1,56 @@
+"""CLI: ``python -m tools.graftlint [--rule R ...] [--json] [ROOT]``.
+
+Exit status: 0 = clean, 1 = unsuppressed findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from . import core
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST static analysis for the framework's invariants")
+    p.add_argument("root", nargs="?",
+                   default=os.path.dirname(os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__)))),
+                   help="repo root to scan (default: this checkout)")
+    p.add_argument("--rule", action="append", dest="rules", metavar="ID",
+                   help="run only this rule (repeatable)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print suppressed findings (human mode)")
+    args = p.parse_args(argv)
+
+    core.load_checkers()
+    if args.list_rules:
+        for rule, checker in sorted(core.REGISTRY.items()):
+            print(f"{rule}: {checker.description}")
+        return 0
+
+    repo = core.Repo(args.root)
+    try:
+        active, suppressed = core.run(repo, rules=args.rules)
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(core.render_json(active, suppressed, rules=args.rules))
+    else:
+        print(core.render_human(active, suppressed,
+                                show_suppressed=args.show_suppressed,
+                                rules=args.rules))
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,11 @@
+"""Bundled checkers. Importing this package populates ``core.REGISTRY``."""
+
+from . import funnels        # noqa: F401
+from . import metrics        # noqa: F401
+from . import imports        # noqa: F401
+from . import hotpath        # noqa: F401
+from . import predict        # noqa: F401
+from . import cachekey       # noqa: F401
+from . import resources      # noqa: F401
+from . import locks          # noqa: F401
+from . import envvars        # noqa: F401

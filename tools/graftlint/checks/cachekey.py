@@ -1,0 +1,163 @@
+"""Resolve-before-cache-key rule.
+
+A compiled-program cache key built from an unresolved "auto" sentinel —
+or from config that an ``os.environ`` read / ``resolve_*()`` call is
+about to change — aliases programs across backends: two processes (or
+two phases of one process) hit the same key for different programs. The
+PR 4 incident class.
+
+Two parts, one rule (``resolve-before-cache-key``):
+
+1. **The anchored pin** (migrated from
+   ``test_auto_sentinel_resolved_before_program_cache_keys``):
+   ``train_booster`` must call ``resolve_growth_backend`` before its
+   first cache-key construction, and the estimator layer's
+   ``_grow_config`` must route through the resolver at all (the sweep
+   path bypasses ``train_booster``).
+2. **The general analysis**: in ANY package function, an ``os.environ``
+   read or a ``resolve_*()`` call *lexically after* the function's first
+   cache-key construction (an assignment to a ``*cache_key*`` name, a
+   subscript/``get``/``setdefault`` on a ``*_CACHE`` global, or a
+   ``_cached_program(...)`` call) is flagged: whatever that read
+   resolves was not part of the key just built. Deliberate
+   reads-that-don't-feed-keys carry an inline suppression with a
+   justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from ..core import (Checker, CheckerRotError, Finding, Module, Repo,
+                    call_name, first_lineno, register)
+
+_CACHE_NAME_RE = re.compile(r".*_CACHE$")
+_BOOSTER = "mmlspark_tpu/models/gbdt/booster.py"
+_API = "mmlspark_tpu/models/gbdt/api.py"
+
+
+def _is_cache_key_construction(node: ast.AST) -> bool:
+    if isinstance(node, ast.Assign):
+        if any(isinstance(t, ast.Name) and "cache_key" in t.id
+               for t in node.targets):
+            return True
+    if isinstance(node, ast.Subscript) and \
+            isinstance(node.value, ast.Name) and \
+            _CACHE_NAME_RE.match(node.value.id):
+        return True
+    if isinstance(node, ast.Call):
+        qual, name = call_name(node)
+        if name == "_cached_program":
+            return True
+        if name in ("get", "setdefault", "pop") and qual and \
+                _CACHE_NAME_RE.match(qual.split(".")[-1]):
+            return True
+    return False
+
+
+def _is_env_read(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "environ"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "os")
+
+
+def _is_resolver_call(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        _qual, name = call_name(node)
+        if name and name.startswith("resolve_"):
+            return name
+    return None
+
+
+class ResolveBeforeCacheKey(Checker):
+    rule = "resolve-before-cache-key"
+    description = "os.environ reads and resolve_*() calls must precede " \
+                  "any compiled-program cache-key construction in the " \
+                  "same function"
+
+    def check(self, repo: Repo) -> Iterator[Finding]:
+        yield from self._anchored_pin(repo)
+        for mod in repo.package():
+            for fn in ast.walk(mod.tree):
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                yield from self._scan_fn(mod, fn)
+
+    def _scan_fn(self, mod: Module, fn: ast.AST) -> Iterator[Finding]:
+        cache_ln = first_lineno(fn, _is_cache_key_construction)
+        if cache_ln is None:
+            return
+        # nested defs establish their own ordering scope: a closure that
+        # reads env lazily AFTER the outer key was built is exactly the
+        # aliasing hazard, so nested bodies are NOT excluded here
+        for node in ast.walk(fn):
+            ln = getattr(node, "lineno", None)
+            if ln is None or ln <= cache_ln:
+                continue
+            if _is_env_read(node):
+                yield self.finding(
+                    mod, ln,
+                    f"os.environ read at line {ln} after cache-key "
+                    f"construction at line {cache_ln} in {fn.name}() — "
+                    "resolve before the key is built (or the key aliases "
+                    "across configs)")
+            else:
+                resolver = _is_resolver_call(node)
+                if resolver:
+                    yield self.finding(
+                        mod, ln,
+                        f"{resolver}() at line {ln} after cache-key "
+                        f"construction at line {cache_ln} in {fn.name}()"
+                        " — resolve before the key is built")
+
+    def _anchored_pin(self, repo: Repo) -> Iterator[Finding]:
+        booster = repo.module(_BOOSTER)
+        api = repo.module(_API)
+        if booster is None or api is None:
+            raise CheckerRotError("models/gbdt/{booster,api}.py moved")
+        tb = next((n for n in ast.walk(booster.tree)
+                   if isinstance(n, ast.FunctionDef)
+                   and n.name == "train_booster"), None)
+        if tb is None:
+            raise CheckerRotError("train_booster vanished from booster.py")
+
+        def is_growth_resolver(n: ast.AST) -> bool:
+            return (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Name)
+                    and n.func.id == "resolve_growth_backend")
+
+        resolver_ln = first_lineno(tb, is_growth_resolver)
+        cache_ln = first_lineno(tb, _is_cache_key_construction)
+        if cache_ln is None:
+            raise CheckerRotError(
+                "train_booster no longer constructs a cache key — "
+                "anchored pin matches nothing")
+        if resolver_ln is None:
+            yield self.finding(
+                booster, tb.lineno,
+                "train_booster no longer resolves the 'auto' tri-states "
+                "(resolve_growth_backend call missing)")
+        elif resolver_ln >= cache_ln:
+            yield self.finding(
+                booster, resolver_ln,
+                f"resolve_growth_backend (line {resolver_ln}) must run "
+                f"before the first cache-key construction "
+                f"(line {cache_ln})")
+
+        gc = next((n for n in ast.walk(api.tree)
+                   if isinstance(n, ast.FunctionDef)
+                   and n.name == "_grow_config"), None)
+        if gc is None:
+            raise CheckerRotError("_grow_config vanished from api.py")
+        if first_lineno(gc, is_growth_resolver) is None:
+            yield self.finding(
+                api, gc.lineno,
+                "_grow_config must resolve 'auto' before handing "
+                "GrowConfig to direct consumers (the sweep path bypasses "
+                "train_booster)")
+
+
+register(ResolveBeforeCacheKey())

@@ -1,0 +1,104 @@
+"""Env-var registry rule.
+
+Every ``MMLSPARK_TPU_*`` knob must be declared exactly once — with a
+default and a doc string — in the central table
+``mmlspark_tpu/observability/env_registry.py``. Before the registry,
+~28 read sites were scattered across the tree and the docs tables
+drifted from them silently (``docs/observability.md`` /
+``docs/performance.md`` are now *generated* from the registry by
+``tools/gen_env_docs.py``).
+
+The rule (``env-var-registry``) checks three directions:
+
+* a ``MMLSPARK_TPU_*`` string literal anywhere in the package that is
+  not declared in the registry (an undocumented knob);
+* a registry entry with ``where="python"`` that no package code reads
+  (a stale entry — entries read by native code or the bench driver
+  declare ``where="native"`` / ``where="bench"`` instead);
+* a registry entry with an empty ``doc``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, Set, Tuple
+
+from ..core import (Checker, CheckerRotError, Finding, Repo, call_name,
+                    register)
+
+_REGISTRY_REL = "mmlspark_tpu/observability/env_registry.py"
+_VAR_RE = re.compile(r"^MMLSPARK_TPU_[A-Z0-9_]+$")
+_MIN_DECLARED = 10
+
+
+def _declared_vars(repo: Repo) -> Dict[str, Tuple[int, str, str]]:
+    """name -> (lineno, where, doc) from the registry's EnvVar(...) calls."""
+    mod = repo.module(_REGISTRY_REL)
+    if mod is None:
+        raise CheckerRotError(
+            f"{_REGISTRY_REL} is gone — the env-var single source of "
+            "truth must exist")
+    out: Dict[str, Tuple[int, str, str]] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        _qual, cname = call_name(node)
+        if cname != "EnvVar":
+            continue
+        kw = {k.arg: k.value for k in node.keywords}
+        name_node = kw.get("name") or (node.args[0] if node.args else None)
+        if not (isinstance(name_node, ast.Constant)
+                and isinstance(name_node.value, str)):
+            continue
+        where = "python"
+        if isinstance(kw.get("where"), ast.Constant):
+            where = str(kw["where"].value)
+        doc = ""
+        if isinstance(kw.get("doc"), ast.Constant):
+            doc = str(kw["doc"].value)
+        out[name_node.value] = (node.lineno, where, doc)
+    return out
+
+
+class EnvVarRegistry(Checker):
+    rule = "env-var-registry"
+    description = "every MMLSPARK_TPU_* knob is declared once, with a " \
+                  "doc string, in observability/env_registry.py"
+
+    def check(self, repo: Repo) -> Iterator[Finding]:
+        declared = _declared_vars(repo)
+        if len(declared) < _MIN_DECLARED:
+            raise CheckerRotError(
+                f"only {len(declared)} EnvVar declarations parsed from "
+                f"{_REGISTRY_REL} (expected >= {_MIN_DECLARED}) — table "
+                "format changed?")
+        reg_mod = repo.module(_REGISTRY_REL)
+        used: Set[str] = set()
+        for mod in repo.package():
+            if mod is reg_mod:
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str) and \
+                        _VAR_RE.match(node.value):
+                    used.add(node.value)
+                    if node.value not in declared:
+                        yield self.finding(
+                            mod, node.lineno,
+                            f"{node.value} is read here but not declared "
+                            f"in {_REGISTRY_REL} — add an EnvVar entry "
+                            "(name, default, doc)")
+        for name, (lineno, where, doc) in sorted(declared.items()):
+            if not doc.strip():
+                yield self.finding(
+                    reg_mod, lineno,
+                    f"{name} is declared without a doc string")
+            if where == "python" and name not in used:
+                yield self.finding(
+                    reg_mod, lineno,
+                    f"{name} is declared but no package code reads it — "
+                    "delete the entry or mark where=\"native\"/\"bench\"")
+
+
+register(EnvVarRegistry())

@@ -1,0 +1,214 @@
+"""Funnel rules: "only module X may call Y".
+
+Five load-bearing single-owner contracts, one declarative table. Each
+entry names the API being funneled, the one place allowed to touch it,
+and a matcher over shared ASTs — what used to be five copy-pasted AST
+walks in ``tests/test_lint.py``:
+
+* ``raw-output-funnel`` — ``observability/logging.py`` is the ONE
+  textual-output path (JSON records + flight mirror + rate limit +
+  trace ids); a bare ``print(`` / ``sys.stderr.write`` bypasses all of
+  it.
+* ``stdlib-getlogger`` — stdlib ``logging.getLogger`` creates a
+  parallel unstructured stream the kill switch and collectors never see.
+* ``response-funnel`` — every HTTP response under ``io/`` goes through
+  ``serving.write_http_response`` (Content-Length + per-status counters
+  + future response policy in one place).
+* ``shard-map-funnel`` — ``parallel/compat.py`` is the one place the
+  jax shard_map API skew is resolved; a bare ``jax.shard_map`` (or a
+  direct experimental import) anywhere else reintroduces the version
+  skew that cost 240 tier-1 tests.
+* ``trace-header-literal`` — the W3C wire contract lives in
+  ``observability/tracing.py`` (TRACEPARENT_HEADER / REQUEST_ID_HEADER);
+  a string literal at any other call site can drift per hop and break
+  cross-process stitching.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Callable, Iterator, List, Optional, Tuple
+
+from ..core import (Checker, CheckerRotError, Finding, Module, Repo,
+                    register)
+
+#: (line, detail) pairs a matcher reports for one module
+Matches = Iterator[Tuple[int, str]]
+
+
+def _match_raw_output(mod: Module) -> Matches:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and node.func.id == "print":
+            yield node.lineno, "print("
+        elif (isinstance(node, ast.Attribute) and node.attr == "write"
+              and isinstance(node.value, ast.Attribute)
+              and node.value.attr in ("stderr", "stdout")
+              and isinstance(node.value.value, ast.Name)
+              and node.value.value.id == "sys"):
+            yield node.lineno, f"sys.{node.value.attr}.write"
+
+
+def _match_getlogger(mod: Module) -> Matches:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Attribute) and node.attr == "getLogger":
+            yield node.lineno, "logging.getLogger"
+
+
+def _match_send_response(mod: Module) -> Matches:
+    owner = mod.owner_map()
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "send_response"):
+            yield node.lineno, f"send_response in {owner.get(node)}()"
+
+
+def _match_shard_map(mod: Module) -> Matches:
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Attribute) and node.attr == "shard_map"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "jax"):
+            yield node.lineno, "jax.shard_map"
+        elif (isinstance(node, ast.ImportFrom) and node.module
+              and node.module.startswith("jax.experimental.shard_map")):
+            yield node.lineno, f"from {node.module} import"
+
+
+_TRACE_HEADERS = frozenset({"traceparent", "x-request-id"})
+
+
+def _match_trace_headers(mod: Module) -> Matches:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and node.value.strip().lower() in _TRACE_HEADERS:
+            yield node.lineno, repr(node.value)
+
+
+@dataclass(frozen=True)
+class FunnelRule:
+    rule: str
+    description: str
+    #: repo-relative scan roots (dirs or files)
+    scope: Tuple[str, ...]
+    #: repo-relative paths where the API is legitimately used (the owner)
+    allow: Tuple[str, ...]
+    match: Callable[[Module], Matches]
+    remedy: str
+    #: (path, function) pairs that must exist in the scan, else the rule
+    #: has rotted (the funnel owner was renamed away)
+    anchors: Tuple[Tuple[str, Optional[str]], ...] = ()
+    #: (path, function): matches inside this function of this file are
+    #: the funnel itself, not violations
+    allow_in_function: Tuple[Tuple[str, str], ...] = ()
+
+
+FUNNEL_RULES: Tuple[FunnelRule, ...] = (
+    FunnelRule(
+        rule="raw-output-funnel",
+        description="textual output only via observability.logging "
+                    "(get_logger / console)",
+        scope=("mmlspark_tpu",),
+        allow=("mmlspark_tpu/observability/logging.py",),
+        match=_match_raw_output,
+        remedy="route through observability.logging.get_logger or "
+               "console()",
+        anchors=(("mmlspark_tpu/observability/logging.py", "console"),),
+    ),
+    FunnelRule(
+        rule="stdlib-getlogger",
+        description="no stdlib logging.getLogger outside the logging "
+                    "funnel",
+        scope=("mmlspark_tpu",),
+        allow=("mmlspark_tpu/observability/logging.py",),
+        match=_match_getlogger,
+        remedy="use observability.logging.get_logger",
+        anchors=(("mmlspark_tpu/observability/logging.py", "get_logger"),),
+    ),
+    FunnelRule(
+        rule="response-funnel",
+        description="io/ handlers emit responses only through "
+                    "serving.write_http_response",
+        scope=("mmlspark_tpu/io",),
+        allow=(),
+        match=_match_send_response,
+        remedy="route through serving.write_http_response (the "
+               "status-counter funnel)",
+        anchors=(("mmlspark_tpu/io/serving.py", "write_http_response"),),
+        allow_in_function=(("mmlspark_tpu/io/serving.py",
+                            "write_http_response"),),
+    ),
+    FunnelRule(
+        rule="shard-map-funnel",
+        description="shard_map only via parallel/compat.py (the "
+                    "version-skew funnel)",
+        scope=("mmlspark_tpu", "tests", "tools", "__graft_entry__.py",
+               "bench.py", "graft_test_env.py"),
+        allow=("mmlspark_tpu/parallel/compat.py",),
+        match=_match_shard_map,
+        remedy="import shard_map from mmlspark_tpu.parallel.compat",
+        anchors=(("mmlspark_tpu/parallel/compat.py", None),),
+    ),
+    FunnelRule(
+        rule="trace-header-literal",
+        description="trace header names only from observability.tracing "
+                    "constants",
+        scope=("mmlspark_tpu",),
+        allow=("mmlspark_tpu/observability/tracing.py",),
+        match=_match_trace_headers,
+        remedy="use tracing.TRACEPARENT_HEADER / tracing.REQUEST_ID_HEADER",
+        anchors=(("mmlspark_tpu/observability/tracing.py", None),),
+    ),
+)
+
+
+class FunnelChecker(Checker):
+    """One table entry = one rule instance."""
+
+    def __init__(self, spec: FunnelRule):
+        self.spec = spec
+        self.rule = spec.rule
+        self.description = spec.description
+
+    def _check_anchors(self, repo: Repo) -> None:
+        for path, fn_name in self.spec.anchors:
+            mod = repo.module(path)
+            if mod is None:
+                raise CheckerRotError(f"anchor file {path} is gone")
+            if fn_name is not None and not any(
+                    isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and n.name == fn_name for n in ast.walk(mod.tree)):
+                raise CheckerRotError(
+                    f"anchor function {fn_name}() vanished from {path}")
+
+    def check(self, repo: Repo) -> Iterator[Finding]:
+        self._check_anchors(repo)
+        allowed_fns = dict(self.spec.allow_in_function)
+        for mod in repo.under(*self.spec.scope):
+            if mod.rel in self.spec.allow:
+                continue
+            for line, detail in self.spec.match(mod):
+                if mod.rel in allowed_fns:
+                    # the funnel function itself is the sanctioned site
+                    node_fn = self._function_at(mod, line)
+                    if node_fn == allowed_fns[mod.rel]:
+                        continue
+                yield self.finding(mod, line,
+                                   f"{detail} — {self.spec.remedy}")
+
+    @staticmethod
+    def _function_at(mod: Module, line: int) -> Optional[str]:
+        """Innermost function whose body spans ``line``."""
+        best: Optional[Tuple[int, str]] = None
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                end = getattr(node, "end_lineno", None)
+                if end is not None and node.lineno <= line <= end:
+                    if best is None or node.lineno > best[0]:
+                        best = (node.lineno, node.name)
+        return best[1] if best else None
+
+
+for _spec in FUNNEL_RULES:
+    register(FunnelChecker(_spec))

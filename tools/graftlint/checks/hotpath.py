@@ -1,0 +1,205 @@
+"""Hot-path host-sync rule.
+
+A host sync (``.item()``, ``float()`` on device values, ``np.asarray``,
+``jax.device_get``, ``.block_until_ready()``) inside a hot loop
+serializes device compute against Python and defeats prefetch/pipeline
+overlap; at multi-device scale the cost multiplies with the mesh (GSPMD
+/ MLPerf TPU-pod scaling). Three surfaces, one rule
+(``hot-path-host-sync``):
+
+1. **Streaming chunk loops** (the migrated PR 2 guard): ``For``/``While``
+   bodies inside ``io/streaming.py`` functions. Materialization belongs
+   in a helper defined OUTSIDE the loop (e.g. ``_score``) — one
+   deliberate, testable sync per chunk.
+2. **Watchdog-registered hot loops, repo-wide**: any loop whose body
+   calls ``<heartbeat>.beat()`` has *declared itself* a hot loop (the
+   serving batch loop, the prefetcher, the GBDT round loops). The same
+   sync markers apply. Deliberate per-round materialization (e.g. the
+   round loop downloading each packed tree) carries an inline
+   ``# graftlint: disable=hot-path-host-sync`` with a justification.
+3. **jit-compiled functions**: functions decorated ``@jax.jit`` /
+   ``@pjit`` / ``@partial(jax.jit, ...)`` or referenced by name inside a
+   ``jax.jit(...)`` / ``pjit(...)`` call in the same module. ``float()`` is excluded on
+   this surface (on static values at trace time it is legal and common);
+   ``.item()`` / ``device_get`` / ``block_until_ready`` / ``np.asarray``
+   inside a traced function are either trace-time crashes waiting for a
+   tracer or silent per-call host round-trips.
+
+Nested function/lambda bodies never count against an enclosing loop —
+helpers defined outside and called inside are the sanctioned pattern.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from ..core import (Checker, CheckerRotError, Finding, Module, Repo,
+                    call_name, loop_body_nodes, register)
+
+#: sync markers inside hot LOOP bodies (name, optional qualifier gate)
+_LOOP_MARKERS = {"asarray", "float", "item", "device_get",
+                 "block_until_ready"}
+#: sync markers inside jit-compiled functions (float excluded: legal on
+#: trace-time statics)
+_JIT_MARKERS = {"asarray", "array", "item", "device_get",
+                "block_until_ready"}
+
+
+def _is_sync_call(call: ast.Call, markers: Set[str],
+                  bare_asarray: bool = False) -> Optional[str]:
+    qual, name = call_name(call)
+    if name not in markers:
+        return None
+    if name in ("asarray", "array"):
+        # numpy materialization is a host sync; jnp.asarray stays on
+        # device (the trees-as-arguments rule handles device_put of
+        # model state separately). On the loop surfaces an UNQUALIFIED
+        # asarray also counts (``from numpy import asarray`` — the
+        # coverage the pre-graftlint guard had); inside jit bodies a
+        # bare name is ambiguous with a jnp alias, so only np.* flags.
+        if qual in ("np", "numpy"):
+            return f"{qual}.{name}"
+        if bare_asarray and qual is None and name == "asarray":
+            return name
+        return None
+    if name in ("device_get",):
+        return f"{qual + '.' if qual else ''}{name}"
+    if name == "float":
+        return None if qual else "float"
+    # .item() / .block_until_ready() are methods — any receiver counts
+    return f".{name}()"
+
+
+def _loops(fn: ast.AST) -> Iterator[ast.AST]:
+    """Loops belonging to ``fn`` itself — not ones inside nested defs,
+    which the module walk visits as their own functions (descending
+    here too would scan every nested hot loop twice and double-count
+    the lint-rot anchor)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, (ast.For, ast.While)):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _loop_declares_hot(loop: ast.AST) -> bool:
+    """A loop body calling ``<x>.beat()`` is a watchdog-registered hot
+    loop."""
+    for n in loop_body_nodes(loop):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "beat":
+            return True
+    return False
+
+
+def _jit_function_names(mod: Module) -> Set[str]:
+    """Names of module functions compiled via ``jax.jit(...)`` by
+    reference (``jax.jit(run)``, ``jax.jit(shard_map(step, ...))``)."""
+    names: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        qual, fname = call_name(node)
+        if fname not in ("jit", "pjit") or qual not in ("jax", None):
+            continue
+        for arg in node.args:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+    return names
+
+
+def _tail_name(node: ast.AST) -> Optional[str]:
+    """Last component of a Name/Attribute chain (``jax.jit`` -> "jit")."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _is_jit_decorated(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = _tail_name(target)
+        if name in ("jit", "pjit"):
+            return True
+        if name == "partial" and isinstance(dec, ast.Call) and dec.args \
+                and _tail_name(dec.args[0]) in ("jit", "pjit"):
+            return True
+    return False
+
+
+class HotPathHostSync(Checker):
+    rule = "hot-path-host-sync"
+    description = "no host syncs (.item/float/np.asarray/device_get/" \
+                  "block_until_ready) in streaming chunk loops, " \
+                  "beat()-registered hot loops, or jit-compiled functions"
+
+    def check(self, repo: Repo) -> Iterator[Finding]:
+        streaming = repo.module("mmlspark_tpu/io/streaming.py")
+        if streaming is None:
+            raise CheckerRotError("mmlspark_tpu/io/streaming.py is gone")
+        if not any(isinstance(n, ast.FunctionDef)
+                   and n.name == "stream_apply"
+                   for n in ast.walk(streaming.tree)):
+            raise CheckerRotError(
+                "stream_apply vanished from io/streaming.py")
+
+        seen_hot_loops = 0
+        for mod in repo.package():
+            jit_names = _jit_function_names(mod)
+            in_streaming = mod is streaming
+            for fn in ast.walk(mod.tree):
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                # surface 3: jit-compiled function bodies
+                if _is_jit_decorated(fn) or fn.name in jit_names:
+                    yield from self._scan_jit_fn(mod, fn)
+                # surfaces 1+2: hot loop bodies (nested loops walk
+                # overlapping bodies — dedupe so one sync is one finding)
+                reported: Set[Tuple[int, str]] = set()
+                for loop in _loops(fn):
+                    declares_hot = _loop_declares_hot(loop)
+                    if declares_hot:
+                        seen_hot_loops += 1
+                    if not (in_streaming or declares_hot):
+                        continue
+                    kind = ("streaming chunk loop" if in_streaming
+                            else "watchdog-registered hot loop")
+                    for n in loop_body_nodes(loop):
+                        if not isinstance(n, ast.Call):
+                            continue
+                        sync = _is_sync_call(n, _LOOP_MARKERS,
+                                             bare_asarray=True)
+                        if sync and (n.lineno, sync) not in reported:
+                            reported.add((n.lineno, sync))
+                            yield self.finding(
+                                mod, n.lineno,
+                                f"host sync {sync} inside {kind} in "
+                                f"{fn.name}() — move into a pre-loop "
+                                f"helper (one deliberate sync per chunk)")
+        if seen_hot_loops < 2:
+            raise CheckerRotError(
+                f"only {seen_hot_loops} beat()-registered hot loops found "
+                "(expected >= 2: serving batch loop, prefetcher, GBDT "
+                "round loops) — did watchdog heartbeats move?")
+
+    def _scan_jit_fn(self, mod: Module, fn: ast.AST) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                sync = _is_sync_call(node, _JIT_MARKERS)
+                if sync:
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"host sync {sync} inside jit-compiled "
+                        f"{fn.name}() — hoist out of the traced function")
+
+
+register(HotPathHostSync())

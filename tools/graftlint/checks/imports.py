@@ -1,0 +1,73 @@
+"""Observability import-cycle rule.
+
+Migrated from ``test_observability_has_no_top_level_framework_imports``:
+every package (core, io, train, models, ...) imports
+``mmlspark_tpu.observability`` at module top level, so observability
+itself must never import those packages back at top level — its only
+framework dependencies are deferred into function bodies. That is what
+makes "every layer imports observability" cycle-free *by construction*
+(and keeps the import cheap: no jax, no framework).
+
+``tests/test_lint.py`` keeps the runtime complement: a fresh interpreter
+imports the telemetry layer standalone and asserts jax never loaded.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Tuple
+
+from ..core import Checker, CheckerRotError, Finding, Repo, register
+
+#: sibling modules observability/* may import relatively at top level
+_SIBLINGS = frozenset({"metrics", "spans", "device", "tracing", "flight",
+                       "logging", "watchdog", "federation", "env_registry",
+                       ""})
+
+
+def _top_level_imports(tree: ast.AST) -> List[Tuple[str, int, int]]:
+    """(module, level, lineno) imported at module scope (top-level
+    try/if wrappers around imports still count; function bodies don't)."""
+    out = []
+    for node in ast.iter_child_nodes(tree):
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(n, ast.Import):
+                out.extend((a.name, 0, n.lineno) for a in n.names)
+            elif isinstance(n, ast.ImportFrom):
+                out.append((n.module or "", n.level, n.lineno))
+            else:
+                stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+class ObservabilityImportCycle(Checker):
+    rule = "obs-import-cycle"
+    description = "observability/* imports only stdlib + its own " \
+                  "siblings at top level (cycle-free by construction)"
+
+    def check(self, repo: Repo) -> Iterator[Finding]:
+        mods = repo.under("mmlspark_tpu/observability")
+        if not mods:
+            raise CheckerRotError("mmlspark_tpu/observability/ is gone")
+        for mod in mods:
+            for name, level, lineno in _top_level_imports(mod.tree):
+                top = name.split(".")[0]
+                if level >= 2 or top == "mmlspark_tpu":
+                    yield self.finding(
+                        mod, lineno,
+                        f"top-level framework import "
+                        f"{'.' * level}{name} — defer into the function "
+                        f"body (import-cycle guard)")
+                elif level == 1 and top not in _SIBLINGS:
+                    yield self.finding(
+                        mod, lineno,
+                        f"top-level relative import .{name} is not an "
+                        f"observability sibling")
+
+
+register(ObservabilityImportCycle())

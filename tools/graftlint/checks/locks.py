@@ -1,0 +1,256 @@
+"""Lock-discipline rule.
+
+Two concurrency contracts reviews kept catching by hand, now enforced
+statically (``lock-discipline``):
+
+1. **Shared attributes stay under the lock.** In a class whose
+   ``__init__`` creates ``self._lock``, an instance attribute mutated
+   from two or more non-``__init__`` methods is shared mutable state by
+   construction — every one of those mutation sites must sit inside a
+   ``with self._lock`` block. (``__init__`` itself is single-threaded
+   construction and doesn't count toward the two.)
+2. **Signal handlers take only reentrant locks.** A lock acquired by a
+   function reachable from a ``signal.signal`` handler must be created
+   as ``threading.RLock()``: the handler runs on the main thread between
+   bytecodes — possibly while that same thread already holds the lock —
+   and a plain ``Lock`` deadlocks the exact process the signal was sent
+   to inspect (the flight.py SIGUSR2 rule). Reachability is the
+   transitive intra-module call graph from the handler.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import (Checker, CheckerRotError, Finding, Module, Repo,
+                    call_name, register)
+
+
+def _lock_kind(value: ast.AST) -> Optional[str]:
+    """"Lock"/"RLock" when ``value`` is a ``threading.[R]Lock()`` call."""
+    if isinstance(value, ast.Call):
+        _qual, name = call_name(value)
+        if name in ("Lock", "RLock"):
+            return name
+    return None
+
+
+def _owns_lock(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _lock_kind(node.value):
+            if any(isinstance(t, ast.Attribute) and t.attr == "_lock"
+                   and isinstance(t.value, ast.Name) and t.value.id == "self"
+                   for t in node.targets):
+                return True
+    return False
+
+
+def _flatten_targets(t: ast.AST) -> Iterator[ast.AST]:
+    """Leaf assignment targets under ``t`` — through tuple/list
+    unpacking and starred elements (``self.a, x = ...`` mutates self.a
+    just as much as a bare assign)."""
+    if isinstance(t, (ast.Tuple, ast.List)):
+        for el in t.elts:
+            yield from _flatten_targets(el)
+    elif isinstance(t, ast.Starred):
+        yield from _flatten_targets(t.value)
+    else:
+        yield t
+
+
+def _self_attr_mutations(method: ast.FunctionDef) -> List[Tuple[str, int,
+                                                                ast.AST]]:
+    """(attr, lineno, node) for every ``self.X = ...`` / ``self.X op= ...``
+    in the method (nested defs included: they run on the same instance)."""
+    out = []
+    for node in ast.walk(method):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = [leaf for t in node.targets
+                       for leaf in _flatten_targets(t)]
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                out.append((t.attr, node.lineno, node))
+    return out
+
+
+def _under_self_lock(method: ast.FunctionDef, node: ast.AST) -> bool:
+    """True when ``node`` sits inside a ``with self._lock`` (possibly
+    among other items) within ``method``."""
+    for w in ast.walk(method):
+        if not isinstance(w, (ast.With, ast.AsyncWith)):
+            continue
+        holds_lock = any(
+            isinstance(item.context_expr, ast.Attribute)
+            and item.context_expr.attr == "_lock"
+            and isinstance(item.context_expr.value, ast.Name)
+            and item.context_expr.value.id == "self"
+            for item in w.items)
+        if holds_lock and any(sub is node for sub in ast.walk(w)):
+            return True
+    return False
+
+
+def _module_locks(mod: Module) -> Dict[str, Tuple[str, int]]:
+    """Module-level ``NAME = threading.[R]Lock()`` -> (kind, lineno)."""
+    locks: Dict[str, Tuple[str, int]] = {}
+    for node in ast.iter_child_nodes(mod.tree):
+        if isinstance(node, ast.Assign):
+            kind = _lock_kind(node.value)
+            if kind:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        locks[t.id] = (kind, node.lineno)
+    return locks
+
+
+def _signal_handlers(mod: Module) -> Set[str]:
+    """Names of module functions registered via ``signal.signal(...)``."""
+    handlers: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and len(node.args) >= 2:
+            qual, name = call_name(node)
+            # only the stdlib registration API: signal.signal(sig, h) —
+            # including underscore aliases (flight.py's ``import signal
+            # as _signal``). An unqualified or differently-qualified
+            # .signal(...) (an event emitter, a scheduler) must not mark
+            # its callback as signal-reachable.
+            if name == "signal" and qual is not None \
+                    and qual.split(".")[-1].lstrip("_") == "signal":
+                h = node.args[1]
+                if isinstance(h, ast.Name):
+                    handlers.add(h.id)
+                elif isinstance(h, ast.Attribute):
+                    handlers.add(h.attr)
+    return handlers
+
+
+def _call_graph(mod: Module) -> Dict[str, Set[str]]:
+    """function name -> names it calls (module-local approximation)."""
+    graph: Dict[str, Set[str]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            callees: Set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    _qual, name = call_name(sub)
+                    if name:
+                        callees.add(name)
+            graph.setdefault(node.name, set()).update(callees)
+    return graph
+
+
+def _lock_acquisitions(fn: ast.AST, locks: Dict[str, Tuple[str, int]]
+                       ) -> Iterator[Tuple[str, int]]:
+    """(lock name, lineno) for ``with NAME`` / ``NAME.acquire()`` in fn."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Name) and ce.id in locks:
+                    yield ce.id, node.lineno
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "acquire"
+              and isinstance(node.func.value, ast.Name)
+              and node.func.value.id in locks):
+            yield node.func.value.id, node.lineno
+
+
+class LockDiscipline(Checker):
+    rule = "lock-discipline"
+    description = "attrs mutated from >=2 methods of a _lock-owning " \
+                  "class stay under the lock; locks reachable from " \
+                  "signal handlers are RLock"
+
+    def check(self, repo: Repo) -> Iterator[Finding]:
+        saw_lock_class = False
+        saw_handler = False
+        for mod in repo.package():
+            yield from self._check_classes(mod)
+            saw_lock_class |= any(
+                isinstance(n, ast.ClassDef) and _owns_lock(n)
+                for n in ast.walk(mod.tree))
+            found_handler, findings = self._check_signal_locks(mod)
+            saw_handler |= found_handler
+            yield from findings
+        if not saw_lock_class:
+            raise CheckerRotError(
+                "no _lock-owning classes found in the package — rule "
+                "matches nothing")
+        if not saw_handler:
+            raise CheckerRotError(
+                "no signal.signal handler registration found (flight.py "
+                "SIGUSR2 wiring moved?)")
+
+    def _check_classes(self, mod: Module) -> Iterator[Finding]:
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef) or not _owns_lock(cls):
+                continue
+            methods = [n for n in cls.body
+                       if isinstance(n, ast.FunctionDef)]
+            per_attr: Dict[str, List[Tuple[str, int, ast.AST,
+                                           ast.FunctionDef]]] = {}
+            for m in methods:
+                if m.name == "__init__":
+                    continue
+                for attr, ln, node in _self_attr_mutations(m):
+                    if attr == "_lock":
+                        continue
+                    per_attr.setdefault(attr, []).append((m.name, ln,
+                                                          node, m))
+            for attr, sites in per_attr.items():
+                if len({mname for mname, *_ in sites}) < 2:
+                    continue
+                for mname, ln, node, m in sites:
+                    if not _under_self_lock(m, node):
+                        yield self.finding(
+                            mod, ln,
+                            f"{cls.name}.{attr} is mutated from "
+                            f"{len({s[0] for s in sites})} methods but "
+                            f"this write in {mname}() is outside "
+                            "'with self._lock'")
+
+    def _check_signal_locks(self, mod: Module
+                            ) -> Tuple[bool, List[Finding]]:
+        handlers = _signal_handlers(mod)
+        if not handlers:
+            return False, []
+        locks = _module_locks(mod)
+        if not locks:
+            return True, []
+        graph = _call_graph(mod)
+        out: List[Finding] = []
+        fns = {n.name: n for n in ast.walk(mod.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        for handler in handlers:
+            reachable: Set[str] = set()
+            frontier = [handler]
+            while frontier:
+                cur = frontier.pop()
+                if cur in reachable or cur not in graph:
+                    continue
+                reachable.add(cur)
+                frontier.extend(graph[cur] & set(fns))
+            for fname in sorted(reachable):
+                fn = fns.get(fname)
+                if fn is None:
+                    continue
+                for lock_name, ln in _lock_acquisitions(fn, locks):
+                    kind, decl_ln = locks[lock_name]
+                    if kind != "RLock":
+                        out.append(self.finding(
+                            mod, ln,
+                            f"{lock_name} (a threading.Lock, line "
+                            f"{decl_ln}) is acquired in {fname}(), "
+                            f"reachable from signal handler {handler}()"
+                            " — must be RLock or the handler deadlocks "
+                            "the thread it interrupts"))
+        return True, out
+
+
+register(LockDiscipline())

@@ -1,0 +1,82 @@
+"""Metric-literal rules: Prometheus-safe names, one kind per name.
+
+Migrated from ``test_metric_name_literals_are_prometheus_safe`` and
+``test_metric_names_unique_per_kind``: every string literal passed as
+the metric name to a ``counter``/``gauge``/``histogram`` (or ``safe_*``)
+factory must match ``[a-z_]+`` — anything else stops the text exposition
+parser — and one name must map to one kind across the whole tree (the
+registry raises at runtime on a kind conflict; catch it at lint time).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Tuple
+
+from ..core import Checker, CheckerRotError, Finding, Module, Repo, register
+
+_NAME_RE = re.compile(r"^[a-z_]+$")
+_FACTORIES = {"counter", "gauge", "histogram",
+              "safe_counter", "safe_gauge", "safe_histogram"}
+#: fewer literal metric names than this means the scan is matching
+#: nothing — the instrumentation this rule protects has moved
+_MIN_EXPECTED = 10
+
+
+def _literal_metric_calls(repo: Repo) -> List[Tuple[Module, int, str, str]]:
+    """(module, line, kind, name) for every literal-name factory call."""
+    found = []
+    for mod in repo.package():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            kind = fn.attr if isinstance(fn, ast.Attribute) else \
+                fn.id if isinstance(fn, ast.Name) else None
+            if kind not in _FACTORIES or not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str):
+                found.append((mod, node.lineno,
+                              kind.replace("safe_", ""), first.value))
+    return found
+
+
+class MetricNameFormat(Checker):
+    rule = "metric-name-format"
+    description = "literal metric names must match [a-z_]+ (Prometheus " \
+                  "text exposition)"
+
+    def check(self, repo: Repo) -> Iterator[Finding]:
+        calls = _literal_metric_calls(repo)
+        if len(calls) < _MIN_EXPECTED:
+            raise CheckerRotError(
+                f"only {len(calls)} literal metric names found "
+                f"(expected >= {_MIN_EXPECTED}) — factory call sites moved?")
+        for mod, line, _kind, name in calls:
+            if not _NAME_RE.match(name):
+                yield self.finding(
+                    mod, line,
+                    f"metric name {name!r} must match [a-z_]+")
+
+
+class MetricKindUnique(Checker):
+    rule = "metric-kind-unique"
+    description = "one metric name maps to one kind " \
+                  "(counter/gauge/histogram) across the tree"
+
+    def check(self, repo: Repo) -> Iterator[Finding]:
+        first_kind: dict = {}
+        for mod, line, kind, name in _literal_metric_calls(repo):
+            prev = first_kind.setdefault(name, (kind, mod.rel, line))
+            if prev[0] != kind:
+                yield self.finding(
+                    mod, line,
+                    f"metric {name!r} registered as {kind} here but as "
+                    f"{prev[0]} at {prev[1]}:{prev[2]}")
+
+
+register(MetricNameFormat())
+register(MetricKindUnique())

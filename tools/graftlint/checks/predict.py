@@ -1,0 +1,66 @@
+"""Device-resident predictor rule: trees ride as jit ARGUMENTS.
+
+Migrated from ``test_booster_predict_path_takes_trees_as_arguments``:
+``jnp.asarray(self.trees...)`` (or a ``device_put`` of them) anywhere in
+the predictor build path of ``models/gbdt/booster.py`` would bake the
+forest into the executable as a constant, making the compiled program
+per-Booster and bringing back the recompile-after-unpickle serving stall
+PR 2 removed. Host-side numpy staging (``np.asarray``) stays legal —
+only *device placement* of the raw tree arrays is baking.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (Checker, CheckerRotError, Finding, Repo, call_name,
+                    register)
+
+_BOOSTER = "mmlspark_tpu/models/gbdt/booster.py"
+_PREDICT_PATH = frozenset({
+    "predict", "predict_raw", "_predict_device", "_device_forest_args",
+    "_device_active", "_build_predict_program", "_predict_program"})
+_MIN_FNS = 4
+
+
+class TreesAsArguments(Checker):
+    rule = "trees-as-arguments"
+    description = "the predictor build path passes trees as packed jit " \
+                  "arguments, never bakes them via jnp.asarray/device_put"
+
+    def check(self, repo: Repo) -> Iterator[Finding]:
+        mod = repo.module(_BOOSTER)
+        if mod is None:
+            raise CheckerRotError(f"{_BOOSTER} is gone")
+        fns = [n for n in ast.walk(mod.tree)
+               if isinstance(n, ast.FunctionDef)
+               and n.name in _PREDICT_PATH]
+        if len(fns) < _MIN_FNS:
+            raise CheckerRotError(
+                f"only {sorted(f.name for f in fns)} of the predictor "
+                f"build path found (expected >= {_MIN_FNS} functions) — "
+                "path renamed?")
+        for fn in fns:
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                qual, name = call_name(call)
+                if name not in ("asarray", "array", "device_put"):
+                    continue
+                if qual in ("np", "numpy"):
+                    continue        # host-side staging is the legal form
+                for arg in call.args:
+                    if any(isinstance(sub, ast.Attribute)
+                           and sub.attr == "trees"
+                           for sub in ast.walk(arg)):
+                        yield self.finding(
+                            mod, call.lineno,
+                            f"{(qual + '.') if qual else ''}{name} of "
+                            f".trees in {fn.name}() bakes the forest "
+                            "into the executable — pass packed trees as "
+                            "jit arguments")
+                        break
+
+
+register(TreesAsArguments())

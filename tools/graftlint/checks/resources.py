@@ -1,0 +1,146 @@
+"""Resource-leak rule: heartbeats and spans reach their close on all paths.
+
+The PR 5 ghost-heartbeat bug, generalized: a ``watchdog.register(...)``
+whose ``close()`` can be skipped by an exception leaves a heartbeat that
+false-stalls minutes later (with stack dumps pointing at innocent code);
+a span that never exits corrupts the nesting trace. Both are context
+managers — the rule (``resource-leak``) requires every acquisition to be
+
+* the context expression of a ``with`` statement, or
+* assigned to a name that is ``close()``\\ d inside a ``finally`` block
+  of the same function (the conditional-registration form the GBDT round
+  loops use: ``hb = register(...) if live else NOOP; try: ... finally:
+  hb.close()``).
+
+A call whose result is discarded is always a leak.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from ..core import (Checker, CheckerRotError, Finding, Module, Repo,
+                    register)
+
+_MIN_REGISTER_SITES = 3
+_MIN_SPAN_SITES = 5
+
+
+def _is_watchdog_register(call: ast.Call) -> bool:
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "register"
+            and isinstance(call.func.value, ast.Name)
+            and "watchdog" in call.func.value.id.lower())
+
+
+def _is_span_call(call: ast.Call) -> bool:
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "span"
+            and isinstance(call.func.value, ast.Name)
+            and "span" in call.func.value.id.lower())
+
+
+def _with_context_calls(fn: ast.AST) -> Set[ast.Call]:
+    """Every Call that appears as (part of) a ``with`` item's context
+    expression — including the conditional ``A if c else B`` form."""
+    out: Set[ast.Call] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    if isinstance(sub, ast.Call):
+                        out.add(sub)
+    return out
+
+
+def _finally_closed_names(fn: ast.AST) -> Set[str]:
+    """Names ``close()``d inside any ``finally`` block of ``fn``."""
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Try) and node.finalbody:
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "close"
+                            and isinstance(sub.func.value, ast.Name)):
+                        names.add(sub.func.value.id)
+    return names
+
+
+def _assigned_name(fn: ast.AST, call: ast.Call) -> Optional[str]:
+    """The simple Name the call's value lands in, when the statement is
+    ``name = <expr containing call>`` (covers the conditional form)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        if any(sub is call for sub in ast.walk(node.value)):
+            if len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                return node.targets[0].id
+            return None
+    return None
+
+
+class ResourceLeak(Checker):
+    rule = "resource-leak"
+    description = "watchdog.register / span acquisitions must reach " \
+                  "close() on all paths (with-statement or try/finally)"
+
+    def check(self, repo: Repo) -> Iterator[Finding]:
+        register_sites = span_sites = 0
+        for mod in repo.package():
+            owner = mod.owner_map()
+            fns = {n for n in ast.walk(mod.tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+            for fn in fns:
+                with_calls = None       # lazy per function
+                closed = None
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    # only calls whose innermost owner is THIS function
+                    # (nested defs are scanned as their own fn)
+                    if owner.get(node) != fn.name:
+                        continue
+                    is_reg = _is_watchdog_register(node)
+                    is_span = not is_reg and _is_span_call(node)
+                    if not (is_reg or is_span):
+                        continue
+                    if is_reg:
+                        register_sites += 1
+                    else:
+                        span_sites += 1
+                    if with_calls is None:
+                        with_calls = _with_context_calls(fn)
+                        closed = _finally_closed_names(fn)
+                    if node in with_calls:
+                        continue
+                    what = ("watchdog.register" if is_reg
+                            else "span acquisition")
+                    name = _assigned_name(fn, node)
+                    if name is None:
+                        yield self.finding(
+                            mod, node.lineno,
+                            f"{what} in {fn.name}() is neither a with-"
+                            "context nor assigned for a finally-close — "
+                            "an exception leaks it")
+                    elif name not in closed:
+                        yield self.finding(
+                            mod, node.lineno,
+                            f"{what} assigned to {name!r} in {fn.name}() "
+                            f"has no {name}.close() in a finally block — "
+                            "an exception mid-loop leaks a ghost "
+                            "heartbeat/span")
+        if register_sites < _MIN_REGISTER_SITES:
+            raise CheckerRotError(
+                f"only {register_sites} watchdog.register sites found "
+                f"(expected >= {_MIN_REGISTER_SITES}) — wiring moved?")
+        if span_sites < _MIN_SPAN_SITES:
+            raise CheckerRotError(
+                f"only {span_sites} span acquisition sites found "
+                f"(expected >= {_MIN_SPAN_SITES}) — wiring moved?")
+
+
+register(ResourceLeak())
