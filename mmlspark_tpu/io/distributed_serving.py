@@ -46,6 +46,8 @@ from ..observability import spans as _spans
 from ..observability import tracing as _tracing
 from ..observability.federation import MetricsFederator
 from ..observability.logging import get_logger
+from ..robustness import failpoints as _failpoints
+from ..robustness import policy as _policy
 from .serving import (ServingQuery, ServingServer, debug_route,
                       write_debug_response, write_http_response)
 
@@ -125,24 +127,59 @@ class ServiceRegistry:
 # ---------------------------------------------------------------------------
 
 
+#: worker statuses the gateway treats as "this worker can't take the
+#: request right now" — retried on ANOTHER worker, budget permitting
+#: (429 = admission shed; 502 = worker's own backend hop died;
+#: 503 = draining / no capacity). 429 does NOT strike the breaker:
+#: an overloaded worker is healthy, and opening its breaker would
+#: remove capacity exactly when the cluster is short of it.
+GATEWAY_RETRY_STATUS = (429, 502, 503)
+
+
 class GatewayServer:
     """Public HTTP front that load-balances over registered workers.
 
     Routing: least-inflight worker (round-robin among ties) — the
-    MultiChannelMap.nextList distribution of the reference. Failover: a
-    connection-level failure marks the worker dead (until the next health
-    sweep readmits it) and the request is retried once on another worker —
-    requeue-once, matching the single-host crash-recovery rule.
+    MultiChannelMap.nextList distribution of the reference — skipping
+    workers whose circuit breaker is open. Failover: connection-level
+    failures open the worker's breaker immediately (the worker is gone);
+    retryable statuses (502/503; a 429 shed retries without a breaker
+    strike — overload is not sickness) accumulate toward its error-rate /
+    consecutive-failure thresholds. Either way the request is retried on
+    another worker, bounded by ``max_failovers`` AND a token-bucket
+    retry budget so a fleet-wide outage sheds load instead of
+    amplifying it. Half-open breaker probes ride the health loop, and
+    ``X-Deadline-Ms`` budgets are honored and attenuated on the worker
+    hop.
     """
 
     def __init__(self, registry: ServiceRegistry, host: str = "localhost",
                  port: int = 0, api_name: str = "serving",
-                 health_interval: float = 2.0, request_timeout: float = 30.0):
+                 health_interval: Optional[float] = None,
+                 request_timeout: float = 30.0,
+                 max_failovers: Optional[int] = None,
+                 breaker_config: Optional[_policy.BreakerConfig] = None,
+                 retry_budget: Optional[_policy.RetryBudget] = None):
         self.registry = registry
         self.api_name = api_name
         self.request_timeout = request_timeout
-        self.health_interval = health_interval
-        self._dead: Dict[str, float] = {}
+        self.health_interval = (
+            health_interval if health_interval is not None
+            else _policy.env_float(
+                "MMLSPARK_TPU_GATEWAY_HEALTH_INTERVAL_SECONDS", 2.0))
+        self.max_failovers = (
+            max_failovers if max_failovers is not None
+            else _policy.env_int("MMLSPARK_TPU_GATEWAY_MAX_FAILOVERS", 3))
+        # breakers key on host:port (bounded slot set — worker ids churn
+        # per restart); open cooldown defaults to the health interval so
+        # recovery probes start at the next sweep, matching the old
+        # dead-list readmission cadence
+        self.breakers = _policy.BreakerBoard(
+            breaker_config or _policy.BreakerConfig(
+                default_open_seconds=self.health_interval))
+        self.retry_budget = retry_budget or _policy.RetryBudget(
+            api=api_name)
+        self._latency = _policy.Ewma()
         self._inflight: Dict[str, int] = {}
         self._rr = 0
         self._lock = threading.Lock()
@@ -180,7 +217,7 @@ class GatewayServer:
                                      api=outer.api_name, method=method,
                                      path=self.path):
                         status, payload, hdrs = outer._route(
-                            method, self.path, body)
+                            method, self.path, body, self.headers)
                 except Exception as e:  # noqa: BLE001
                     # e.g. a corrupted file-backed registry blowing up the
                     # worker scan: answer 500 instead of dropping the
@@ -223,6 +260,9 @@ class GatewayServer:
         # expose the merged view on this gateway's /metrics + /debug/cluster
         # (inert per-tick while telemetry is disabled)
         self.federation = MetricsFederator(self._federation_targets)
+        # /debug/cluster shows which workers the routing plane is
+        # currently refusing, next to their scrape health
+        self.federation.breaker_states = self.breakers.states
         self._threads = [
             threading.Thread(target=self._httpd.serve_forever, daemon=True),
             threading.Thread(target=self._health_loop, daemon=True),
@@ -251,14 +291,16 @@ class GatewayServer:
         self._httpd.server_close()
 
     # -- routing -------------------------------------------------------------
+    @staticmethod
+    def _addr(w: WorkerInfo) -> str:
+        return f"{w.host}:{w.port}"
+
     def _live_workers(self) -> List[WorkerInfo]:
-        # registry scan (filesystem I/O for file-backed registries) stays
-        # OUTSIDE the routing lock; only the dead-map lookup needs it
-        workers = self.registry.workers()
-        now = time.monotonic()
-        with self._lock:
-            live = [w for w in workers
-                    if self._dead.get(w.worker_id, 0) < now]
+        # registry scan (filesystem I/O for file-backed registries) and
+        # the breaker lookups both run lock-free; only _pick's
+        # inflight/round-robin state needs the routing lock
+        live = [w for w in self.registry.workers()
+                if self.breakers.allow(self._addr(w))]
         _metrics.safe_gauge("gateway_live_workers", api=self.api_name).set(
                  len(live))
         return live
@@ -269,36 +311,148 @@ class GatewayServer:
         if not workers:
             return None
         with self._lock:
-            load = [(self._inflight.get(w.worker_id, 0), i)
+            load = [(self._inflight.get(self._addr(w), 0), i)
                     for i, w in enumerate(workers)]
             min_load = min(load)[0]
             candidates = [i for l, i in load if l == min_load]
             self._rr += 1
             return workers[candidates[self._rr % len(candidates)]]
 
-    def _route(self, method, path, body):
-        tried: set = set()
-        for _ in range(2):                        # original + one failover
-            w = self._pick(exclude=tried)
+    def _retry_after(self, base: Optional[Dict[str, str]] = None,
+                     est: Optional[float] = None) -> Dict[str, str]:
+        """Headers for a gateway-generated (or exhausted-failover) error
+        response: Retry-After derived from observed worker latency — a
+        hint real enough that well-behaved clients back off instead of
+        hammering. A worker-supplied Retry-After in ``base`` wins."""
+        hdrs = dict(base or {"Content-Type": "application/json"})
+        if "Retry-After" not in hdrs:
+            if est is None:
+                lat = self._latency.value
+                est = 2 * lat if lat else self.health_interval
+            hdrs["Retry-After"] = str(_policy.retry_after_seconds(est))
+        return hdrs
+
+    def _spend_failover(self, attempts: int) -> bool:
+        """One more failover attempt? Bounded by max_failovers AND the
+        retry budget — under a fleet-wide outage the budget converges
+        retry load to a fraction of live traffic."""
+        if attempts >= self.max_failovers:
+            return False
+        return self.retry_budget.try_spend()
+
+    def _route(self, method, path, body, req_headers=None):
+        # every admitted request accrues retry budget; retries spend it
+        self.retry_budget.deposit()
+        deadline = _policy.Deadline.from_headers(req_headers)
+        # hard failures (worker GONE) exclude the worker outright; soft
+        # ones (it answered 429/502/503) only deprioritize it — with every
+        # worker soft-failed, re-trying one beats failing the request,
+        # and the budget + max_failovers still bound the loop
+        hard_tried: set = set()
+        soft_tried: set = set()
+        attempts = 0
+        last: Optional[tuple] = None           # last retryable worker reply
+        while True:
+            if deadline is not None and deadline.expired:
+                _metrics.safe_counter("gateway_deadline_expired_total",
+                                      api=self.api_name).inc()
+                _flight.record("deadline_expired", api=self.api_name,
+                               attempts=attempts)
+                return 504, b'{"error": "deadline exceeded"}', \
+                    self._retry_after()
+            w = self._pick(exclude=hard_tried | soft_tried)
+            if w is None and soft_tried:
+                if last is not None and last[0] == 429:
+                    # every live worker is shedding: relay the pacing
+                    # hint instead of instantly re-hitting a fleet that
+                    # just said "overloaded" — zero-delay re-sends are
+                    # the amplification the retry budget exists to stop
+                    return last[0], last[1], self._retry_after(last[2])
+                soft_tried.clear()
+                w = self._pick(exclude=hard_tried)
             if w is None:
-                return 503, b'{"error": "no live workers"}', {
-                    "Content-Type": "application/json"}
-            tried.add(w.worker_id)
+                if last is not None:
+                    # no one else to try: relay the worker's own answer
+                    # (already carries its Retry-After when it sent one)
+                    return last[0], last[1], self._retry_after(last[2])
+                return 503, b'{"error": "no live workers"}', \
+                    self._retry_after(est=self.health_interval)
+            addr = self._addr(w)
+            # when the client's remaining budget (not our own timeout) is
+            # what bounds this attempt, a timeout or 504 says "impatient
+            # client", not "sick worker" — it must not strike the breaker
+            budget_bound = (deadline is not None and
+                            deadline.remaining_seconds()
+                            < self.request_timeout)
             with self._lock:
-                self._inflight[w.worker_id] = \
-                    self._inflight.get(w.worker_id, 0) + 1
+                # keyed by address like the breakers: worker ids churn
+                # per restart and would grow this dict without bound
+                self._inflight[addr] = self._inflight.get(addr, 0) + 1
             try:
-                conn = http.client.HTTPConnection(
-                    w.host, w.port, timeout=self.request_timeout)
-                # outbound hop: the active trace context rides the wire,
-                # so worker-side spans stitch to this gateway's
-                conn.request(method, f"/{w.api_name}", body=body,
-                             headers=_tracing.outbound_headers())
-                resp = conn.getresponse()
-                payload = resp.read()
-                headers = {"Content-Type":
-                           resp.getheader("Content-Type", "text/plain")}
-                conn.close()
+                # fault site: the worker hop — a synthetic retryable
+                # status stands in for "the picked worker answered
+                # sick", exercising failover without touching the wire
+                act = _failpoints.fault_point("gateway.route", worker=addr)
+                if act is not None and act.status is not None:
+                    status, payload = act.status, b'{"error": "injected"}'
+                    headers = {"Content-Type": "application/json"}
+                else:
+                    timeout = self.request_timeout
+                    if deadline is not None:
+                        timeout = max(0.05, min(
+                            timeout, deadline.remaining_seconds()))
+                    conn = http.client.HTTPConnection(
+                        w.host, w.port, timeout=timeout)
+                    # outbound hop: the active trace context rides the
+                    # wire (worker spans stitch to this gateway's), and
+                    # the deadline budget is attenuated for the hop
+                    out_headers = _tracing.outbound_headers()
+                    if deadline is not None:
+                        out_headers[_policy.DEADLINE_HEADER] = \
+                            deadline.header_value()
+                    t0 = time.perf_counter()
+                    conn.request(method, f"/{w.api_name}", body=body,
+                                 headers=out_headers)
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                    headers = {"Content-Type":
+                               resp.getheader("Content-Type", "text/plain")}
+                    # shed/drain hints must reach the client
+                    ra = resp.getheader("Retry-After")
+                    if ra:
+                        headers["Retry-After"] = ra
+                    status = resp.status
+                    conn.close()
+                    self._latency.update(time.perf_counter() - t0)
+                if status in GATEWAY_RETRY_STATUS:
+                    # worker answered but can't serve: soft breaker
+                    # strike (except shed — overload is not sickness),
+                    # then budgeted retry on another worker
+                    soft_tried.add(w.worker_id)
+                    if status != 429:
+                        self.breakers.breaker(addr).record_failure()
+                    _metrics.safe_counter("gateway_retries_total",
+                                          api=self.api_name,
+                                          reason=f"status_{status}").inc()
+                    last = (status, payload, headers)
+                    if not self._spend_failover(attempts):
+                        return status, payload, self._retry_after(headers)
+                    attempts += 1
+                    self.failovers += 1
+                    continue
+                if status == 504:
+                    # the worker accepted but never answered — a dead
+                    # batch thread is not "healthy", so repeated 504s
+                    # must accumulate toward its breaker. Exempt under a
+                    # client-clamped budget, and never retried either
+                    # way: the client's budget is what ran out
+                    if not budget_bound:
+                        self.breakers.breaker(addr).record_failure()
+                    _metrics.safe_counter("gateway_retries_total",
+                                          api=self.api_name,
+                                          reason="status_504").inc()
+                    return status, payload, self._retry_after(headers)
+                self.breakers.breaker(addr).record_success()
                 self.forwarded += 1
                 # labeled by address, not worker_id: ids are minted per
                 # worker start, so churn under failover would grow the
@@ -306,16 +460,33 @@ class GatewayServer:
                 # replacement; the host:port slot set is bounded
                 _metrics.safe_counter("gateway_forwarded_total",
                                       api=self.api_name,
-                                      worker=f"{w.host}:{w.port}").inc()
-                return resp.status, payload, headers
+                                      worker=addr).inc()
+                return status, payload, headers
             except (OSError, http.client.HTTPException) as e:
+                timed_out = isinstance(e, TimeoutError)
+                if timed_out and budget_bound:
+                    # the CLIENT's clamped budget expired mid-hop, not
+                    # our request_timeout: answering 504 without a
+                    # breaker strike keeps impatient clients from
+                    # evicting healthy workers
+                    _metrics.safe_counter("gateway_retries_total",
+                                          api=self.api_name,
+                                          reason="client_budget").inc()
+                    return 504, b'{"error": "deadline exceeded"}', \
+                        self._retry_after()
                 # connection-level failure OR a worker dying mid-response
-                # (BadStatusLine/IncompleteRead): mark dead until a health
-                # sweep readmits it, retry on another worker
-                with self._lock:
-                    self._dead[w.worker_id] = (time.monotonic()
-                                               + 10 * self.health_interval)
-                self.failovers += 1
+                # (BadStatusLine/IncompleteRead): the worker is GONE —
+                # open its breaker now, retry on another worker; the
+                # health loop's half-open probes readmit it on recovery.
+                # A read TIMEOUT is the one exception: the worker
+                # accepted the connection and is merely slow — the same
+                # condition the 504 branch above insists must only
+                # ACCUMULATE toward the breaker, so a one-strike open
+                # here would evict a busy-but-healthy worker exactly
+                # when the cluster is short of capacity
+                hard_tried.add(w.worker_id)
+                self.breakers.breaker(addr).record_failure(
+                    hard=not timed_out)
                 _metrics.safe_counter("gateway_failovers_total",
                                       api=self.api_name).inc()
                 # labeled by failure class (a bounded set), so silent
@@ -323,47 +494,76 @@ class GatewayServer:
                 _metrics.safe_counter("gateway_retries_total",
                                       api=self.api_name,
                                       reason=type(e).__name__).inc()
-                logger.warning("failover: worker %s (%s:%s) failed: %s",
-                               w.worker_id, w.host, w.port, e,
+                logger.warning("failover: worker %s (%s) failed: %s",
+                               w.worker_id, addr, e,
                                api=self.api_name,
                                reason=type(e).__name__)
                 self.federation.last_failover = {
                     "ts": time.time(), "worker": w.worker_id,
-                    "addr": f"{w.host}:{w.port}",
+                    "addr": addr,
                     "reason": f"{type(e).__name__}: {e}"}
                 _flight.record("gateway_failover",
                                api=self.api_name, worker=w.worker_id,
-                               addr=f"{w.host}:{w.port}",
+                               addr=addr,
                                reason=f"{type(e).__name__}: {e}")
+                if not self._spend_failover(attempts):
+                    # exhaustion precedence: an expired client budget
+                    # reads as 504, not a fleet-wide 502
+                    if deadline is not None and deadline.expired:
+                        return 504, b'{"error": "deadline exceeded"}', \
+                            self._retry_after()
+                    return 502, b'{"error": "all workers failed"}', \
+                        self._retry_after()
+                attempts += 1
+                self.failovers += 1
             finally:
                 with self._lock:
-                    self._inflight[w.worker_id] = max(
-                        0, self._inflight.get(w.worker_id, 1) - 1)
-        return 502, b'{"error": "all workers failed"}', {
-            "Content-Type": "application/json"}
+                    self._inflight[addr] = max(
+                        0, self._inflight.get(addr, 1) - 1)
 
+    # -- health / breaker recovery -------------------------------------------
     def _health_loop(self):
         while not self._stop.wait(self.health_interval):
-            now = time.monotonic()
-            with self._lock:
-                # probe EVERY still-blacklisted worker: a recovered worker
-                # readmits at the next sweep, not after the TTL lapses
-                dead = [wid for wid, until in self._dead.items()
-                        if until >= now]
-            for w in self.registry.workers():
-                if w.worker_id not in dead:
-                    continue
-                try:  # probe: TCP connect is enough to readmit
-                    conn = http.client.HTTPConnection(w.host, w.port,
-                                                      timeout=1.0)
-                    conn.connect()
-                    conn.close()
-                    with self._lock:
-                        self._dead.pop(w.worker_id, None)
-                except OSError:
-                    with self._lock:
-                        self._dead[w.worker_id] = (now
-                                                   + 10 * self.health_interval)
+            try:
+                self._probe_half_open()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                _flight.record("health_loop_error", api=self.api_name,
+                               error=f"{type(e).__name__}: {e}")
+
+    def _probe_half_open(self):
+        """Half-open probes piggyback on the health sweep: an open
+        breaker past its cooldown goes half-open and gets ONE probe per
+        sweep — live traffic never probes a sick worker itself."""
+        addrs = {self._addr(w): w for w in self.registry.workers()}
+        for addr, br in self.breakers.items():
+            if addr not in addrs:
+                # worker left the registry: prune its breaker — under
+                # ephemeral-port churn a board keyed by dead addresses
+                # would grow (and re-open against) slots nobody routes to
+                self.breakers.forget(addr)
+                continue
+            if br.state == _policy.OPEN and br.probe_due():
+                br.begin_probe()
+            if br.state != _policy.HALF_OPEN:
+                continue
+            if self._probe_worker(addrs[addr], addr):
+                br.probe_success()
+            else:
+                br.probe_failure()
+
+    def _probe_worker(self, w: WorkerInfo, addr: str) -> bool:
+        # fault site: a failing probe keeps the breaker open — chaos can
+        # hold a recovered worker out of rotation deterministically
+        act = _failpoints.fault_point("gateway.probe", worker=addr)
+        if act is not None and act.status is not None:
+            return False
+        try:  # probe: TCP connect is enough to readmit
+            conn = http.client.HTTPConnection(w.host, w.port, timeout=1.0)
+            conn.connect()
+            conn.close()
+            return True
+        except OSError:
+            return False
 
 
 # ---------------------------------------------------------------------------

@@ -35,7 +35,10 @@ from ..core.dataset import Dataset
 from ..core.params import (HasErrorCol, HasInputCol, HasOutputCol, Param,
                            TypeConverters)
 from ..core.pipeline import PipelineModel, Transformer
+from ..observability import metrics as _metrics
 from ..observability import tracing as _tracing
+from ..robustness import failpoints as _failpoints
+from ..robustness import policy as _policy
 
 # ---------------------------------------------------------------------------
 # Schema (reference: io/http/HTTPSchema.scala:26-166)
@@ -121,6 +124,12 @@ def send_request(request: HTTPRequestData, timeout: float = 60.0) -> HTTPRespons
     """One blocking HTTP exchange. Never raises for HTTP-level errors; network
     errors surface as status 0 (the reference encodes failures as null rows —
     we keep the row and signal via statusCode/reason)."""
+    # fault site: synthetic exchange failure (error_0 = connection-level,
+    # matching the status-0 encoding below) or added latency
+    act = _failpoints.fault_point("http.send", url=request.url)
+    if act is not None and act.status is not None:
+        return HTTPResponseData(status_code=act.status,
+                                reason="injected fault")
     req = urllib.request.Request(
         request.url, data=request.entity, method=request.method.upper())
     for k, v in (request.headers or {}).items():
@@ -153,23 +162,27 @@ def advanced_handling(request: HTTPRequestData,
                       timeout: float = 60.0) -> HTTPResponseData:
     """Retry/backoff handler (reference: io/http/HandlingUtils.advancedUDF —
     retries 429/5xx/connection failures on a millisecond backoff schedule,
-    honouring Retry-After when present)."""
+    honouring Retry-After when present).
+
+    The schedule stays the API, but each step sleeps ``uniform(0, step)``
+    through :func:`robustness.policy.backoff` — a fixed unjittered
+    schedule makes synchronized clients retry in lockstep, re-spiking the
+    service at exactly the cadence it is trying to shed. A parseable
+    ``Retry-After`` overrides the schedule (capped at 30 s); retries are
+    counted in ``http_retries_total{reason}``.
+    """
     resp = send_request(request, timeout)
     if backoffs is None:
         backoffs = (100, 500, 1000)      # callers may pass an unset param
-    for backoff_ms in backoffs:
+    for attempt in range(len(backoffs)):
         if resp.status_code not in RETRY_STATUS:
             return resp
-        delay = backoff_ms / 1000.0
-        retry_after = resp.headers.get("retry-after")
-        if retry_after:
-            try:
-                # Retry-After may also be an HTTP-date (RFC 9110); fall back
-                # to the schedule for anything non-numeric, cap to 30s.
-                delay = min(float(retry_after), 30.0)
-            except ValueError:
-                pass
-        time.sleep(delay)
+        _metrics.safe_counter(
+            "http_retries_total",
+            reason=("connection" if resp.status_code == 0
+                    else str(resp.status_code))).inc()
+        _policy.backoff(attempt, schedule_ms=backoffs,
+                        retry_after=resp.headers.get("retry-after"))
         resp = send_request(request, timeout)
     return resp
 

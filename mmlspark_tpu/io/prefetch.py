@@ -37,6 +37,7 @@ from typing import Callable, Iterable, Iterator, TypeVar
 from ..observability import metrics as _metrics
 from ..observability import spans as _spans
 from ..observability import watchdog as _watchdog
+from ..robustness.failpoints import fault_point as _failpoint
 
 T = TypeVar("T")
 
@@ -77,6 +78,9 @@ def iter_prefetched(thunks: Iterable[Callable[[], T]], *, depth: int = 1,
             pending.append(ex.submit(thunk))
         while pending:
             hb.beat()
+            # chaos hook: a failing/slow chunk load, surfaced at the
+            # consumer's yield point exactly like a real reader error
+            _failpoint("prefetch.chunk")
             fut = pending.popleft()
             t0 = time.perf_counter()
             with _spans.span("prefetch_wait", site=site):

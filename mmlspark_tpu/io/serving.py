@@ -52,6 +52,8 @@ from ..observability import spans as _spans
 from ..observability import tracing as _tracing
 from ..observability import watchdog as _watchdog
 from ..observability.logging import get_logger
+from ..robustness import failpoints as _failpoints
+from ..robustness import policy as _policy
 from .http import to_jsonable
 
 logger = get_logger("mmlspark_tpu.io.serving")
@@ -262,6 +264,13 @@ class ServedRequest:
     requeued: bool = False
     #: trace context extracted at the edge (None with telemetry disabled)
     trace: Optional[Any] = None
+    #: remaining-time budget parsed from X-Deadline-Ms (None = no deadline)
+    deadline: Optional[_policy.Deadline] = None
+    #: monotonic admission time — the queue-wait clock
+    enqueued_at: float = 0.0
+    #: withdrawn at admission (drain race): the batch loop must skip it —
+    #: its handler already answered 503
+    shed: bool = False
 
     def json(self) -> Any:
         return json.loads(self.body.decode("utf-8")) if self.body else None
@@ -277,12 +286,29 @@ class ServingServer:
     """
 
     def __init__(self, host: str = "localhost", port: int = 0,
-                 api_name: str = "serving", request_timeout: float = 30.0):
+                 api_name: str = "serving", request_timeout: float = 30.0,
+                 max_queue_depth: Optional[int] = None):
         self.api_name = api_name
         self.request_timeout = request_timeout
-        self._queue: "queue.Queue[ServedRequest]" = queue.Queue()
+        # admission control: past this backlog the handler sheds with
+        # 429 + Retry-After instead of queueing forever (0 disables).
+        # The bound lives in the queue itself (put_nowait admission) —
+        # a qsize() check-then-put would admit a burst past the limit.
+        self.max_queue_depth = (
+            max_queue_depth if max_queue_depth is not None
+            else _policy.env_int("MMLSPARK_TPU_MAX_QUEUE_DEPTH", 512))
+        self._queue: "queue.Queue[ServedRequest]" = queue.Queue(
+            maxsize=max(0, self.max_queue_depth))
         self._inflight: Dict[str, ServedRequest] = {}
         self._lock = threading.Lock()
+        self._draining = False
+        # pulsed on every reply/requeue/batch so drain and await_served
+        # can wait on progress instead of sleep-polling
+        self._progress = threading.Event()
+        # observed per-request service time + queue wait: the inputs to
+        # the Retry-After hint handed to shed/drained clients
+        self._service_ewma = _policy.Ewma()
+        self._wait_ewma = _policy.Ewma()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -298,6 +324,38 @@ class ServingServer:
                         # even when the batching worker is wedged
                         write_debug_response(self, route, outer.api_name)
                         return
+                # fault site: admission-side chaos (synthetic 5xx, added
+                # latency, connection-drop crash); ordered AFTER the
+                # debug routes so /metrics & /debug stay readable mid-run
+                act = _failpoints.fault_point("serving.handle",
+                                          api=outer.api_name)
+                if act is not None and act.status is not None:
+                    write_http_response(self, act.status,
+                                        b'{"error": "injected"}',
+                                        counter="serving_responses_total",
+                                        api=outer.api_name)
+                    return
+                if outer._draining:
+                    # new traffic is refused during drain; gateways have
+                    # already dropped us from the registry, and a direct
+                    # client gets told when capacity elsewhere frees up
+                    outer._shed("draining")
+                    write_http_response(self, 503,
+                                        b'{"error": "draining"}',
+                                        outer.retry_after_hint(),
+                                        counter="serving_responses_total",
+                                        api=outer.api_name)
+                    return
+                deadline = _policy.Deadline.from_headers(self.headers)
+                if deadline is not None and deadline.expired:
+                    _metrics.safe_counter("serving_deadline_dropped_total",
+                                          api=outer.api_name,
+                                          stage="admission").inc()
+                    write_http_response(self, 504,
+                                        b'{"error": "deadline exceeded"}',
+                                        counter="serving_responses_total",
+                                        api=outer.api_name)
+                    return
                 # inbound hop: adopt the caller's trace (gateway/client
                 # traceparent) or start one; None while disabled, which
                 # also suppresses the X-Request-Id echo
@@ -325,16 +383,49 @@ class ServingServer:
                             path=self.path,
                             headers={k.lower(): v
                                      for k, v in self.headers.items()},
-                            body=body, trace=ctx)
+                            body=body, trace=ctx, deadline=deadline,
+                            enqueued_at=time.monotonic())
                         with outer._lock:
                             outer._inflight[req.id] = req
-                        outer._queue.put(req)
-                        _metrics.safe_gauge("serving_queue_depth",
-                                            api=outer.api_name).set(
-                            outer._queue.qsize())
-                        ok = req.done.wait(outer.request_timeout)
+                        try:
+                            outer._queue.put_nowait(req)
+                        except queue.Full:
+                            # admission control: past the backlog bound,
+                            # queueing only converts overload into
+                            # timeouts — shed now and tell the client
+                            # when the queue will have drained
+                            with outer._lock:
+                                outer._inflight.pop(req.id, None)
+                            outer._shed("queue_full")
+                            write_http_response(
+                                self, 429, b'{"error": "overloaded"}',
+                                outer.retry_after_hint(),
+                                counter="serving_responses_total",
+                                api=outer.api_name)
+                            return
+                        if outer._draining and outer._withdraw(req):
+                            # drain began between the flag check and the
+                            # enqueue: without this withdraw, a request
+                            # slipping into an already-flushed queue
+                            # would die as a silent 504 after stop()
+                            outer._shed("draining")
+                            write_http_response(
+                                self, 503, b'{"error": "draining"}',
+                                outer.retry_after_hint(),
+                                counter="serving_responses_total",
+                                api=outer.api_name)
+                            return
+                        outer._update_queue_depth()
+                        # a deadlined request never parks past its budget:
+                        # waiting longer only delays the inevitable 504
+                        wait_s = outer.request_timeout
+                        if deadline is not None:
+                            wait_s = min(wait_s,
+                                         deadline.remaining_seconds())
+                        ok = req.done.wait(wait_s)
                         with outer._lock:
                             outer._inflight.pop(req.id, None)
+                        outer._progress.set()
                         echo = ({} if ctx is None else
                                 {_tracing.REQUEST_ID_HEADER: ctx.trace_id})
                         if not ok or req.response is None:
@@ -412,6 +503,67 @@ class ServingServer:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}/{self.api_name}"
 
+    # -- resilience --------------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Refuse new traffic (503 + Retry-After); in-flight requests and
+        queued batches keep flowing to completion."""
+        self._draining = True
+        _metrics.safe_gauge("serving_draining", api=self.api_name).set(1)
+
+    def inflight_count(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    def has_inflight(self, request_id: str) -> bool:
+        with self._lock:
+            return request_id in self._inflight
+
+    def _shed(self, reason: str) -> None:
+        _metrics.safe_counter("serving_shed_total", api=self.api_name,
+                              reason=reason).inc()
+        _flight.record("shed", api=self.api_name, reason=reason,
+                       depth=self._queue.qsize())
+
+    def _withdraw(self, req: ServedRequest) -> bool:
+        """Take a just-enqueued request back (the admission/drain race).
+        True when this handler still owns the reply — the batch loop
+        will skip the marked request; False when the batch side already
+        answered it."""
+        req.shed = True
+        with self._lock:
+            owned = self._inflight.pop(req.id, None) is not None
+        return owned and not req.done.is_set()
+
+    def _update_queue_depth(self) -> None:
+        """The ONE writer of the ``serving_queue_depth`` gauge — every
+        queue transition funnels here so the exported depth can never
+        diverge between call sites."""
+        _metrics.safe_gauge("serving_queue_depth", api=self.api_name).set(
+            self._queue.qsize())
+
+    def observe_batch(self, n: int, seconds: float) -> None:
+        """ServingQuery reports each batch's service time here, feeding
+        the per-request EWMA the Retry-After hint is derived from."""
+        if n > 0:
+            self._service_ewma.update(seconds / n)
+
+    def retry_after_hint(self) -> Dict[str, str]:
+        """Retry-After for shed/drain responses: the estimated time for
+        the CURRENT backlog to drain at the observed per-request service
+        rate (queue wait EWMA as a floor — it already includes batching
+        effects), clamped sane while the estimators are cold."""
+        per_req = self._service_ewma.value or 0.0
+        est = (self._queue.qsize() + 1) * per_req
+        wait = self._wait_ewma.value
+        if wait:
+            est = max(est, wait)
+        return {"Retry-After":
+                str(_policy.retry_after_seconds(est))}
+
     # -- source side -------------------------------------------------------
     def get_batch(self, max_batch: int, max_latency: float,
                   eager: bool = True) -> List[ServedRequest]:
@@ -436,8 +588,7 @@ class ServingServer:
             # empty keeps exporting the LAST busy depth forever (the
             # assembly histogram correctly stays untouched: there was no
             # assembly)
-            _metrics.safe_gauge("serving_queue_depth",
-                                api=self.api_name).set(self._queue.qsize())
+            self._update_queue_depth()
             return out
         t_first = time.monotonic()
         if eager:
@@ -462,11 +613,21 @@ class ServingServer:
                          t_first: float) -> List[ServedRequest]:
         # assembly wait = time after the FIRST arrival spent filling the
         # batch (0 for an eager lone request; bounded by the deadline)
+        now = time.monotonic()
         _metrics.safe_histogram("serving_batch_assembly_seconds",
-                                api=self.api_name).observe(
-            time.monotonic() - t_first)
-        _metrics.safe_gauge("serving_queue_depth", api=self.api_name).set(
-            self._queue.qsize())
+                                api=self.api_name).observe(now - t_first)
+        # queue WAIT (admission -> batch), per request — nonzero even on
+        # the eager lone-request path, and the signal the shed threshold
+        # and Retry-After math key off (assembly time alone hides the
+        # time spent parked BEHIND earlier batches)
+        wait_h = _metrics.safe_histogram("serving_queue_wait_seconds",
+                                         api=self.api_name)
+        for r in out:
+            if r.enqueued_at:
+                w = now - r.enqueued_at
+                wait_h.observe(w)
+                self._wait_ewma.update(w)
+        self._update_queue_depth()
         return out
 
     def requeue(self, req: ServedRequest) -> bool:
@@ -475,7 +636,14 @@ class ServingServer:
         if req.requeued or req.done.is_set():
             return False
         req.requeued = True
-        self._queue.put(req)
+        try:
+            # never block the batch thread on a full queue: under shed
+            # pressure the crash-recovery slot is gone — the request's
+            # handler times out to its normal 504 instead
+            self._queue.put_nowait(req)
+        except queue.Full:
+            self._shed("requeue_full")
+            return False
         # queue transition: a crash-recovery requeue is exactly the kind
         # of event a post-mortem flight dump needs in sequence
         _flight.record("requeue", api=self.api_name, request_id=req.id)
@@ -500,6 +668,7 @@ class ServingServer:
         req.response = {"statusCode": status_code, "entity": entity or b"",
                         "headers": headers or {}}
         req.done.set()
+        self._progress.set()
         return True
 
 
@@ -593,10 +762,67 @@ class ServingQuery:
         self._thread.join(timeout=5)
         self.server.stop()
 
+    def drain(self, settle_seconds: Optional[float] = None,
+              timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Graceful shutdown: serve normally for ``settle_seconds`` (the
+        window gateways need to drop this worker from their routing
+        tables after deregistration), then refuse new traffic and let
+        every queued request and in-flight batch complete before
+        stopping — a SIGTERM'd worker exits with zero client-visible
+        errors. Returns drain stats for the caller's exit log.
+
+        Env defaults: ``MMLSPARK_TPU_DRAIN_SETTLE_SECONDS`` (0.5),
+        ``MMLSPARK_TPU_DRAIN_TIMEOUT_SECONDS`` (30).
+        """
+        api = self.server.api_name
+        if settle_seconds is None:
+            settle_seconds = _policy.env_float(
+                "MMLSPARK_TPU_DRAIN_SETTLE_SECONDS", 0.5)
+        if timeout is None:
+            timeout = _policy.env_float(
+                "MMLSPARK_TPU_DRAIN_TIMEOUT_SECONDS", 30.0)
+        t0 = time.monotonic()
+        _flight.record("drain_begin", api=api,
+                       queued=self.server._queue.qsize(),
+                       inflight=self.server.inflight_count())
+        logger.info("drain begin", api=api,
+                    settle_seconds=settle_seconds)
+        if settle_seconds > 0:
+            time.sleep(settle_seconds)
+        self.server.begin_drain()
+        end = time.monotonic() + timeout
+        clean = False
+        progress = self.server._progress
+        while True:
+            if (self.server._queue.qsize() == 0
+                    and self.server.inflight_count() == 0):
+                clean = True
+                break
+            remaining = end - time.monotonic()
+            if remaining <= 0:
+                break
+            # woken by every reply/requeue/handler-release pulse; the
+            # timeout only bounds the wait between pulses
+            progress.wait(min(remaining, 0.05))
+            progress.clear()
+        self.stop()
+        stats = {"clean": clean,
+                 "seconds": round(time.monotonic() - t0, 3),
+                 "requests_served": self.requests_served,
+                 "leftover_inflight": self.server.inflight_count()}
+        _flight.record("drain_complete", api=api, **stats)
+        logger.info("drain complete", api=api, **stats)
+        return stats
+
     def await_served(self, n: int, timeout: float = 30.0) -> None:
         deadline = time.monotonic() + timeout
-        while self.requests_served < n and time.monotonic() < deadline:
-            time.sleep(0.01)
+        progress = self.server._progress
+        while self.requests_served < n:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            progress.wait(min(remaining, 0.05))
+            progress.clear()
 
     def _run(self) -> None:
         api = self.server.api_name
@@ -612,11 +838,41 @@ class ServingQuery:
         finally:
             hb.close()
 
+    def _drop_expired(self, batch: List[ServedRequest],
+                      api: str) -> List[ServedRequest]:
+        """Answer 504 now for co-batched requests whose deadline already
+        passed — scoring them would spend a device dispatch on replies
+        nobody awaits (their handler threads have stopped waiting)."""
+        live: List[ServedRequest] = []
+        for r in batch:
+            if r.deadline is not None and r.deadline.expired:
+                _metrics.safe_counter("serving_deadline_dropped_total",
+                                      api=api, stage="batch").inc()
+                _flight.record("deadline_dropped", api=api,
+                               request_id=r.id)
+                # usually the handler (whose wait is capped at the
+                # deadline) has already 504'd and released the socket —
+                # replying then would misfire the reply_unknown anomaly
+                # counter; only route a real 504 to a still-parked one
+                if self.server.has_inflight(r.id):
+                    self.server.reply(r.id, {"error": "deadline exceeded"},
+                                      504)
+            else:
+                live.append(r)
+        return live
+
     def _run_batches(self, api: str, hb) -> None:
         while not self._stop.is_set():
             hb.beat()
             batch = self.server.get_batch(self.max_batch, self.max_latency,
                                           self.eager)
+            if not batch:
+                continue
+            # requests withdrawn at admission (the drain race) were
+            # already answered 503 by their handler — scoring them would
+            # double-reply
+            batch = [r for r in batch if not r.shed]
+            batch = self._drop_expired(batch, api)
             if not batch:
                 continue
             _metrics.safe_histogram("serving_batch_size", api=api,
@@ -633,6 +889,10 @@ class ServingQuery:
             ctx = traces[0] if traces else None
             token = _tracing.activate(ctx) if ctx is not None else None
             try:
+                # fault site: an `error` rule here is a transform crash —
+                # it rides the requeue-once recovery path below exactly
+                # like a real one (which is the point)
+                _failpoints.fault_point("serving.batch", api=api)
                 with _spans.span("serving_transform", api=api,
                                  batch_size=len(batch),
                                  trace_ids=[t.trace_id for t in traces]):
@@ -647,10 +907,12 @@ class ServingQuery:
                         self.server.reply(rid, rep)
                 self.batches_served += 1
                 self.requests_served += len(batch)
+                self.server._progress.set()
+                dt = time.perf_counter() - t0
+                self.server.observe_batch(len(batch), dt)
                 _metrics.safe_counter("serving_batches_total", api=api).inc()
                 _metrics.safe_histogram("serving_transform_seconds",
-                                        api=api).observe(
-                    time.perf_counter() - t0)
+                                        api=api).observe(dt)
             except Exception as e:
                 survivors = [r for r in batch if self.server.requeue(r)]
                 logger.error("batch transform failed: %s: %s",
@@ -683,6 +945,7 @@ class ServingBuilder:
         self._transform: Optional[Callable[[Dataset], Dataset]] = None
         self._reply_col = "reply"
         self._timeout = 30.0
+        self._max_queue_depth: Optional[int] = None
 
     def address(self, host: str, port: int = 0, api_name: str = "serving"
                 ) -> "ServingBuilder":
@@ -700,6 +963,13 @@ class ServingBuilder:
 
     def request_timeout(self, seconds: float) -> "ServingBuilder":
         self._timeout = seconds
+        return self
+
+    def queue_limit(self, max_queue_depth: int) -> "ServingBuilder":
+        """Admission bound: past this backlog, requests shed with 429 +
+        Retry-After instead of queueing (0 disables; default from
+        ``MMLSPARK_TPU_MAX_QUEUE_DEPTH``, 512)."""
+        self._max_queue_depth = max_queue_depth
         return self
 
     def transform(self, fn: Callable[[Dataset], Dataset]) -> "ServingBuilder":
@@ -732,7 +1002,9 @@ class ServingBuilder:
     def start(self) -> ServingQuery:
         if self._transform is None:
             raise ValueError("no transform set; call .transform(fn) or .pipeline(model)")
-        server = ServingServer(self._host, self._port, self._name, self._timeout)
+        server = ServingServer(self._host, self._port, self._name,
+                               self._timeout,
+                               max_queue_depth=self._max_queue_depth)
         return ServingQuery(server, self._transform, self._reply_col,
                             self._max_batch, self._max_latency,
                             self._eager).start()

@@ -78,6 +78,19 @@ def main(argv=None) -> int:
     w.add_argument("--output-col", default="prediction")
     w.add_argument("--max-batch", type=int, default=32)
     w.add_argument("--max-latency-ms", type=float, default=5.0)
+    w.add_argument("--max-queue-depth", type=int, default=None,
+                   help="shed (429 + Retry-After) above this many queued "
+                        "requests (default: MMLSPARK_TPU_MAX_QUEUE_DEPTH "
+                        "or 512; 0 = unbounded)")
+    w.add_argument("--drain-settle-seconds", type=float, default=None,
+                   help="after SIGTERM + deregistration, keep serving "
+                        "this long while gateways drop us from their "
+                        "routing tables (default: "
+                        "MMLSPARK_TPU_DRAIN_SETTLE_SECONDS or 0.5)")
+    w.add_argument("--drain-timeout", type=float, default=None,
+                   help="seconds to finish queued + in-flight work on "
+                        "SIGTERM (default: "
+                        "MMLSPARK_TPU_DRAIN_TIMEOUT_SECONDS or 30)")
 
     g = sub.add_parser("gateway", help="load-balance over registry workers")
     g.add_argument("--registry", required=True)
@@ -128,7 +141,8 @@ def main(argv=None) -> int:
         transform = _load_transform(args.model, args.input_col,
                                     args.output_col,
                                     max_batch=args.max_batch)
-        server = ServingServer(args.host, args.port, args.api_name)
+        server = ServingServer(args.host, args.port, args.api_name,
+                               max_queue_depth=args.max_queue_depth)
         query = ServingQuery(server, transform, max_batch=args.max_batch,
                              max_latency=args.max_latency_ms / 1000.0)
         advertise = args.advertise_host or args.host
@@ -151,8 +165,18 @@ def main(argv=None) -> int:
         try:
             stop.wait()
         finally:
+            # graceful drain: deregister FIRST (gateways route around us
+            # from their next registry scan), keep serving through the
+            # settle window, then refuse new traffic and finish every
+            # queued request and in-flight batch before exiting — a
+            # SIGTERM'd worker costs zero client-visible errors
             registry.deregister(info.worker_id)
-            query.stop()
+            stats = query.drain(
+                settle_seconds=args.drain_settle_seconds,
+                timeout=args.drain_timeout)
+            # console, like the ready-line: orchestration + tests parse it
+            _logging.console(f"worker {info.worker_id} drained")
+            log.info("worker drained", worker_id=info.worker_id, **stats)
         return 0
 
     gateway = GatewayServer(registry, args.host, args.port, args.api_name)

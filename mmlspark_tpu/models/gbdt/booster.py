@@ -32,6 +32,7 @@ from ...observability import metrics as _metrics
 from ...observability import spans as _spans
 from ...observability import watchdog as _watchdog
 from ...observability.logging import console as _console
+from ...robustness.failpoints import fault_point as _failpoint
 from ...utils import compile_cache as _compile_cache
 from ...ops.binning import QuantileBinner, bin_cols_device
 from ...parallel import mesh as meshlib
@@ -1435,7 +1436,29 @@ def train_booster(
         # (sweeps) never purge each other's files
         ckpt_mgr = CheckpointManager(checkpoint_dir,
                                      namespace=ckpt_fingerprint[:12])
+        # resolved here, before any compiled-program cache key is built
+        # (the resolve-before-cache-key rule): the dump hook itself is
+        # armed much later, next to the round loop
+        dump_on_unhealthy = os.environ.get(
+            "MMLSPARK_TPU_CHECKPOINT_ON_UNHEALTHY",
+            "").lower() in ("1", "true", "yes")
         latest = ckpt_mgr.latest_matching(ckpt_fingerprint)
+        # MMLSPARK_TPU_STRICT_RESUME=1: resume-or-die — checkpoints that
+        # exist but mismatch (changed data/config/warm start) raise a
+        # CheckpointMismatchError instead of silently retraining from
+        # scratch. Only probed when the namespaced resume found NOTHING
+        # (the happy path must not unpickle every file twice), and the
+        # probe checks ACROSS namespaces: the un-namespaced inspection
+        # view sees the mismatched files a namespaced manager filters
+        # out (config drift changes the namespace).
+        if latest is None and os.environ.get(
+                "MMLSPARK_TPU_STRICT_RESUME",
+                "").lower() in ("1", "true", "yes"):
+            # a MATCH here is a legacy un-namespaced checkpoint the
+            # namespaced manager can't see — resume from it rather than
+            # silently retraining (the outcome strict mode forbids)
+            latest = CheckpointManager(checkpoint_dir).latest_matching(
+                ckpt_fingerprint, purge_stale=False, strict=True)
         if latest is not None:
             step, payload = latest
             init_booster = Booster.from_string(payload["model"])
@@ -1486,8 +1509,22 @@ def train_booster(
             raise ValueError(
                 "init_booster warm start scores raw rows: pass X alongside "
                 "dataset=")
-        scores0 = init_booster.predict_raw(
-            np.asarray(_densify(X), np.float32))  # [n, K]
+        # checkpoint resume restores the EXACT accumulated score matrix
+        # the interrupted run held (downloaded into the payload at save
+        # time): re-deriving it via predict_raw would replay the forest
+        # in a different float-summation order and the resumed run would
+        # drift from the uninterrupted one by an ulp — enough to pick
+        # different splits. Stored state is what makes a failpoint-killed
+        # fit resume to bit-identical trees. Shape-guarded fallback:
+        # an old-format checkpoint re-scores through the model.
+        resume_scores = (None if resume_state is None
+                         else resume_state.get("scores"))
+        if resume_scores is not None and \
+                np.asarray(resume_scores).shape == (n, K):
+            scores0 = np.asarray(resume_scores, np.float32)
+        else:
+            scores0 = init_booster.predict_raw(
+                np.asarray(_densify(X), np.float32))  # [n, K]
         scores_d, _ = meshlib.shard_rows(scores0.astype(np.float32), mesh)
     elif boost_from_average:
         base_fn = _cached_program(
@@ -1507,20 +1544,39 @@ def train_booster(
         tw.mark("base_scores")
 
     has_valid = valid_set is not None
+    valid_fp = None
     if has_valid:
         Xv, yv, wv = valid_set
         Xv = np.asarray(_densify(Xv), np.float32)
         yv = np.asarray(yv, np.float32)
         wv = np.ones_like(yv) if wv is None else np.asarray(wv, np.float32)
         nv = len(yv)
+        if ckpt_mgr is not None:
+            # the valid set is NOT part of the resume fingerprint (a
+            # changed eval set must not discard training progress), so
+            # the exact-state vscores restore needs its own identity
+            # check — restoring V1's accumulated scores against V2's
+            # labels would silently corrupt early stopping
+            from ...utils.checkpoint import data_fingerprint as _vfp
+            valid_fp = _vfp(Xv, yv, wv)
         Xvb_d, _ = meshlib.shard_rows(binner.transform(Xv), mesh)
         yv_d, _ = meshlib.shard_rows(yv, mesh)
         # fold validity into the weight so padded rows don't count
         wv_pad, _ = meshlib.pad_rows(wv, nshards)
         wv_pad = wv_pad * meshlib.validity_mask(nv, len(wv_pad))
         wv_d, _ = meshlib.shard_rows(wv_pad, mesh)
-        vscores0 = (init_booster.predict_raw(Xv) if init_booster is not None
-                    else np.tile(base[None, :], (nv, 1)))
+        # same exact-state rule as the training scores above — but only
+        # when the checkpoint was written against THIS valid set
+        resume_vscores = (None if resume_state is None
+                          else resume_state.get("vscores"))
+        if (resume_vscores is not None and valid_fp is not None
+                and resume_state.get("valid_fingerprint") == valid_fp
+                and np.asarray(resume_vscores).shape == (nv, K)):
+            vscores0 = np.asarray(resume_vscores, np.float32)
+        elif init_booster is not None:
+            vscores0 = init_booster.predict_raw(Xv)
+        else:
+            vscores0 = np.tile(base[None, :], (nv, 1))
         vscores_d, _ = meshlib.shard_rows(vscores0.astype(np.float32), mesh)
         if tw.on:
             jax.block_until_ready((Xvb_d, yv_d, wv_d, vscores_d))
@@ -1899,10 +1955,62 @@ def train_booster(
     # sentinel (fused paths have no rounds; scan_eval_history covers them)
     hb = _watchdog.register("gbdt_round_loop", stall_seconds=120.0) \
         if not fuse_es else _watchdog.NOOP_HEARTBEAT
+    # last-good-checkpoint dump on watchdog events (opt-in via
+    # MMLSPARK_TPU_CHECKPOINT_ON_UNHEALTHY=1): a NaN/divergence sentinel
+    # or a stall episode during a checkpointed fit writes the newest
+    # HEALTHY state immediately — for sentinels the flagged round's trees
+    # are dropped (they embody the bad update), for stalls every complete
+    # round is good. The dump rides the normal checkpoint format, so the
+    # standard auto-resume picks it up after the operator kills the job.
+    unregister_dump = None
+    if ckpt_mgr is not None and dump_on_unhealthy:
+        dump_once = threading.Event()
+
+        def _last_good_dump(category, name, fields):
+            # sentinel events name the model stream ("gbdt"); stall
+            # episodes name the heartbeat site
+            if name not in ("gbdt", "gbdt_round_loop") or dump_once.is_set():
+                return
+            trees_snap = list(all_trees)    # append-only: snapshot is safe
+            complete = (len(trees_snap) // K) * K
+            if category in ("nan_loss", "loss_divergence") and complete >= K:
+                complete -= K
+            if complete <= 0:
+                # nothing healthy to dump yet — stay ARMED: a round-0
+                # event must not burn the one-shot latch and silence a
+                # real mid-fit dump later
+                return
+            step = iterations_done + complete // K - 1
+            try:
+                ckpt_mgr.save(step, {
+                    "model": _finalize(trees_snap[:complete]).model_string(),
+                    "iteration": step,
+                    "fingerprint": ckpt_fingerprint,
+                    "prior_iterations": 0 if user_init_booster is None
+                    else user_init_booster.num_iterations,
+                    "best_metric": best_metric,
+                    "best_iter": best_iter,
+                    "rounds_no_improve": rounds_no_improve,
+                    "history": history,
+                    "valid_fingerprint": valid_fp,
+                    "emergency": True, "reason": category})
+            except Exception:  # noqa: BLE001 — disk full mid-incident:
+                return         # stay armed for a later, luckier event
+            # latch only AFTER a successful publish — a failed dump must
+            # not permanently disable the safety net
+            dump_once.set()
+            _flight.record("checkpoint_emergency_dump", model="gbdt",
+                           reason=category, iteration=step)
+
+        unregister_dump = _watchdog.add_event_callback(_last_good_dump)
     t_round = time.perf_counter()
     try:
         for it in ([] if fuse_es else range(iterations_done, num_iterations)):
             hb.beat()
+            # chaos hook: one evaluation per boosting round — `error`
+            # kills the fit mid-train (the preemption drill the resume
+            # path is tested against), `delay` simulates a slow round
+            _failpoint("gbdt.round")
             key, bag_key = _iter_keys(base_key, it)
             scores_d, vscores_d_new, trees_packed, metrics = step(
                 Xbt_d, y_d, w_d, vmask_d, scores_d,
@@ -1958,6 +2066,18 @@ def train_booster(
             if (ckpt_mgr is not None and checkpoint_period > 0
                     and (it + 1) % checkpoint_period == 0
                     and it + 1 < num_iterations):
+                # the accumulated score matrices ride in the payload so a
+                # resume restarts from the EXACT optimizer state — see the
+                # resume_scores comment above (bit-identical trees). One
+                # d2h per checkpoint period; best-effort on exotic
+                # placements (a non-addressable mesh falls back to the
+                # predict_raw reconstruction on resume).
+                try:
+                    scores_host = np.asarray(scores_d)[:n]  # graftlint: disable=hot-path-host-sync (deliberate: one d2h per checkpoint period, exact-state resume needs the host copy)
+                    vscores_host = (np.asarray(vscores_d)[:nv]  # graftlint: disable=hot-path-host-sync (same deliberate checkpoint d2h as scores_host)
+                                    if has_valid else None)
+                except Exception:  # noqa: BLE001
+                    scores_host = vscores_host = None
                 ckpt_mgr.save(it, {"model": _finalize(all_trees).model_string(),
                                    "iteration": it,
                                    "fingerprint": ckpt_fingerprint,
@@ -1967,10 +2087,15 @@ def train_booster(
                                    "best_metric": best_metric,
                                    "best_iter": best_iter,
                                    "rounds_no_improve": rounds_no_improve,
-                                   "history": history})
+                                   "history": history,
+                                   "scores": scores_host,
+                                   "vscores": vscores_host,
+                                   "valid_fingerprint": valid_fp})
 
     finally:
         hb.close()
+        if unregister_dump is not None:
+            unregister_dump()
     booster = _finalize(all_trees)
     # early-stop truncation applies to fresh runs and checkpoint resumes
     # alike (the checkpoint's trees carry global iteration indices); only a

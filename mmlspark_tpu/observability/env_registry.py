@@ -24,16 +24,35 @@ layer and must stay cycle-free (the ``obs-import-cycle`` rule).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
 __all__ = ["EnvVar", "REGISTRY", "get", "names", "render_markdown",
-           "SECTIONS"]
+           "SECTIONS", "env_float", "env_int"]
+
+
+def env_float(name: str, default: float) -> float:
+    """Read a float knob; unset, empty, or unparseable -> ``default``
+    (the one fallback semantics every consumer shares — keep parsing
+    here so it cannot drift between subsystems)."""
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 #: section id -> docs file the generated table lives in
 SECTIONS: Dict[str, str] = {"observability": "docs/observability.md",
-                            "performance": "docs/performance.md"}
+                            "performance": "docs/performance.md",
+                            "robustness": "docs/robustness.md"}
 
 #: who reads an entry: "python" (the package — lint-checked), "native"
 #: (the C++ host runtime, exempt from the must-be-read check)
@@ -176,6 +195,100 @@ REGISTRY: Tuple[EnvVar, ...] = (
            section="performance",
            doc="`0` disables the native C++ TreeSHAP engine inside the "
                "host path (falls back to vectorized numpy recursion)"),
+    # -- robustness: fault injection --------------------------------------
+    EnvVar(name="MMLSPARK_TPU_FAILPOINTS", default="(off)",
+           section="robustness",
+           doc="fault-injection rules, `site:kind[:arg][@N]` "
+               "comma-separated (kinds `error_<status>`/`error`/`delay`/"
+               "`exit`; grammar + site table in docs/robustness.md); "
+               "byte-identical no-op when unset"),
+    EnvVar(name="MMLSPARK_TPU_FAILPOINTS_SEED", default="0",
+           section="robustness",
+           doc="seed for probabilistic fault rules — the same spec + "
+               "seed replays the same fired-fault sequence"),
+    # -- robustness: retry policy -----------------------------------------
+    EnvVar(name="MMLSPARK_TPU_RETRY_MAX_ATTEMPTS", default="3",
+           section="robustness",
+           doc="`RetryPolicy` total attempts including the first"),
+    EnvVar(name="MMLSPARK_TPU_RETRY_BASE_MS", default="25",
+           section="robustness",
+           doc="`RetryPolicy` full-jitter backoff base (delay drawn "
+               "uniform(0, min(cap, base·2^attempt)))"),
+    EnvVar(name="MMLSPARK_TPU_RETRY_MAX_MS", default="2000",
+           section="robustness",
+           doc="`RetryPolicy` backoff cap per sleep"),
+    EnvVar(name="MMLSPARK_TPU_RETRY_BUDGET_RATIO", default="0.1",
+           section="robustness",
+           doc="retry-budget tokens accrued per admitted request — under "
+               "a total outage retry load converges to this fraction of "
+               "live traffic"),
+    EnvVar(name="MMLSPARK_TPU_RETRY_BUDGET_MIN", default="10",
+           section="robustness",
+           doc="retry-budget starting balance (cold starts can fail over "
+               "before traffic has accrued tokens)"),
+    EnvVar(name="MMLSPARK_TPU_RETRY_BUDGET_CAP", default="100",
+           section="robustness",
+           doc="retry-budget token ceiling"),
+    # -- robustness: circuit breakers -------------------------------------
+    EnvVar(name="MMLSPARK_TPU_BREAKER_CONSECUTIVE", default="5",
+           section="robustness",
+           doc="consecutive soft failures that open a worker's breaker"),
+    EnvVar(name="MMLSPARK_TPU_BREAKER_ERROR_RATE", default="0.5",
+           section="robustness",
+           doc="windowed error-rate threshold that opens a breaker (at "
+               "`MIN_VOLUME`+ observations)"),
+    EnvVar(name="MMLSPARK_TPU_BREAKER_WINDOW", default="20",
+           section="robustness",
+           doc="breaker outcome-window length for the error-rate trip"),
+    EnvVar(name="MMLSPARK_TPU_BREAKER_MIN_VOLUME", default="10",
+           section="robustness",
+           doc="minimum windowed observations before the error rate can "
+               "trip a breaker"),
+    EnvVar(name="MMLSPARK_TPU_BREAKER_OPEN_SECONDS",
+           default="(gateway health interval)", section="robustness",
+           doc="open-state cooldown before a half-open probe is due"),
+    EnvVar(name="MMLSPARK_TPU_BREAKER_HALF_OPEN_SUCCESSES", default="1",
+           section="robustness",
+           doc="successful health-loop probes needed to re-close a "
+               "half-open breaker"),
+    EnvVar(name="MMLSPARK_TPU_DEADLINE_MARGIN_MS", default="5",
+           section="robustness",
+           doc="per-hop attenuation subtracted from the re-emitted "
+               "`X-Deadline-Ms` budget (wire + serialization slack)"),
+    # -- robustness: admission / drain / gateway --------------------------
+    EnvVar(name="MMLSPARK_TPU_MAX_QUEUE_DEPTH", default="512",
+           section="robustness",
+           doc="worker bounded-queue admission limit — past it requests "
+               "shed with 429 + a queue-drain-derived Retry-After "
+               "(0 = unbounded)"),
+    EnvVar(name="MMLSPARK_TPU_DRAIN_SETTLE_SECONDS", default="0.5",
+           section="robustness",
+           doc="SIGTERM drain: keep serving this long after "
+               "deregistration while gateways drop the worker from "
+               "their routing tables"),
+    EnvVar(name="MMLSPARK_TPU_DRAIN_TIMEOUT_SECONDS", default="30",
+           section="robustness",
+           doc="SIGTERM drain: seconds to finish queued + in-flight "
+               "work before the worker stops"),
+    EnvVar(name="MMLSPARK_TPU_GATEWAY_HEALTH_INTERVAL_SECONDS",
+           default="2.0", section="robustness",
+           doc="gateway health-sweep period — also the cadence of "
+               "half-open breaker probes"),
+    EnvVar(name="MMLSPARK_TPU_GATEWAY_MAX_FAILOVERS", default="3",
+           section="robustness",
+           doc="failover retries per routed request (each also spends "
+               "one retry-budget token)"),
+    # -- robustness: preemption-safe training -----------------------------
+    EnvVar(name="MMLSPARK_TPU_STRICT_RESUME", default="(off)",
+           section="robustness",
+           doc="`1` = resume-or-die: checkpoints that exist but mismatch "
+               "the run's fingerprint raise `CheckpointMismatchError` "
+               "instead of silently retraining from scratch"),
+    EnvVar(name="MMLSPARK_TPU_CHECKPOINT_ON_UNHEALTHY", default="(off)",
+           section="robustness",
+           doc="`1` = a watchdog stall or training-health sentinel "
+               "during a checkpointed fit dumps the newest HEALTHY "
+               "state immediately (one-shot per fit)"),
     # -- native host runtime ----------------------------------------------
     EnvVar(name="MMLSPARK_TPU_NATIVE_CACHE",
            default="(per-user dir under system temp, mode 0700)",

@@ -282,6 +282,10 @@ class MetricsFederator:
         self._thread: Optional[threading.Thread] = None
         #: set by the gateway on failover (surfaced in /debug/cluster)
         self.last_failover: Optional[Dict[str, Any]] = None
+        #: optional callable -> {worker: breaker_state}; the gateway
+        #: installs its BreakerBoard view so /debug/cluster shows which
+        #: workers the routing plane is currently refusing
+        self.breaker_states: Optional[Callable[[], Dict[str, str]]] = None
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "MetricsFederator":
@@ -409,5 +413,11 @@ class MetricsFederator:
                 "error": st.error,
                 "families": len(st.families),
             }
-        return {"time": now, "interval_seconds": self.interval,
-                "workers": workers, "last_failover": self.last_failover}
+        payload = {"time": now, "interval_seconds": self.interval,
+                   "workers": workers, "last_failover": self.last_failover}
+        if self.breaker_states is not None:
+            try:
+                payload["breakers"] = dict(self.breaker_states())
+            except Exception:  # noqa: BLE001 — diagnostics must not 500
+                pass
+        return payload

@@ -36,7 +36,6 @@ registration and is shared process-wide.
 from __future__ import annotations
 
 import math
-import os
 import sys
 import threading
 import time
@@ -46,6 +45,7 @@ from typing import Any, Dict, List, Optional
 
 from . import flight as _flight
 from . import metrics as _metrics
+from .env_registry import env_float as _env_float
 
 __all__ = [
     "Heartbeat", "register", "heartbeats", "stop", "running",
@@ -53,6 +53,7 @@ __all__ = [
     "get_interval_seconds", "set_interval_seconds",
     "dump_all_stacks", "report_training_metric", "scan_eval_history",
     "training_healthy", "reset_training_health", "stall_counts",
+    "add_event_callback",
 ]
 
 _STALL_ENV = "MMLSPARK_TPU_WATCHDOG_STALL_SECONDS"
@@ -65,13 +66,6 @@ DIVERGENCE_FACTOR = 2.0
 COLLAPSE_FACTOR = 5.0
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
 _stall_seconds = max(0.01, _env_float(_STALL_ENV, 30.0))
 _interval_seconds = _env_float(_INTERVAL_ENV, 0.0)  # 0 -> derived
 
@@ -81,6 +75,39 @@ _next_id = 0
 _thread: Optional[threading.Thread] = None
 _stop_evt = threading.Event()
 _stall_log: List[Dict[str, Any]] = []          # recent stalls (bounded)
+#: subscribers to watchdog events: cb(category, name, fields) fired on
+#: every stall episode (category "stall", name = heartbeat site) and
+#: every training-health event (category = event kind, name = model) —
+#: the hook training loops use to dump a last-good checkpoint when the
+#: watchdog declares the fit sick (see models/gbdt/booster.py)
+_event_callbacks: List[Any] = []
+
+
+def add_event_callback(cb) -> Any:
+    """Subscribe ``cb(category, name, fields)`` to stall/health events;
+    returns a zero-arg unsubscribe. Callbacks run on the emitting thread
+    (the sampler for stalls, the training loop for sentinels) and must
+    never raise — exceptions are swallowed."""
+    with _lock:
+        _event_callbacks.append(cb)
+
+    def _remove() -> None:
+        with _lock:
+            try:
+                _event_callbacks.remove(cb)
+            except ValueError:
+                pass
+    return _remove
+
+
+def _emit_event(category: str, name: str, **fields: Any) -> None:
+    with _lock:
+        cbs = list(_event_callbacks)
+    for cb in cbs:
+        try:
+            cb(category, name, fields)
+        except Exception:  # noqa: BLE001 — a sick callback must not
+            pass           # break the watchdog or the training loop
 
 
 def get_stall_seconds() -> float:
@@ -267,6 +294,7 @@ def _flag_stall(hb: Heartbeat, age: float) -> None:
         _stall_log.append({"site": hb.site, "age_seconds": round(age, 3),
                            "ts": time.time(), "dump": dump_path})
         del _stall_log[:-256]
+    _emit_event("stall", hb.site, age_seconds=round(age, 3))
 
 
 def _run() -> None:
@@ -346,6 +374,7 @@ def _unhealthy(model: str, kind: str, **fields: Any) -> None:
     _flight.record("training_health", model=model, event=kind, **fields)
     _logging.get_logger("mmlspark_tpu.watchdog").error(
         "training health: %s on %s", kind, model, model=model, **fields)
+    _emit_event(kind, model, **fields)
 
 
 def report_training_metric(model: str, iteration: int,

@@ -153,6 +153,9 @@ def barrier(name: str = "barrier") -> None:
     # 90 s floor: a wait up to the barrier's own 60 s timeout is legal
     # (one host finishing a long compile late); only a wait_at_barrier
     # that overruns its contract — a stuck coordination RPC — flags.
+    from ..robustness.failpoints import fault_point as _failpoint
     with _watchdog.register(f"barrier:{name}", stall_seconds=90.0), \
             _span(f"barrier.{name}", metric_label="barrier", barrier=name):
+        # chaos hook: a peer stuck (delay) or lost (error) at the barrier
+        _failpoint("barrier.wait")
         client.wait_at_barrier(name, timeout_in_ms=60_000)

@@ -19,12 +19,31 @@ import hashlib
 import os
 import pickle
 import re
+import threading
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..robustness.failpoints import fault_point as _failpoint
+
+
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.pkl$")
+
+
+class CheckpointMismatchError(RuntimeError):
+    """Strict-resume refusal: checkpoints exist in the directory but none
+    matches the run's data/config fingerprint. Raised (instead of the
+    default silent fresh start) when the caller demands resume, e.g.
+    ``MMLSPARK_TPU_STRICT_RESUME=1`` on a preempted training job — a
+    fleet restart that silently retrains from scratch would burn the
+    whole TPU reservation before anyone noticed.
+
+    Strict mode deliberately treats the directory as ONE run's (the
+    probe inspects across namespaces — config drift changes the
+    namespace, which is exactly what it must catch), so it is
+    incompatible with the shared-directory sweep pattern: point each
+    strict-resumed job at its own directory."""
 
 
 def data_fingerprint(*arrays, config: Any = None) -> str:
@@ -100,9 +119,17 @@ class CheckpointManager:
 
     def save(self, step: int, payload: Dict[str, Any]) -> str:
         path = self._path(step)
-        tmp = f"{path}.{os.getpid()}.tmp"
+        # pid AND thread id: the watchdog's emergency dump runs on the
+        # sampler thread of the SAME process as the training loop's
+        # periodic save — a pid-only suffix would let both interleave
+        # writes into one tmp file and publish a torn checkpoint
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
         with open(tmp, "wb") as f:
             pickle.dump({"step": step, **payload}, f)
+        # fault site: a crash here is a torn write — the tmp file exists
+        # but was never published, which is exactly what the atomic
+        # os.replace below is defending against
+        _failpoint("checkpoint.write", step=step)
         os.replace(tmp, path)           # atomic publish
         self._prune()
         return path
@@ -134,7 +161,8 @@ class CheckpointManager:
                 pass
 
     def latest_matching(self, fingerprint: str,
-                        purge_stale: bool = True
+                        purge_stale: bool = True,
+                        strict: bool = False
                         ) -> Optional[Tuple[int, Dict[str, Any]]]:
         """Newest checkpoint whose stored fingerprint matches.
 
@@ -143,8 +171,16 @@ class CheckpointManager:
         un-namespaced managers — any mismatching file) are removed when
         ``purge_stale`` so a higher-numbered stale file can't shadow the new
         run's checkpoints. Namespaced managers only ever see (and purge)
-        their own files, so concurrent runs sharing a directory are safe."""
+        their own files, so concurrent runs sharing a directory are safe.
+
+        ``strict``: when checkpoints exist but NONE matches, raise
+        :class:`CheckpointMismatchError` naming the expected and found
+        fingerprints instead of returning None — the resume-or-die mode
+        for preempted jobs where "silently start over" is the worst
+        outcome. Strict mode never purges (the evidence stays on disk).
+        """
         best = None
+        found: List[str] = []
         for step, name in self._files():
             path = os.path.join(self.directory, name)
             try:
@@ -154,9 +190,19 @@ class CheckpointManager:
                 continue
             if payload.get("fingerprint") == fingerprint:
                 best = (step, payload)
-            elif purge_stale:
-                try:
-                    os.remove(path)
-                except OSError:
-                    pass
+            else:
+                found.append(str(payload.get("fingerprint")))
+                if purge_stale and not strict:
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+        if strict and best is None and found:
+            raise CheckpointMismatchError(
+                f"no checkpoint in {self.directory!r} matches fingerprint "
+                f"{fingerprint!r} (found {sorted(set(found))}): the data, "
+                "config, or warm-start model changed since the interrupted "
+                "run. Refusing to resume under strict mode — retrain "
+                "deliberately (unset MMLSPARK_TPU_STRICT_RESUME) or point "
+                "checkpointDir elsewhere.")
         return best

@@ -1,0 +1,67 @@
+"""Echo serving worker for the chaos / drain acceptance tests.
+
+Mirrors ``serving_main worker`` (registry registration, ready-line,
+SIGTERM -> deregister + graceful drain) but serves a model-free echo
+transform, so the client can assert that every reply belongs to exactly
+the request that asked for it — the no-duplicate / no-cross-wiring
+check a real model's predictions can't provide. Runs whatever fault
+rules ``MMLSPARK_TPU_FAILPOINTS`` carries, like any worker process
+would.
+
+Usage: python -m tests._chaos_worker --registry DIR [--port N]
+"""
+
+import argparse
+import os
+import signal
+import threading
+import uuid
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tests._chaos_worker")
+    p.add_argument("--registry", required=True)
+    p.add_argument("--host", default="localhost")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--api-name", default="serving")
+    p.add_argument("--drain-settle-seconds", type=float, default=None)
+    args = p.parse_args(argv)
+
+    from mmlspark_tpu.io.distributed_serving import (ServiceRegistry,
+                                                     WorkerInfo)
+    from mmlspark_tpu.io.serving import ServingQuery, ServingServer
+    from mmlspark_tpu.observability import logging as _logging
+
+    pid = os.getpid()
+
+    def transform(ds):
+        return ds.with_column("reply", [
+            {"entity": {"i": (v or {}).get("i"), "pid": pid},
+             "statusCode": 200}
+            for v in ds["value"]])
+
+    registry = ServiceRegistry(args.registry)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *a: stop.set())
+
+    server = ServingServer(args.host, args.port, args.api_name)
+    query = ServingQuery(server, transform, max_batch=16,
+                         max_latency=0.005)
+    info = WorkerInfo(worker_id=uuid.uuid4().hex[:12], host=args.host,
+                      port=server.port, api_name=args.api_name)
+    query.start()
+    registry.register(info)
+    _logging.console(f"worker {info.worker_id} serving on "
+                     f"{server.host}:{server.port}")
+    try:
+        stop.wait()
+    finally:
+        registry.deregister(info.worker_id)
+        query.drain(settle_seconds=args.drain_settle_seconds)
+        _logging.console(f"worker {info.worker_id} drained")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

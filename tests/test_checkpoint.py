@@ -4,11 +4,14 @@ Covers the CheckpointManager primitives, GBDT mid-train resume (result must
 predict like an uninterrupted run), and exact-state SGD pass resume.
 """
 
+import threading
+
 import numpy as np
 import pytest
 
 from mmlspark_tpu.core.dataset import Dataset
-from mmlspark_tpu.utils.checkpoint import CheckpointManager
+from mmlspark_tpu.utils.checkpoint import (CheckpointManager,
+                                           CheckpointMismatchError)
 
 
 def test_manager_roundtrip_prune_atomic(tmp_path):
@@ -22,6 +25,101 @@ def test_manager_roundtrip_prune_atomic(tmp_path):
     # stray tmp files are never listed
     (tmp_path / "ck" / "ckpt_0000000001.pkl.123.tmp").write_bytes(b"junk")
     assert mgr.steps() == [11, 15]
+
+
+def test_retention_under_concurrent_writers(tmp_path):
+    """Newest-k pruning must hold (and never raise) when several writer
+    threads share one manager — the preempted-and-restarted-twice case
+    where two trainer generations briefly overlap on shared storage."""
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=3,
+                            namespace="aaaa11112222")
+    errors = []
+
+    def writer(tid):
+        try:
+            for step in range(tid, 40, 4):
+                mgr.save(step, {"w": step, "fingerprint": "fp"})
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    steps = mgr.steps()
+    # the newest checkpoint always survives; racing prunes may leave
+    # slightly fewer than keep, never more than keep + in-flight slack
+    assert 39 in steps and len(steps) <= 3, steps
+    # every surviving file is a complete, loadable checkpoint
+    for s in steps:
+        assert mgr.load(s)["w"] == s
+    # and a final quiescent save restores exactly newest-keep
+    mgr.save(40, {"w": 40, "fingerprint": "fp"})
+    assert len(mgr.steps()) <= 3 and max(mgr.steps()) == 40
+
+
+def test_concurrent_namespaces_prune_independently(tmp_path):
+    """Two namespaced runs hammering ONE directory concurrently: each
+    keeps its own newest-k and neither ever deletes the other's files."""
+    d = str(tmp_path / "shared")
+    m1 = CheckpointManager(d, keep=2, namespace="aaaa11112222")
+    m2 = CheckpointManager(d, keep=2, namespace="bbbb33334444")
+
+    def writer(mgr, fp):
+        for step in range(10):
+            mgr.save(step, {"fingerprint": fp})
+
+    t1 = threading.Thread(target=writer, args=(m1, "fp1"))
+    t2 = threading.Thread(target=writer, args=(m2, "fp2"))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert m1.steps() == [8, 9] and m2.steps() == [8, 9]
+    assert m1.latest_matching("fp1")[0] == 9
+    assert m2.latest_matching("fp2")[0] == 9
+
+
+def test_latest_matching_strict_raises_with_clear_error(tmp_path):
+    """Fingerprint mismatch under strict mode: a clear refusal naming
+    both fingerprints, and the mismatching evidence is NOT purged."""
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(3, {"fingerprint": "old-data-old-config"})
+    with pytest.raises(CheckpointMismatchError) as ei:
+        mgr.latest_matching("new-fingerprint", strict=True)
+    msg = str(ei.value)
+    assert "new-fingerprint" in msg and "old-data-old-config" in msg
+    assert mgr.steps() == [3], "strict probe must not purge evidence"
+    # default (non-strict) keeps the historical purge-and-start-fresh
+    assert mgr.latest_matching("new-fingerprint") is None
+    assert mgr.steps() == []
+
+
+def test_strict_on_empty_directory_is_fine(tmp_path):
+    """Strict mode only refuses when checkpoints EXIST but mismatch; an
+    empty directory is a legitimate fresh start."""
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    assert mgr.latest_matching("fp", strict=True) is None
+
+
+def test_checkpoint_write_failpoint_proves_atomicity(tmp_path):
+    """A crash injected between the payload write and the atomic publish
+    leaves the published set untouched — resumes only ever see complete
+    checkpoints."""
+    from mmlspark_tpu.robustness import failpoints
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=5)
+    mgr.save(1, {"w": 1})
+    failpoints.configure("checkpoint.write:error")
+    try:
+        with pytest.raises(failpoints.InjectedFault):
+            mgr.save(2, {"w": 2})
+    finally:
+        failpoints.clear()
+    assert mgr.steps() == [1], "torn write must not publish"
+    assert mgr.load(1)["w"] == 1
+    mgr.save(2, {"w": 2})                      # recovered writer works
+    assert mgr.steps() == [1, 2]
 
 
 def _gbdt_data(n=300, seed=5):

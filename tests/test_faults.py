@@ -1,0 +1,251 @@
+"""Fault injector: rule grammar, determinism, and the live fault sites.
+
+Covers robustness/failpoints.py and its wiring into io/serving.py,
+io/distributed_serving.py, and io/http.py:
+
+* spec grammar (kinds, durations, probabilities, @N pins) and loud
+  rejection of typos — a chaos config must never be silently
+  half-applied;
+* seeded determinism — the same spec + seed replays the same pattern
+  (the property that turns a chaos run into a regression test);
+* the byte-identity contract: with no rules configured, a LIVE serving
+  round-trip behaves exactly as without the injector;
+* each wired request-path site observed doing its job end-to-end
+  (synthetic errors, added latency, batch-loop crashes riding the
+  requeue path, gateway failover recovering injected worker-hop 503s).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from mmlspark_tpu.io.distributed_serving import DistributedServing
+from mmlspark_tpu.io.http import HTTPRequestData, send_request
+from mmlspark_tpu.io.serving import serve
+from mmlspark_tpu.observability import flight, metrics
+from mmlspark_tpu.robustness import failpoints
+from mmlspark_tpu.robustness.failpoints import InjectedFault, parse_spec
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    prev = metrics.set_enabled(True)
+    metrics.reset()
+    flight.clear()
+    failpoints.clear()
+    yield
+    failpoints.clear()
+    metrics.set_enabled(prev)
+    metrics.reset()
+    flight.clear()
+
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(url, data=json.dumps(payload).encode(),
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _echo_query(**kw):
+    return (serve().address("localhost", 0, "faulted")
+            .batch(8, 5)
+            .transform(lambda ds: ds.with_column("reply", [
+                {"entity": {"i": v["i"]}, "statusCode": 200}
+                for v in ds["value"]]))
+            .start())
+
+
+class TestGrammar:
+    def test_full_spec_round_trip(self):
+        rules = parse_spec("gateway.route:error_503:0.2,"
+                           "serving.handle:delay:250ms:0.1,"
+                           "serving.batch:error@1", seed=3)
+        assert [r.site for r in rules] == ["gateway.route", "serving.handle",
+                                          "serving.batch"]
+        assert rules[0].kind_label == "error_503" and rules[0].p == 0.2
+        assert rules[1].delay_s == pytest.approx(0.25)
+        assert rules[1].p == pytest.approx(0.1)
+        assert rules[2].kind == "error" and rules[2].at == 1
+
+    def test_seconds_duration_and_exit_code(self):
+        (r,) = parse_spec("http.send:delay:1.5s")
+        assert r.delay_s == pytest.approx(1.5)
+        (r,) = parse_spec("gbdt.round:exit:3@5")
+        assert r.kind == "exit" and r.exit_code == 3 and r.at == 5
+        (r,) = parse_spec("gbdt.round:exit")
+        assert r.exit_code == 17              # the default preemption code
+
+    def test_bare_number_duration_is_milliseconds(self):
+        (r,) = parse_spec("http.send:delay:40")
+        assert r.delay_s == pytest.approx(0.04)
+
+    @pytest.mark.parametrize("bad", [
+        "nope.site:error_503",            # unregistered site
+        "Serving.Handle:error_503",       # case matters: sites are [a-z_.]
+        "http.send:explode",              # unknown kind
+        "http.send:error_abc",            # non-numeric status
+        "http.send:error_700",            # status out of range
+        "http.send:delay",                # delay without a duration
+        "http.send:delay:0ms",            # delay must be positive
+        "http.send:error_503:2",          # probability out of [0,1]
+        "http.send:error_503:x",          # unparseable probability
+        "http.send:error_503@x",          # @N must be an integer
+        "http.send:error_503@0",          # @N is 1-based
+        "gbdt.round:exit:zz",             # bad exit code
+        "http.send",                      # no kind at all
+    ])
+    def test_typos_fail_loudly(self, bad):
+        with pytest.raises(ValueError):
+            parse_spec(bad)
+
+    def test_empty_spec_is_no_rules(self):
+        assert parse_spec("") == ()
+        assert parse_spec(" , ,") == ()
+
+
+class TestDeterminism:
+    def _pattern(self, seed, n=40):
+        failpoints.configure("http.send:error_503:0.5", seed=seed)
+        out = [failpoints.fault_point("http.send") is not None
+               for _ in range(n)]
+        failpoints.clear()
+        return out
+
+    def test_same_seed_same_pattern(self):
+        assert self._pattern(1) == self._pattern(1)
+
+    def test_seed_changes_pattern(self):
+        a, b = self._pattern(1), self._pattern(2)
+        assert a != b
+        assert any(a) and any(b)          # p=0.5 over 40 draws fires both
+
+    def test_at_pin_fires_on_exactly_that_hit(self):
+        failpoints.configure("http.send:error_503@3")
+        fired = [failpoints.fault_point("http.send") is not None
+                 for _ in range(5)]
+        assert fired == [False, False, True, False, False]
+        assert failpoints.hit_count("http.send") == 5
+
+    def test_at_pin_composes_with_probability(self):
+        """`site:kind:p@N` draws the RNG AT the pinned hit (the grammar
+        documents [:arg][@N] as composable) — and regardless of the
+        draw, no other hit can ever fire."""
+        outcomes = set()
+        for seed in range(8):
+            failpoints.configure("http.send:error_503:0.5@1", seed=seed)
+            outcomes.add(failpoints.fault_point("http.send") is not None)
+            assert not any(failpoints.fault_point("http.send") is not None
+                           for _ in range(5))
+        assert outcomes == {True, False}   # p=0.5 over 8 seeds sees both
+
+    def test_error_rule_raises(self):
+        failpoints.configure("serving.batch:error@1")
+        with pytest.raises(InjectedFault) as ei:
+            failpoints.fault_point("serving.batch")
+        assert ei.value.site == "serving.batch" and ei.value.hit == 1
+        assert failpoints.fault_point("serving.batch") is None  # @1 spent
+
+    def test_env_lazy_load(self, monkeypatch):
+        monkeypatch.setenv(failpoints.FAILPOINTS_ENV,
+                           "http.send:error_418@1")
+        failpoints._rules = None          # simulate a fresh process
+        act = failpoints.fault_point("http.send")
+        assert act is not None and act.status == 418
+
+
+class TestByteIdentity:
+    def test_unset_faults_live_round_trip_identical(self):
+        """No rules configured: a live serving round-trip answers exactly
+        the uninstrumented reply and the injector leaves no trace."""
+        q = _echo_query()
+        try:
+            status, body = _post(q.server.url, {"i": 11})
+            assert status == 200
+            assert json.loads(body) == {"i": 11}
+        finally:
+            q.stop()
+        text = metrics.get_registry().render_prometheus()
+        assert "failpoints_fired_total" not in text
+        assert not any(e["kind"] == "failpoint"
+                       for e in flight.events())
+        assert failpoints.fault_point("serving.handle") is None
+
+
+@pytest.mark.chaos
+class TestLiveSites:
+    def test_serving_handle_error(self):
+        failpoints.configure("serving.handle:error_503@1")
+        q = _echo_query()
+        try:
+            status, _ = _post(q.server.url, {"i": 0})
+            assert status == 503
+            status, body = _post(q.server.url, {"i": 1})
+            assert status == 200 and json.loads(body) == {"i": 1}
+        finally:
+            q.stop()
+        assert metrics.counter("failpoints_fired_total",
+                               site="serving.handle",
+                               kind="error_503").value == 1.0
+        assert any(e["kind"] == "failpoint"
+                   and e["site"] == "serving.handle"
+                   for e in flight.events())
+
+    def test_serving_handle_delay(self):
+        failpoints.configure("serving.handle:delay:200ms@1")
+        q = _echo_query()
+        try:
+            t0 = time.monotonic()
+            status, body = _post(q.server.url, {"i": 2})
+            dt = time.monotonic() - t0
+            assert status == 200 and json.loads(body) == {"i": 2}
+            assert dt >= 0.2
+        finally:
+            q.stop()
+
+    def test_batch_loop_crash_rides_requeue(self):
+        failpoints.configure("serving.batch:error@1")
+        q = _echo_query()
+        try:
+            status, body = _post(q.server.url, {"i": 5})
+            # the first batch crashed, the requeued retry answered
+            assert status == 200 and json.loads(body) == {"i": 5}
+        finally:
+            q.stop()
+        assert metrics.counter("serving_requeues_total",
+                               api="faulted").value >= 1.0
+        kinds = [e["kind"] for e in flight.events()]
+        assert "failpoint" in kinds and "requeue" in kinds
+
+    def test_gateway_route_error_fails_over(self):
+        failpoints.configure("gateway.route:error_503@1")
+        d = DistributedServing(
+            lambda ds: ds.with_column("reply", [
+                {"entity": {"i": v["i"]}, "statusCode": 200}
+                for v in ds["value"]]),
+            num_workers=2).start()
+        try:
+            status, body = _post(d.url, {"i": 9})
+            # the injected worker-hop 503 was retried on another worker
+            assert status == 200 and json.loads(body) == {"i": 9}
+        finally:
+            d.stop()
+        assert metrics.counter("gateway_retries_total", api="serving",
+                               reason="status_503").value == 1.0
+
+    def test_http_send_error_without_network(self):
+        failpoints.configure("http.send:error_503")
+        resp = send_request(HTTPRequestData(
+            url="http://localhost:1/never-dialed"))
+        assert resp.status_code == 503 and resp.reason == "injected fault"
+
+    def test_http_send_connection_style_error(self):
+        failpoints.configure("http.send:error_0@1")
+        resp = send_request(HTTPRequestData(
+            url="http://localhost:1/never-dialed"))
+        assert resp.status_code == 0

@@ -169,6 +169,60 @@ class TestFunnelRules:
         assert [f.line for f in got] == [1, 2], active
         assert [f.line for f in suppressed] == [3]
 
+    def test_deadline_header_literal(self, tmp_path):
+        active, suppressed = run_rule(tmp_path, "deadline-header-literal", {
+            "mmlspark_tpu/robustness/policy.py":
+                'DEADLINE_HEADER = "X-Deadline-Ms"\n',
+            "mmlspark_tpu/io/hop.py": """\
+                H = "X-Deadline-Ms"
+                L = "x-deadline-ms"
+                OK = "x-deadline-ms"  # graftlint: disable=deadline-header-literal (test)
+            """})
+        got = hits(active, "deadline-header-literal",
+                   "mmlspark_tpu/io/hop.py")
+        assert [f.line for f in got] == [1, 2], active
+        assert [f.line for f in suppressed] == [3]
+        # the defining module is sanctioned
+        assert not hits(active, "deadline-header-literal",
+                        "mmlspark_tpu/robustness/policy.py")
+
+    def test_retry_sleep_funnel(self, tmp_path):
+        active, suppressed = run_rule(tmp_path, "retry-sleep-funnel", {
+            "mmlspark_tpu/robustness/policy.py":
+                "def backoff(attempt):\n    pass\n",
+            "mmlspark_tpu/io/client.py": """\
+                import time
+
+                def fetch(send):
+                    for attempt in range(3):
+                        resp = send()
+                        if resp:
+                            return resp
+                        time.sleep(2 ** attempt)
+
+                def poll(ready):
+                    while not ready():
+                        time.sleep(0.1)  # graftlint: disable=retry-sleep-funnel (test)
+
+                def one_shot():
+                    time.sleep(0.5)      # not in a loop: out of scope
+            """,
+            "mmlspark_tpu/models/trainer.py": """\
+                import time
+
+                def wait():
+                    while True:
+                        time.sleep(1.0)
+            """})
+        got = hits(active, "retry-sleep-funnel",
+                   "mmlspark_tpu/io/client.py")
+        assert [f.line for f in got] == [8], active
+        assert [f.line for f in suppressed] == [12]
+        # the rule scopes io/ only — a training-loop sleep is not a
+        # retry-path concern
+        assert not hits(active, "retry-sleep-funnel",
+                        "mmlspark_tpu/models/trainer.py")
+
 
 # --------------------------------------------------------------------------
 # metric rules
@@ -710,6 +764,66 @@ def test_env_var_registry(tmp_path):
     assert "MMLSPARK_TPU_NATIVE_ONLY" not in msgs   # where="native": exempt
     assert [f.line for f in suppressed] == [12]
     assert "MMLSPARK_TPU_V0" not in msgs      # declared AND read: clean
+
+
+# --------------------------------------------------------------------------
+# failpoint-site-grammar
+# --------------------------------------------------------------------------
+
+_SEED_FAILPOINTS = """\
+    SITES = {
+        "serving.handle": "worker HTTP handler",
+        "dead.site": "registered but wired nowhere",
+    }
+
+    def fault_point(site, **ctx):
+        return None
+"""
+
+
+def test_failpoint_site_grammar(tmp_path):
+    active, suppressed = run_rule(tmp_path, "failpoint-site-grammar", {
+        "mmlspark_tpu/robustness/failpoints.py": _SEED_FAILPOINTS,
+        "mmlspark_tpu/io/serving.py": """\
+            from ..robustness.failpoints import fault_point as _failpoint
+
+            def handle(which):
+                _failpoint("serving.handle")
+                _failpoint("serving.hanlde")
+                _failpoint("Serving.Handle")
+                _failpoint(which)
+                _failpoint("nope.site")  # graftlint: disable=failpoint-site-grammar (test)
+        """})
+    got = hits(active, "failpoint-site-grammar",
+               "mmlspark_tpu/io/serving.py")
+    # the typo'd site, the grammar violation, and the non-literal arg —
+    # the correctly wired literal on line 4 is clean
+    assert [f.line for f in got] == [5, 6, 7], active
+    assert "serving.hanlde" in got[0].message
+    assert "grammar" in got[1].message
+    assert "non-literal" in got[2].message
+    assert [f.line for f in suppressed] == [8]
+    # the registered-but-unwired site flags at its SITES entry
+    reg = hits(active, "failpoint-site-grammar",
+               "mmlspark_tpu/robustness/failpoints.py")
+    assert len(reg) == 1 and "dead.site" in reg[0].message, active
+
+
+def test_failpoint_site_grammar_rot(tmp_path):
+    """failpoints.py losing its literal SITES dict is lint-rot, not a
+    silent pass."""
+    active, _sup = run_rule(tmp_path, "failpoint-site-grammar", {
+        "mmlspark_tpu/robustness/failpoints.py":
+            "def fault_point(site, **ctx):\n    return None\n",
+        "mmlspark_tpu/io/serving.py": """\
+            from ..robustness.failpoints import fault_point as _failpoint
+
+            def handle():
+                _failpoint("anything.here")
+        """})
+    rot = [f for f in active if f.rule == "failpoint-site-grammar"
+           and "lint-rot" in f.message]
+    assert rot, active
 
 
 # --------------------------------------------------------------------------

@@ -106,9 +106,10 @@ def test_serving_main_worker_and_gateway(tmp_path):
 
 
 class TestBenchRegression:
-    """tools/bench_regression.py compares the two newest BENCH_r*.json
-    and gates on >20% throughput drops — exercised on synthetic fixtures
-    (the real rounds carry relay jitter and must not gate the suite)."""
+    """tools/bench_regression.py gates the newest BENCH_r*.json against
+    the median of up to the 3 preceding rounds (>20% throughput drops) —
+    exercised on synthetic fixtures (the real rounds carry relay jitter
+    and must not gate the suite)."""
 
     def _write_round(self, d, n, line):
         # the driver wrapper shape: bench stdout lives in "tail", last
@@ -165,6 +166,62 @@ class TestBenchRegression:
                                         "gbdt_predict_rows_per_sec": 100.0})
         self._write_round(tmp_path, 2, {"value": 3.0,
                                         "gbdt_predict_rows_per_sec": 95.0})
+        r = self._run(tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_median_absorbs_one_hot_outlier_round(self, tmp_path):
+        # the r04->r05 false flag: one anomalously FAST round must not
+        # become the bar every later round is measured against
+        for n, v in ((1, 100.0), (2, 104.0), (3, 160.0)):   # r3 = outlier
+            self._write_round(tmp_path, n, {"metric": "m", "value": 1.0,
+                                            "quantized_trees_per_sec": v})
+        self._write_round(tmp_path, 4, {"metric": "m", "value": 1.0,
+                                        "quantized_trees_per_sec": 98.0})
+        r = self._run(tmp_path)
+        # vs r3 alone: 39% drop, a false flag; vs median 104: 5.8%, fine
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "median(r01,r02,r03)" in r.stdout
+
+        # a drop below the MEDIAN still gates — the window absorbs
+        # jitter, not sustained regressions
+        self._write_round(tmp_path, 5, {"metric": "m", "value": 1.0,
+                                        "quantized_trees_per_sec": 60.0})
+        r = self._run(tmp_path)
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "quantized_trees_per_sec" in r.stdout
+
+    def test_even_window_takes_lower_median(self, tmp_path):
+        # two baseline rounds at 100 and 130: the LOWER middle (100) is
+        # the bar, so 85 is a 15% drop, not a 34.6% flag
+        self._write_round(tmp_path, 1, {"x_per_sec": 100.0})
+        self._write_round(tmp_path, 2, {"x_per_sec": 130.0})
+        self._write_round(tmp_path, 3, {"x_per_sec": 85.0})
+        r = self._run(tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_window_flag_narrows_baseline(self, tmp_path):
+        for n, v in ((1, 500.0), (2, 500.0), (3, 100.0)):
+            self._write_round(tmp_path, n, {"x_per_sec": v})
+        self._write_round(tmp_path, 4, {"x_per_sec": 95.0})
+        # --window 1 = the old previous-round-only behaviour
+        assert self._run(tmp_path, "--window", "1").returncode == 0
+        # the full window medians to 500 -> 81% drop
+        assert self._run(tmp_path).returncode == 1
+
+    def test_unparseable_baseline_round_shrinks_window(self, tmp_path):
+        (tmp_path / "BENCH_r01.json").write_text("no json here\n")
+        self._write_round(tmp_path, 2, {"x_per_sec": 100.0})
+        self._write_round(tmp_path, 3, {"x_per_sec": 97.0})
+        r = self._run(tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "skipping unparseable baseline" in r.stderr
+
+    def test_mixed_metric_window_never_gates_value(self, tmp_path):
+        # a window mixing a TPU round and a CPU fallback must drop the
+        # headline "value" from the baseline entirely
+        self._write_round(tmp_path, 1, {"metric": "tpu_m", "value": 30.0})
+        self._write_round(tmp_path, 2, {"metric": "cpu_m", "value": 3.0})
+        self._write_round(tmp_path, 3, {"metric": "tpu_m", "value": 4.0})
         r = self._run(tmp_path)
         assert r.returncode == 0, r.stdout + r.stderr
 

@@ -380,7 +380,12 @@ class TestServingEdge:
             assert "X-Request-Id" not in hdrs
         assert flight.events() == []
         metrics.set_enabled(True)
-        assert metrics.get_registry().snapshot() == {}
+        # nothing from the disabled window may appear; the batch thread's
+        # idle poll ticks every max_latency and may legally re-record the
+        # queue-depth gauge in the instant after re-enable, so only that
+        # family is tolerated here
+        families = set(metrics.get_registry().snapshot())
+        assert families <= {"serving_queue_depth"}, families
 
     def test_unknown_reply_counted(self, serving_query):
         server = serving_query.server

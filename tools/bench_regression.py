@@ -1,15 +1,21 @@
 #!/usr/bin/env python
 """Round-over-round bench regression gate.
 
-Compares the two newest ``BENCH_r*.json`` files in the repo root (or the
-directory given as the first argument): each file is a driver wrapper
-object whose ``tail`` holds the bench run's stdout, where the LAST JSON
-line is the round's metrics (bench.py's last-line-wins convention; a
-bare JSON-line file is accepted too). Throughput keys shared by both
-rounds — ``value`` (when both rounds report the same ``metric`` name)
-and every ``*_per_sec`` / ``*_rps`` key — must not drop more than the
-threshold (default 20%). Keys that are missing, non-numeric, or <= 0 in
-either round (failed secondaries report -1) are skipped.
+Gates the newest ``BENCH_r*.json`` file in the repo root (or the
+directory given as the first argument) against the MEDIAN of up to the
+three rounds preceding it: each file is a driver wrapper object whose
+``tail`` holds the bench run's stdout, where the LAST JSON line is the
+round's metrics (bench.py's last-line-wins convention; a bare JSON-line
+file is accepted too). A single-round baseline is one relay-jitter
+sample away from a false flag (r04->r05 flagged quantized_* secondaries
+~30% "down" on jitter alone); the median of a short window absorbs one
+outlier round in either direction. On an even window the LOWER middle
+value is taken — ties break toward not flagging. Throughput keys shared
+by the baseline and the newest round — ``value`` (when every baseline
+round and the newest report the same ``metric`` name) and every
+``*_per_sec`` / ``*_rps`` key — must not drop more than the threshold
+(default 20%). Keys that are missing, non-numeric, or <= 0 in a round
+(failed secondaries report -1) are skipped in that round.
 
 Exit status: 0 = no regression (or fewer than two rounds to compare),
 1 = at least one key regressed, 2 = usage/parse error. Wired as a fast
@@ -95,6 +101,51 @@ def _comparable_keys(prev: Dict, cur: Dict) -> List[str]:
     return sorted(set(keys))
 
 
+def _low_median(xs: List[float]) -> float:
+    """Median taking the LOWER middle value on even windows — with two
+    baseline rounds a tie breaks toward the slower one, so one fast
+    outlier round cannot manufacture a regression flag."""
+    xs = sorted(xs)
+    return xs[(len(xs) - 1) // 2]
+
+
+def baseline(rounds: List[Dict]) -> Dict:
+    """Fold a window of previous rounds into one synthetic baseline:
+    per shared throughput key, the low-median of the rounds that report
+    a usable (numeric, > 0) value. ``metric``/``value`` participate only
+    when EVERY window round names the same metric — a window mixing a
+    TPU round with a CPU fallback must not gate the headline at all."""
+    out: Dict = {}
+    keys = set()
+    for r in rounds:
+        keys.update(k for k in r if _RATE_RE.match(k))
+    for key in keys:
+        vals = []
+        for r in rounds:
+            try:
+                v = float(r[key])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if v > 0:
+                vals.append(v)
+        if vals:
+            out[key] = _low_median(vals)
+    metrics = {r.get("metric") for r in rounds}
+    if len(metrics) == 1 and None not in metrics:
+        vals = []
+        for r in rounds:
+            try:
+                v = float(r["value"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if v > 0:
+                vals.append(v)
+        if vals:
+            out["metric"] = metrics.pop()
+            out["value"] = _low_median(vals)
+    return out
+
+
 def compare(prev: Dict, cur: Dict, threshold: float) -> List[str]:
     """Human-readable regression lines (empty = pass)."""
     out = []
@@ -123,27 +174,52 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="directory holding BENCH_r*.json (default: repo root)")
     p.add_argument("--threshold", type=float, default=0.2,
                    help="max allowed fractional drop (default 0.2 = 20%%)")
+    p.add_argument("--window", type=int, default=3,
+                   help="baseline rounds preceding the newest to take "
+                        "the median over (default 3)")
     args = p.parse_args(argv)
+    if args.window < 1:
+        print("bench_regression: --window must be >= 1", file=sys.stderr)
+        return 2
 
     rounds = _rounds(args.directory)
     if len(rounds) < 2:
         print(f"bench_regression: {len(rounds)} round(s) in "
               f"{args.directory}; nothing to compare")
         return 0
-    (n_prev, p_prev), (n_cur, p_cur) = rounds[-2], rounds[-1]
-    prev, cur = _bench_line(p_prev), _bench_line(p_cur)
-    if prev is None or cur is None:
-        print(f"bench_regression: no parseable bench line in "
-              f"{p_prev if prev is None else p_cur}", file=sys.stderr)
+    (n_cur, p_cur) = rounds[-1]
+    cur = _bench_line(p_cur)
+    if cur is None:
+        print(f"bench_regression: no parseable bench line in {p_cur}",
+              file=sys.stderr)
         return 2
+    window = rounds[-1 - args.window:-1]
+    prev_lines, prev_names = [], []
+    for n_prev, p_prev in window:
+        line = _bench_line(p_prev)
+        if line is None:
+            # an unparseable baseline round shrinks the window rather
+            # than failing the gate — the newest round is what's judged
+            print(f"bench_regression: skipping unparseable baseline "
+                  f"{p_prev}", file=sys.stderr)
+            continue
+        prev_lines.append(line)
+        prev_names.append(f"r{n_prev:02d}")
+    if not prev_lines:
+        print(f"bench_regression: no parseable baseline among "
+              f"{[p for _, p in window]}", file=sys.stderr)
+        return 2
+    prev = baseline(prev_lines)
+    label = f"median({','.join(prev_names)})" if len(prev_names) > 1 \
+        else prev_names[0]
     regressions = compare(prev, cur, args.threshold)
     if regressions:
-        print(f"bench_regression: r{n_cur:02d} regressed vs r{n_prev:02d}:")
+        print(f"bench_regression: r{n_cur:02d} regressed vs {label}:")
         for line in regressions:
             print(f"  {line}")
         return 1
     keys = _comparable_keys(prev, cur)
-    print(f"bench_regression: r{n_cur:02d} vs r{n_prev:02d} OK "
+    print(f"bench_regression: r{n_cur:02d} vs {label} OK "
           f"({len(keys)} shared throughput keys within "
           f"{args.threshold * 100:.0f}%)")
     return 0

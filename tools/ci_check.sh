@@ -1,10 +1,14 @@
 #!/usr/bin/env bash
 # Standalone static-analysis lane (no pytest, no jax): graftlint over
 # the whole tree with machine-readable output, plus the env-var docs
-# drift gate. Exit nonzero on any unsuppressed finding or drifted table.
+# drift gate and a seeded-chaos smoke (a live fault-injected serving
+# round-trip proving the failpoint plane fires, recovers, and replays
+# deterministically). Exit nonzero on any unsuppressed finding,
+# drifted table, or chaos-smoke failure.
 #
 #   tools/ci_check.sh            # human summary + JSON artifact
 #   GRAFTLINT_JSON=out.json tools/ci_check.sh
+#   CI_SKIP_CHAOS=1 tools/ci_check.sh   # lint/docs gates only
 set -u -o pipefail
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -33,8 +37,68 @@ fi
 
 (cd "$ROOT" && python tools/gen_env_docs.py --check) || rc=1
 
+if [ "${CI_SKIP_CHAOS:-0}" != "1" ]; then
+    if (cd "$ROOT" && python - <<'EOF'
+import json
+import urllib.error
+import urllib.request
+
+from mmlspark_tpu.io.serving import serve
+from mmlspark_tpu.observability import flight, metrics
+from mmlspark_tpu.robustness import failpoints
+
+metrics.set_enabled(True)
+
+# deterministic replay: the same spec + seed draws the same pattern
+def pattern(seed):
+    failpoints.configure("http.send:error_503:0.5", seed=seed)
+    out = [failpoints.fault_point("http.send") is not None
+           for _ in range(32)]
+    failpoints.clear()
+    return out
+
+assert pattern(11) == pattern(11), "seeded chaos did not replay"
+
+# live smoke: one injected 503 at admission, then clean recovery
+failpoints.configure("serving.handle:error_503@1", seed=11)
+q = (serve().address("localhost", 0, "ci_chaos").batch(8, 5)
+     .transform(lambda ds: ds.with_column("reply", [
+         {"entity": {"i": v["i"]}, "statusCode": 200}
+         for v in ds["value"]])).start())
+try:
+    def post(payload):
+        req = urllib.request.Request(
+            q.server.url, data=json.dumps(payload).encode(), method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    status, _ = post({"i": 0})
+    assert status == 503, f"injected fault not served: {status}"
+    status, body = post({"i": 1})
+    assert status == 200 and json.loads(body) == {"i": 1}, \
+        f"recovery failed: {status} {body!r}"
+finally:
+    q.stop()
+
+assert metrics.counter("failpoints_fired_total", site="serving.handle",
+                       kind="error_503").value == 1.0
+assert any(e["kind"] == "failpoint" and e["site"] == "serving.handle"
+           for e in flight.events()), "fault missing from the flight ring"
+print("chaos smoke: injected 503 served, recovery clean, replay deterministic")
+EOF
+    ); then
+        :
+    else
+        echo "ci_check: chaos smoke FAILED" >&2
+        rc=1
+    fi
+fi
+
 if [ "$rc" -ne 0 ]; then
-    echo "ci_check: FAILED (graftlint findings or env-docs drift)" >&2
+    echo "ci_check: FAILED (graftlint findings, env-docs drift, or chaos smoke)" >&2
 else
     echo "ci_check: clean"
 fi

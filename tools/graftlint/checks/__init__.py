@@ -9,3 +9,4 @@ from . import cachekey       # noqa: F401
 from . import resources      # noqa: F401
 from . import locks          # noqa: F401
 from . import envvars        # noqa: F401
+from . import failpoints    # noqa: F401

@@ -1,0 +1,116 @@
+"""failpoint-site-grammar: fault-injection sites are a closed, wired set.
+
+``robustness/failpoints.py`` registers every injection site in its
+``SITES`` dict; ``fault_point("<site>")`` call sites across the package
+must name exactly those sites. Three failure modes, all caught here:
+
+* a call-site literal that is not in ``SITES`` (or violates the
+  ``[a-z_.]+`` grammar) would parse-fail a chaos spec or, worse, never
+  fire — the typo'd chaos run reads as "survived the fault";
+* a registered site that no production code evaluates is dead registry —
+  a chaos spec targeting it silently injects nothing;
+* a call site passing a non-literal first argument defeats the static
+  pin entirely.
+
+The checker anchors on the ``SITES`` dict itself (renamed away =
+lint-rot, not a silent pass).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..core import Checker, CheckerRotError, Finding, Repo, register
+
+_SITE_RE = re.compile(r"^[a-z_.]+$")
+_FAILPOINTS_REL = "mmlspark_tpu/robustness/failpoints.py"
+#: names a call site may bind fault_point to (the package convention:
+#: module access ``_failpoints.fault_point(...)`` or the aliased import
+#: ``from ..robustness.failpoints import fault_point as _failpoint``)
+_CALL_NAMES = frozenset({"fault_point", "_failpoint"})
+
+
+def _registered_sites(repo: Repo) -> Tuple[Dict[str, int], int]:
+    """(site -> lineno, SITES dict lineno) parsed from failpoints.py."""
+    mod = repo.module(_FAILPOINTS_REL)
+    if mod is None:
+        raise CheckerRotError(f"{_FAILPOINTS_REL} is gone")
+    for node in ast.walk(mod.tree):
+        target = None
+        if isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            target = node.target.id
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+        if target != "SITES" or not isinstance(node.value, ast.Dict):
+            continue
+        sites: Dict[str, int] = {}
+        for key in node.value.keys:
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                sites[key.value] = key.lineno
+        if sites:
+            return sites, node.value.lineno
+    raise CheckerRotError(
+        f"no literal SITES dict found in {_FAILPOINTS_REL}")
+
+
+class FailpointSiteChecker(Checker):
+    rule = "failpoint-site-grammar"
+    description = ("fault_point call-site literals match the registered "
+                   "SITES set (and every site is wired)")
+
+    def check(self, repo: Repo) -> Iterator[Finding]:
+        sites, sites_line = _registered_sites(repo)
+        wired: set = set()
+        for mod in repo.package():
+            if mod.rel == _FAILPOINTS_REL:
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                name = (fn.id if isinstance(fn, ast.Name)
+                        else fn.attr if isinstance(fn, ast.Attribute)
+                        else None)
+                if name not in _CALL_NAMES:
+                    continue
+                site = self._site_arg(node)
+                if site is None:
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"{name}() with a non-literal site — the static "
+                        "pin needs a string literal from failpoints.SITES")
+                    continue
+                if not _SITE_RE.match(site):
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"site {site!r} violates the [a-z_.]+ grammar")
+                elif site not in sites:
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"site {site!r} is not registered in "
+                        f"failpoints.SITES (registered: {sorted(sites)})")
+                else:
+                    wired.add(site)
+        for site in sorted(set(sites) - wired):
+            yield Finding(
+                self.rule, _FAILPOINTS_REL, sites.get(site, sites_line),
+                f"registered site {site!r} is wired nowhere in the "
+                "package — a chaos spec targeting it silently injects "
+                "nothing")
+
+    @staticmethod
+    def _site_arg(call: ast.Call) -> Optional[str]:
+        args: List[ast.expr] = list(call.args)
+        if not args:
+            return None
+        first = args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return first.value
+        return None
+
+
+register(FailpointSiteChecker())

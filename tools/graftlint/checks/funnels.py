@@ -22,6 +22,14 @@ walks in ``tests/test_lint.py``:
   ``observability/tracing.py`` (TRACEPARENT_HEADER / REQUEST_ID_HEADER);
   a string literal at any other call site can drift per hop and break
   cross-process stitching.
+* ``deadline-header-literal`` — the ``X-Deadline-Ms`` wire contract
+  lives in ``robustness/policy.py`` (DEADLINE_HEADER); a re-spelled
+  literal at another hop silently breaks deadline propagation the same
+  way a drifted trace header breaks stitching.
+* ``retry-sleep-funnel`` — a bare ``time.sleep`` inside a loop under
+  ``io/`` is an unjittered, deadline-blind retry (or a poll that should
+  ride an Event); the sanctioned delays are ``robustness/policy.py``'s
+  ``backoff`` / ``RetryPolicy.sleep_before``.
 """
 
 from __future__ import annotations
@@ -31,7 +39,7 @@ from dataclasses import dataclass
 from typing import Callable, Iterator, List, Optional, Tuple
 
 from ..core import (Checker, CheckerRotError, Finding, Module, Repo,
-                    register)
+                    call_name, loop_body_nodes, register)
 
 #: (line, detail) pairs a matcher reports for one module
 Matches = Iterator[Tuple[int, str]]
@@ -84,6 +92,26 @@ def _match_trace_headers(mod: Module) -> Matches:
         if isinstance(node, ast.Constant) and isinstance(node.value, str) \
                 and node.value.strip().lower() in _TRACE_HEADERS:
             yield node.lineno, repr(node.value)
+
+
+def _match_deadline_header(mod: Module) -> Matches:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and node.value.strip().lower() == "x-deadline-ms":
+            yield node.lineno, repr(node.value)
+
+
+def _match_loop_sleep(mod: Module) -> Matches:
+    owner = mod.owner_map()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        for inner in loop_body_nodes(node):
+            if isinstance(inner, ast.Call):
+                qual, name = call_name(inner)
+                if name == "sleep" and qual == "time":
+                    yield inner.lineno, \
+                        f"time.sleep in a loop in {owner.get(inner)}()"
 
 
 @dataclass(frozen=True)
@@ -159,6 +187,28 @@ FUNNEL_RULES: Tuple[FunnelRule, ...] = (
         match=_match_trace_headers,
         remedy="use tracing.TRACEPARENT_HEADER / tracing.REQUEST_ID_HEADER",
         anchors=(("mmlspark_tpu/observability/tracing.py", None),),
+    ),
+    FunnelRule(
+        rule="deadline-header-literal",
+        description="the X-Deadline-Ms header name only from "
+                    "robustness.policy.DEADLINE_HEADER",
+        scope=("mmlspark_tpu",),
+        allow=("mmlspark_tpu/robustness/policy.py",),
+        match=_match_deadline_header,
+        remedy="use robustness.policy.DEADLINE_HEADER (a re-spelled "
+               "literal silently breaks deadline propagation at that hop)",
+        anchors=(("mmlspark_tpu/robustness/policy.py", None),),
+    ),
+    FunnelRule(
+        rule="retry-sleep-funnel",
+        description="no bare time.sleep inside io/ loop bodies (retry "
+                    "delays go through robustness.policy)",
+        scope=("mmlspark_tpu/io",),
+        allow=(),
+        match=_match_loop_sleep,
+        remedy="route retry delays through robustness.policy.backoff / "
+               "RetryPolicy.sleep_before, and waits through an Event",
+        anchors=(("mmlspark_tpu/robustness/policy.py", "backoff"),),
     ),
 )
 
