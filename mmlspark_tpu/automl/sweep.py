@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import PartitionSpec as P
+from ..parallel.placement import pspec as P
 
 from ..core.dataset import Dataset
 from ..parallel.compat import shard_map
@@ -170,6 +170,11 @@ def swept_fit(est, param_maps: List[Dict[str, Any]],
     mesh = meshlib.get_default_mesh()
     axis = mesh.axis_names[0]
     D = mesh.shape[axis]
+    # placement decision: the sweep replicates the DATASET and shards the
+    # TRIAL axis — the inverse of the training-path row sharding
+    from ..parallel import placement
+    placement.plan_for("automl.sweep", mesh=mesh, replicate=True,
+                       what="trial_axis_sharded")
     T = len(param_maps)
     T_pad = -(-T // D) * D
 

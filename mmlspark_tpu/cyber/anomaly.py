@@ -94,10 +94,11 @@ def als_fit(user_idx: np.ndarray, item_idx: np.ndarray, rating: np.ndarray,
     import jax
     import jax.numpy as jnp
     from jax import lax
-    from jax.sharding import PartitionSpec as P
 
     from ..parallel import mesh as meshlib
+    from ..parallel import placement
     from ..parallel.compat import shard_map
+    from ..parallel.placement import pspec as P
 
     nnz = len(rating)
     key = jax.random.PRNGKey(seed)
@@ -112,6 +113,7 @@ def als_fit(user_idx: np.ndarray, item_idx: np.ndarray, rating: np.ndarray,
                  if mesh is not None and meshlib.DATA_AXIS in mesh.shape
                  else None)
     nshards = mesh.shape[data_axis] if data_axis else 1
+    placement.plan_for("als.fit", mesh=mesh, rows=nnz)
     n_pad = -(-max(nnz, 1) // nshards) * nshards
     pad = n_pad - nnz
 

@@ -124,12 +124,17 @@ class DNNModel(Model, HasInputCol, HasOutputCol, HasBatchSize):
                     _, acts = self.apply_fn(p, x, capture=[node])
                     return acts[node]
             mesh = get_default_mesh()
-            if mesh is not None and np.prod(list(mesh.shape.values())) > 1:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-                data_axis = list(mesh.shape.keys())[0]
+            from ...parallel import placement
+            # rows shard over the mesh's LEADING axis (the historical
+            # behavior — scoring follows whatever topology the mesh leads
+            # with, data-parallel or not); plan_for counts shards on that
+            # same axis so the logged decision matches the placement
+            lead_axis = list(mesh.shape.keys())[0]
+            plan = placement.plan_for("dnn.transform", mesh=mesh,
+                                      axis=lead_axis)
+            if plan.decision == "shard_rows":
                 jfn = jax.jit(fn, in_shardings=(
-                    NamedSharding(mesh, P()),
-                    NamedSharding(mesh, P(data_axis))))
+                    plan.replicated(), plan.batch()))
             else:
                 jfn = jax.jit(fn)
             self._compiled[node] = jfn

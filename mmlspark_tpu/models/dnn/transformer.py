@@ -26,8 +26,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
+from ...parallel import placement
+from ...parallel.placement import pspec as P
 from ...parallel.ring_attention import (ring_attention,
                                         zigzag_ring_attention)
 from ...parallel.compat import axis_size as compat_axis_size, shard_map
@@ -304,12 +306,10 @@ def make_forward(cfg: TransformerConfig, mesh: Mesh, causal: bool = True):
 
 
 def shard_params(params, cfg: TransformerConfig, mesh: Mesh):
-    specs = param_specs(cfg)
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+    placement.plan_for("transformer.fit", mesh=mesh, what="params_tp")
+    return placement.put_tree(params, param_specs(cfg), mesh)
 
 
 def shard_opt_state(opt_state, cfg: TransformerConfig, mesh: Mesh):
     specs = {"mu": param_specs(cfg), "nu": param_specs(cfg), "count": P()}
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), opt_state, specs)
+    return placement.put_tree(opt_state, specs, mesh)

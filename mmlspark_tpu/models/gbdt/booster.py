@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ...observability import flight as _flight
 from ...observability import metrics as _metrics
@@ -36,7 +36,9 @@ from ...robustness.failpoints import fault_point as _failpoint
 from ...utils import compile_cache as _compile_cache
 from ...ops.binning import QuantileBinner, bin_cols_device
 from ...parallel import mesh as meshlib
+from ...parallel import placement
 from ...parallel.compat import shard_map
+from ...parallel.placement import pspec as P
 from .growth import (GrowConfig, Tree, bitset_words, grow_tree,
                      grow_tree_depthwise, predict_forest_raw,
                      predict_tree_binned, resolve_growth_backend)
@@ -217,14 +219,16 @@ def _unpack_trees_device(flat: jnp.ndarray, T: int, M: int, BW: int) -> Tree:
 
 def _to_device(x):
     """The predict hot path's ONLY host->device transfer funnel — tests
-    shim this to assert exactly one upload per scoring call."""
-    return jnp.asarray(x)
+    shim this to assert exactly one upload per scoring call. Rides the
+    placement layer (ROADMAP item 6): placement.to_device is the
+    package-wide h2d funnel."""
+    return placement.to_device(x)
 
 
 def _from_device(x) -> np.ndarray:
     """The predict hot path's ONLY device->host transfer funnel — tests
     shim this to assert exactly one download per scoring call."""
-    return np.asarray(x)
+    return placement.to_host(x)
 
 
 # process-wide fused-predictor executable cache. Keyed on shape/config only
@@ -394,7 +398,7 @@ def _build_predict_program(T_pad: int, M: int, BW: int, depth_cap: int,
 def _device_validity_mask(n: int, n_pad: int, mesh: Mesh):
     fn = _cached_program(("synth_vmask", n, n_pad, mesh), lambda: jax.jit(
         lambda: (jnp.arange(n_pad) < n).astype(jnp.float32),
-        out_shardings=meshlib.row_sharding(mesh)))
+        out_shardings=placement.row_sharding(mesh)))
     return fn()
 
 
@@ -402,7 +406,7 @@ def _device_tile_scores(base_d, n_pad: int, K: int, mesh: Mesh):
     fn = _cached_program(("synth_scores", n_pad, K, mesh), lambda: jax.jit(
         lambda b: jnp.broadcast_to(
             b[None, :].astype(jnp.float32), (n_pad, K)),
-        out_shardings=meshlib.row_sharding(mesh, ndim=2)))
+        out_shardings=placement.row_sharding(mesh, ndim=2)))
     return fn(base_d)
 
 
@@ -530,12 +534,15 @@ class LightGBMDataset:
                                 categorical_features,
                                 max_bin_by_feature).fit(X)
         tw.mark("binner_fit")
+        # placement decision (observable): dataset rows are batch-dim
+        # sharded over the mesh's data axis when it has >1 shard
+        placement.plan_for("gbdt.ingest", mesh=mesh, rows=n)
         # Binning runs ON DEVICE, producing the column-major [F, n_local]
         # layout tree growth consumes (the host searchsorted pass measured
         # 1.6 s at the 1Mx28 bench shape vs ~ms of VPU compare-sums; raw and
         # binned rows are the same byte count so the transfer is unchanged).
         # Padding rows bin to garbage but carry vmask 0 downstream.
-        X_d, _ = meshlib.shard_rows(X, mesh)
+        X_d, _ = placement.shard_rows(X, mesh)
         if tw.on:
             X_d.block_until_ready()
             tw.mark("xfer_X")
@@ -548,16 +555,16 @@ class LightGBMDataset:
         tw.mark("bin_device")
         X_d.delete()
         del X_d
-        y_d, _ = meshlib.shard_rows(y, mesh)
+        y_d, _ = placement.shard_rows(y, mesh)
         if row_valid is not None:
             # in-group padding rows (ranker) are dead for counts/histograms
             vmask = meshlib.validity_mask(n, n_pad)
             vmask[:n] *= np.asarray(row_valid, np.float32)
-            vmask_d, _ = meshlib.shard_rows(vmask, mesh)
+            vmask_d, _ = placement.shard_rows(vmask, mesh)
         else:
             vmask_d = _device_validity_mask(n, n_pad, mesh)
         if weight is not None:
-            w_d, _ = meshlib.shard_rows(
+            w_d, _ = placement.shard_rows(
                 np.asarray(weight, np.float32), mesh)
         else:
             # default unit weights with zeros on padding rows — exactly the
@@ -742,6 +749,9 @@ class Booster:
         transform are fused into the cached executable.
         """
         _compile_cache.ensure()
+        # placement decision (deduped flight event): the fused predictor
+        # replicates — its executable cache is keyed on exact batch shapes
+        placement.plan_for("gbdt.predict", replicate=True)
         X = np.asarray(X, dtype=np.float32)
         if num_iteration is None or num_iteration < 0:
             num_iteration = self.num_iterations
@@ -1217,8 +1227,12 @@ def _grow_axis_for(mesh, cfg) -> "str | None":
     depthwise histogram subtraction (single-device only) can engage — psum
     over a size-1 axis is the identity it replaces. Voting keeps the axis
     even at size 1: its top-2k ballot restricts the split search and must
-    behave identically regardless of shard count."""
-    return ("data" if (dict(mesh.shape).get("data", 1) > 1 or cfg.voting)
+    behave identically regardless of shard count — and so does a resolved
+    hist_blocks (the deterministic blocked reduction must run the SAME
+    gather-fold program on a 1-device mesh that it runs on 8)."""
+    det = isinstance(cfg.hist_blocks, int) and cfg.hist_blocks > 1
+    return ("data" if (dict(mesh.shape).get("data", 1) > 1 or cfg.voting
+                       or det)
             else None)
 
 
@@ -1500,6 +1514,17 @@ def train_booster(
     is_cat_j = jnp.asarray(is_cat_np) if is_cat_np.any() else None
     nshards = meshlib.num_shards(mesh)
 
+    # placement + determinism resolution — BEFORE any compiled-program
+    # cache key below (the PR 4 resolve-before-cache-key rule): the plan
+    # resolves the backend (which decides buffer donation) and emits the
+    # placement flight event; hist_blocks resolves the canonical reduction
+    # geometry. Both land in cfg / the cache key as concrete values.
+    plan = placement.plan_for("gbdt.fit", mesh=mesh, rows=n_pad,
+                              boosting=boosting_type)
+    cfg = cfg._replace(hist_blocks=placement.resolve_hist_blocks(
+        cfg.hist_blocks, mesh, n_pad, voting=cfg.voting))
+    deterministic = isinstance(cfg.hist_blocks, int) and cfg.hist_blocks > 1
+
     # base score (replicated scalar per class). Computed on device from the
     # already-sharded label/weight arrays, then broadcast to the initial
     # score matrix on device — no dataset-sized host round-trips.
@@ -1525,14 +1550,27 @@ def train_booster(
         else:
             scores0 = init_booster.predict_raw(
                 np.asarray(_densify(X), np.float32))  # [n, K]
-        scores_d, _ = meshlib.shard_rows(scores0.astype(np.float32), mesh)
+        scores_d, _ = placement.shard_rows(scores0.astype(np.float32), mesh)
     elif boost_from_average:
-        base_fn = _cached_program(
-            ("init_score", objective, num_class,
-             tuple(sorted(objective_kwargs.items())), y_d.shape, mesh),
-            lambda: jax.jit(lambda yy, ww, vm: jnp.broadcast_to(
-                obj.init_score(yy, ww * vm), (K,)).astype(jnp.float32)))
-        base_d = base_fn(y_d, w_d, vmask_d)
+        if deterministic:
+            # topology-independent base score: a jit reduction over sharded
+            # arrays lets GSPMD pick a device-count-dependent f32 combine
+            # order, so the deterministic mode gathers the (one-time,
+            # [n]-sized) label/weight arrays and computes the init score on
+            # the default device — the same program at every device count.
+            base_d = jnp.broadcast_to(
+                obj.init_score(
+                    placement.to_device(placement.to_host(y_d)),
+                    placement.to_device(placement.to_host(w_d)
+                                        * placement.to_host(vmask_d))),
+                (K,)).astype(jnp.float32)
+        else:
+            base_fn = _cached_program(
+                ("init_score", objective, num_class,
+                 tuple(sorted(objective_kwargs.items())), y_d.shape, mesh),
+                lambda: jax.jit(lambda yy, ww, vm: jnp.broadcast_to(
+                    obj.init_score(yy, ww * vm), (K,)).astype(jnp.float32)))
+            base_d = base_fn(y_d, w_d, vmask_d)
         base = np.asarray(base_d, dtype=np.float32)
         scores_d = _device_tile_scores(base_d, n_pad, K, mesh)
     else:
@@ -1559,12 +1597,12 @@ def train_booster(
             # labels would silently corrupt early stopping
             from ...utils.checkpoint import data_fingerprint as _vfp
             valid_fp = _vfp(Xv, yv, wv)
-        Xvb_d, _ = meshlib.shard_rows(binner.transform(Xv), mesh)
-        yv_d, _ = meshlib.shard_rows(yv, mesh)
+        Xvb_d, _ = placement.shard_rows(binner.transform(Xv), mesh)
+        yv_d, _ = placement.shard_rows(yv, mesh)
         # fold validity into the weight so padded rows don't count
         wv_pad, _ = meshlib.pad_rows(wv, nshards)
         wv_pad = wv_pad * meshlib.validity_mask(nv, len(wv_pad))
-        wv_d, _ = meshlib.shard_rows(wv_pad, mesh)
+        wv_d, _ = placement.shard_rows(wv_pad, mesh)
         # same exact-state rule as the training scores above — but only
         # when the checkpoint was written against THIS valid set
         resume_vscores = (None if resume_state is None
@@ -1577,7 +1615,7 @@ def train_booster(
             vscores0 = init_booster.predict_raw(Xv)
         else:
             vscores0 = np.tile(base[None, :], (nv, 1))
-        vscores_d, _ = meshlib.shard_rows(vscores0.astype(np.float32), mesh)
+        vscores_d, _ = placement.shard_rows(vscores0.astype(np.float32), mesh)
         if tw.on:
             jax.block_until_ready((Xvb_d, yv_d, wv_d, vscores_d))
             tw.mark("valid_prep")
@@ -1776,8 +1814,9 @@ def train_booster(
     # nondeterministic heap corruption (review-reproduced: ~40% of
     # test_histogram_engines runs segfaulted mid-host-loop on jax 0.4.37;
     # 0/6 with donation off), and host-RAM copies are not the bottleneck
-    # the donation targets anyway.
-    if jax.default_backend() == "cpu":
+    # the donation targets anyway. The placement plan resolved the backend
+    # up front (PlacementPlan.donate_buffers).
+    if not plan.donate_buffers:
         donate = ()
     else:
         donate = (4, 8) if has_valid else (4,)
@@ -2239,10 +2278,10 @@ def _train_dart(*, mesh, cfg, K, obj, objective, objective_kwargs,
 
     dstep, deval = _cached_program(cache_key, build_dart)
 
-    sh = lambda spec: NamedSharding(mesh, spec)
-    contribs_d = jax.device_put(
+    sh = lambda spec: placement.sharding(spec, mesh)
+    contribs_d = placement.device_put(
         np.zeros((T_max, npad, K), np.float32), sh(c_spec))
-    vcontribs_d = (jax.device_put(
+    vcontribs_d = (placement.device_put(
         np.zeros((T_max, Xvb_d.shape[0], K), np.float32), sh(c_spec))
         if has_valid else np.zeros((), np.float32))
     dummy = np.zeros((), np.float32)

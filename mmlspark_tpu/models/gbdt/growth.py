@@ -33,7 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ...ops.histogram import node_histogram, quantize_stats
+from ...ops.histogram import node_histogram, quant_q_max, quantize_stats
+from ...parallel.compat import axis_size as _axis_size
 
 NEG_INF = jnp.float32(-jnp.inf)
 
@@ -139,6 +140,22 @@ class GrowConfig(NamedTuple):
     # compiled-program cache keyed on cfg stays correct for free; resolved
     # alongside hist_subtraction.
     compact_selector: str = "auto"
+    # Deterministic histogram-reduction geometry (topology-independent
+    # training). 0 = the plain path: per-shard histograms psum'd across the
+    # mesh — fast, but f32 accumulation order (and therefore the last ulp
+    # of every gain and leaf value) depends on the device count. An int
+    # k >= 2 pins a CANONICAL geometry instead: rows are processed as k
+    # fixed blocks, per-block histograms/stat-sums are all_gather'd in
+    # block order and folded left-to-right, and quantized-gradient scales/
+    # rounding derive from global row indices — so every device count
+    # dividing k grows BIT-IDENTICAL trees (model_string() equality at
+    # k=8 across 1/2/4/8 devices; the preemption-resume story across
+    # topology changes). Costs one gathered [k, F, 3W, B] transient per
+    # pass and disables histogram subtraction. "auto" (default) resolves
+    # via placement.resolve_hist_blocks (MMLSPARK_TPU_HIST_BLOCKS, default
+    # 0) BEFORE entering any compiled-program cache key; unresolved "auto"
+    # reaching growth behaves as 0.
+    hist_blocks: "int | str" = "auto"
 
 
 def resolve_growth_backend(cfg: GrowConfig) -> GrowConfig:
@@ -169,6 +186,160 @@ def resolve_growth_backend(cfg: GrowConfig) -> GrowConfig:
             cs = "argsort" if on_tpu else "searchsorted"
         cfg = cfg._replace(hist_subtraction=bool(hs), compact_selector=cs)
     return cfg
+
+
+# ---------------------------------------------------------------------------
+# Deterministic blocked reduction (GrowConfig.hist_blocks): the canonical
+# geometry that makes sharded training topology-independent. Every reduction
+# that crosses rows — histograms, stat totals, leaf renewal — is computed per
+# fixed row block, gathered into canonical block order, and folded
+# left-to-right, so the f32 rounding sequence is a function of the BLOCK
+# COUNT, never of how many devices happen to hold the blocks.
+# Scope: the contract covers the TRAINING reductions (histograms, stat
+# totals, quantization, leaf renewal). Validation METRIC combining stays a
+# psum — early stopping driven by a valid set may therefore stop at a
+# different round across topologies when a round's metric lands within one
+# ulp of the best; fits without validation-driven stopping are
+# bit-identical end to end (docs/performance.md "Sharded training").
+# ---------------------------------------------------------------------------
+
+
+def _hist_block_geometry(cfg: GrowConfig, axis_name, n: int):
+    """(blocks_local, rows_per_block) for the blocked reduction; (0, n) on
+    the plain psum path. Raises when a pinned block count cannot tile this
+    shard (train_booster resolves these cases up front via
+    placement.resolve_hist_blocks; direct growth callers fail loudly)."""
+    hb = cfg.hist_blocks
+    if hb == "auto" or not hb or (isinstance(hb, int) and hb <= 1):
+        return 0, n
+    if cfg.voting:
+        raise ValueError(
+            "hist_blocks does not compose with voting_parallel (the "
+            "shard-local ballot is inherently topology-dependent)")
+    axis_sz = _axis_size(axis_name) if axis_name is not None else 1
+    if hb % axis_sz:
+        raise ValueError(
+            f"hist_blocks={hb} is not a multiple of the {axis_sz}-shard "
+            "data axis")
+    bl = hb // axis_sz
+    if n % bl:
+        raise ValueError(
+            f"shard row count {n} does not tile into {bl} blocks "
+            f"(hist_blocks={hb} over {axis_sz} shards)")
+    return bl, n // bl
+
+
+def _blocked_fold(parts: jnp.ndarray, axis_name):
+    """Gather per-shard block partials into canonical order and fold them
+    left-to-right. ``parts``: [blocks_local, ...] stacked partials; the
+    explicit unrolled fold (not a reduce op) pins the f32 rounding order
+    regardless of how XLA would lower an axis reduction."""
+    if axis_name is not None:
+        parts = lax.all_gather(parts, axis_name, axis=0, tiled=True)
+    acc = parts[0]
+    for j in range(1, parts.shape[0]):
+        acc = acc + parts[j]
+    return acc
+
+
+def _positional_uniform(key, channels: int, n_local: int, axis_name):
+    """[channels, n_local] uniforms derived from GLOBAL row indices.
+
+    ``jax.random.uniform(key, shape)`` draws depend on position within the
+    local shape, so a sharded run and a single-device run would round the
+    same row differently. This hash (murmur3-style finalizers over the key
+    words and the global row id) gives every global row the same draw on
+    every topology — quality is ample for stochastic rounding."""
+    kd = key
+    try:
+        kd = jax.random.key_data(key)
+    except Exception:  # noqa: BLE001 — raw uint32 key arrays (default impl)
+        pass
+    kd = jnp.asarray(kd).astype(jnp.uint32).reshape(-1)
+    k0, k1 = kd[0], kd[-1]
+    idx = jnp.arange(n_local, dtype=jnp.uint32)
+    if axis_name is not None:
+        idx = idx + (jnp.uint32(n_local)
+                     * lax.axis_index(axis_name).astype(jnp.uint32))
+    ch = jnp.arange(channels, dtype=jnp.uint32)[:, None]
+    x = (idx[None, :] ^ k0) + ch * jnp.uint32(0x9E3779B9)
+
+    def _mix(v):
+        v = (v ^ (v >> jnp.uint32(16))) * jnp.uint32(0x85EBCA6B)
+        v = (v ^ (v >> jnp.uint32(13))) * jnp.uint32(0xC2B2AE35)
+        return v ^ (v >> jnp.uint32(16))
+
+    x = _mix(x ^ k1)
+    x = _mix(x + jnp.uint32(0x27D4EB2F))
+    return (x >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(
+        1.0 / (1 << 24))
+
+
+def _quantize_for(cfg: GrowConfig, base_t, qkey, axis_name, blocks_local,
+                  rows_per_block):
+    """int8 stat quantization, topology-aware. Blocked mode derives the
+    scales from the GLOBAL amax (pmax is exact, so every shard count
+    computes the same scale), bounds the int32 accumulator by the
+    rows-per-block (the actual per-accumulation row count), and draws the
+    stochastic-rounding bits from global row indices."""
+    if not blocks_local:
+        return quantize_stats(base_t, qkey)
+    amax = jnp.max(jnp.abs(base_t), axis=1)
+    if axis_name is not None:
+        amax = lax.pmax(amax, axis_name)
+    q_max = quant_q_max(rows_per_block)
+    u = None if qkey is None else _positional_uniform(
+        qkey, base_t.shape[0], base_t.shape[1], axis_name)
+    return quantize_stats(base_t, qkey, amax=amax, q_max=q_max, u=u)
+
+
+def _blocked_node_hist(binned_t, row_pos, base_t, W: int, B: int, qscales,
+                       blocks_local: int, rows_per_block: int, axis_name):
+    """[F, W*3, B] histogram via the canonical blocked reduction: one
+    engine pass per fixed row block (identical shapes on every topology),
+    gathered and folded in block order."""
+    parts = jnp.stack([
+        node_histogram(
+            binned_t[:, j * rows_per_block:(j + 1) * rows_per_block],
+            row_pos[j * rows_per_block:(j + 1) * rows_per_block],
+            base_t[:, j * rows_per_block:(j + 1) * rows_per_block],
+            W, B, scales=qscales)
+        for j in range(blocks_local)])
+    return _blocked_fold(parts, axis_name)
+
+
+def _stat_totals(base_t, qscales, axis_name, blocks_local, rows_per_block):
+    """[3] global grad/hess/count totals. Blocked mode folds per-block sums
+    in canonical order; the plain path keeps the historical psum.
+
+    Quantized per-BLOCK sums accumulate in int32 (bounded: _quantize_for
+    caps q_max by rows_per_block, so a block sum stays under 2^31) and
+    widen to f32 BEFORE the cross-block fold — folding raw int32 across
+    all hist_blocks would wrap once q_max * total_rows crosses 2^31
+    (~17M rows at q_max=127). The f32 fold is the same rounding class as
+    the plain path's scale-before-psum order, and stays deterministic:
+    identical values folded in identical order on every topology."""
+    if blocks_local:
+        def block_sum(j):
+            seg = base_t[:, j * rows_per_block:(j + 1) * rows_per_block]
+            if qscales is not None:
+                return jnp.sum(seg.astype(jnp.int32),
+                               axis=1).astype(jnp.float32)
+            return jnp.sum(seg, axis=1)
+
+        tot = _blocked_fold(
+            jnp.stack([block_sum(j) for j in range(blocks_local)]),
+            axis_name)
+        if qscales is not None:
+            tot = tot * qscales
+        return tot
+    if qscales is not None:
+        tot = jnp.sum(base_t.astype(jnp.int32), axis=1) * qscales
+    else:
+        tot = jnp.sum(base_t, axis=1)
+    if axis_name is not None:
+        tot = lax.psum(tot, axis_name)
+    return tot
 
 
 def _soft_threshold(g, l1):
@@ -357,13 +528,16 @@ class Tree(NamedTuple):
 def _use_subtraction(cfg, axis_name, n: int) -> bool:
     """Single engagement rule for histogram subtraction, shared by both
     growth policies: single-device only (see the GrowConfig comment), not
-    under voting, and only worth the selector/gather overhead at real row
-    counts (threshold provisional until TPU gather costs are measured)."""
+    under voting, not under the deterministic blocked reduction (the
+    compacted smaller-child pass has no canonical block tiling), and only
+    worth the selector/gather overhead at real row counts (threshold
+    provisional until TPU gather costs are measured)."""
     if cfg.hist_subtraction == "auto":
         raise ValueError(
             "hist_subtraction='auto' reached tree growth unresolved — "
             "callers must apply resolve_growth_backend(cfg) first")
-    return (cfg.hist_subtraction and axis_name is None
+    blocked = isinstance(cfg.hist_blocks, int) and cfg.hist_blocks > 1
+    return (cfg.hist_subtraction and axis_name is None and not blocked
             and not cfg.voting and n >= 8192)
 
 
@@ -414,17 +588,25 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     vm = valid.astype(jnp.float32)
     base_t = jnp.stack([grad * vm, hess * vm, vm], axis=0)   # [3, n]
+    bl, rpb = _hist_block_geometry(cfg, axis_name, n)
     qscales = None
     if cfg.quantized_grad:
-        base_t, qscales = quantize_stats(base_t, qkey)
+        base_t, qscales = _quantize_for(cfg, base_t, qkey, axis_name, bl,
+                                        rpb)
 
     def all_hist(row_pos, W):
         """Global per-node histogram [F, W*3, B] + selected-feature mask.
 
-        data_parallel: one full [F, W*3, B] psum. voting_parallel: vote top_k
-        locally, psum the votes, psum only the global top-2k features'
-        histograms (scattered back into a zeroed full array so downstream
-        split search keeps static shapes; unselected features are masked)."""
+        data_parallel: one full [F, W*3, B] psum — or, under hist_blocks,
+        the canonical blocked fold (topology-independent f32 order).
+        voting_parallel: vote top_k locally, psum the votes, psum only the
+        global top-2k features' histograms (scattered back into a zeroed
+        full array so downstream split search keeps static shapes;
+        unselected features are masked)."""
+        if bl:
+            return (_blocked_node_hist(binned_t, row_pos, base_t, W, B,
+                                       qscales, bl, rpb, axis_name),
+                    jnp.ones(F, dtype=bool))
         h = node_histogram(binned_t, row_pos, base_t, W, B, scales=qscales)
         if axis_name is None:
             return h, jnp.ones(F, dtype=bool)
@@ -445,12 +627,7 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     # totals from the raw stats (not the histogram: under voting_parallel an
     # unselected feature's rows are zeroed there). Quantized mode totals the
     # DEQUANTIZED stats so node stats stay consistent with histogram sums.
-    if qscales is not None:
-        tot = jnp.sum(base_t.astype(jnp.int32), axis=1) * qscales
-    else:
-        tot = jnp.sum(base_t, axis=1)
-    if axis_name is not None:
-        tot = lax.psum(tot, axis_name)
+    tot = _stat_totals(base_t, qscales, axis_name, bl, rpb)
     tot_g, tot_h, tot_c = tot[0], tot[1], tot[2]
 
     # cfg is static Python config: root may split unless max_depth == 0
@@ -601,7 +778,8 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     state = lax.fori_loop(0, L - 1, round_body, state)
 
     if cfg.quantized_grad and cfg.quant_renew_leaf:
-        state = _renew_leaf_stats(state, grad, hess, vm, M, axis_name)
+        state = _renew_leaf_stats(state, grad, hess, vm, M, axis_name,
+                                  bl, rpb)
 
     lr = jnp.float32(cfg.learning_rate)
     raw_val = -_soft_threshold(state["ng"], cfg.lambda_l1) / (
@@ -622,18 +800,29 @@ def grow_tree(binned_t: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     return tree, state["row_node"]
 
 
-def _renew_leaf_stats(state, grad, hess, vm, M: int, axis_name):
+def _renew_leaf_stats(state, grad, hess, vm, M: int, axis_name,
+                      blocks_local: int = 0, rows_per_block: int = 0):
     """Full-precision leaf-stat renewal for quantized training (LightGBM
     quant_train_renew_leaf): leaf grad/hess/count sums recomputed from the
     original f32 stats by one segment-sum over the final row->leaf map, so
     leaf VALUES carry no int8 quantization error while split structure keeps
     the 2x-rate int8 histogram path. Internal-node stats stay as recorded
-    (structural metadata only)."""
+    (structural metadata only). Under hist_blocks the segment-sums run per
+    canonical block and fold in block order, like every other row
+    reduction."""
     seg = state["row_node"]
     stats = jnp.stack([grad * vm, hess * vm, vm])            # [3, n]
-    renew = jnp.zeros((3, M), jnp.float32).at[:, seg].add(stats)
-    if axis_name is not None:
-        renew = lax.psum(renew, axis_name)
+    if blocks_local:
+        parts = jnp.stack([
+            jnp.zeros((3, M), jnp.float32).at[
+                :, seg[j * rows_per_block:(j + 1) * rows_per_block]].add(
+                stats[:, j * rows_per_block:(j + 1) * rows_per_block])
+            for j in range(blocks_local)])
+        renew = _blocked_fold(parts, axis_name)
+    else:
+        renew = jnp.zeros((3, M), jnp.float32).at[:, seg].add(stats)
+        if axis_name is not None:
+            renew = lax.psum(renew, axis_name)
     for i, k in enumerate(("ng", "nh", "nc")):
         state[k] = jnp.where(state["is_leaf"], renew[i], state[k])
     return state
@@ -699,9 +888,11 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
 
     vm = valid.astype(jnp.float32)
     base_t = jnp.stack([grad * vm, hess * vm, vm], axis=0)   # [3, n]
+    bl, rpb = _hist_block_geometry(cfg, axis_name, n)
     qscales = None
     if cfg.quantized_grad:
-        base_t, qscales = quantize_stats(base_t, qkey)
+        base_t, qscales = _quantize_for(cfg, base_t, qkey, axis_name, bl,
+                                        rpb)
     zi = jnp.zeros(M, dtype=jnp.int32)
     zf = jnp.zeros(M, dtype=jnp.float32)
     tree_arrays = dict(
@@ -715,12 +906,7 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
     leaves = jnp.int32(1)
 
     # root totals (dequantized sums: consistent with histogram sums)
-    if qscales is not None:
-        tot0 = jnp.sum(base_t.astype(jnp.int32), axis=1) * qscales
-    else:
-        tot0 = jnp.sum(base_t, axis=1)
-    if axis_name is not None:
-        tot0 = lax.psum(tot0, axis_name)
+    tot0 = _stat_totals(base_t, qscales, axis_name, bl, rpb)
     tree_arrays["ng"] = tree_arrays["ng"].at[0].set(tot0[0])
     tree_arrays["nh"] = tree_arrays["nh"].at[0].set(tot0[1])
     tree_arrays["nc"] = tree_arrays["nc"].at[0].set(tot0[2])
@@ -795,20 +981,26 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
 
                 # one fused histogram pass covers the whole level: the
                 # row->position one-hot and masked stats are built in VMEM
-                h = node_histogram(binned_t, row_pos, base_t, W, B,
-                                   scales=qscales)             # [F, W*3, B]
                 feat_mask_lvl = feat_mask
-                if axis_name is not None:
-                    if cfg.voting:
-                        # per-level voting: shards vote top_k features by
-                        # their best local gain across the WHOLE frontier,
-                        # then only the global top-2k features' level
-                        # histograms cross the interconnect
-                        h, sel = _voting_select(h, feat_mask, cfg, axis_name,
-                                                W)
-                        feat_mask_lvl = feat_mask & sel
-                    else:
-                        h = lax.psum(h, axis_name)
+                if bl:
+                    # canonical blocked fold: topology-independent f32 order
+                    h = _blocked_node_hist(binned_t, row_pos, base_t, W, B,
+                                           qscales, bl, rpb, axis_name)
+                else:
+                    h = node_histogram(binned_t, row_pos, base_t, W, B,
+                                       scales=qscales)         # [F, W*3, B]
+                    if axis_name is not None:
+                        if cfg.voting:
+                            # per-level voting: shards vote top_k features
+                            # by their best local gain across the WHOLE
+                            # frontier, then only the global top-2k
+                            # features' level histograms cross the
+                            # interconnect
+                            h, sel = _voting_select(h, feat_mask, cfg,
+                                                    axis_name, W)
+                            feat_mask_lvl = feat_mask & sel
+                        else:
+                            h = lax.psum(h, axis_name)
                 h = h.reshape(F, W, 3, B).transpose(1, 0, 2, 3)  # [W,F,3,B]
 
             tot = jnp.stack([tree_arrays["ng"][jnp.maximum(fr, 0)],
@@ -920,7 +1112,7 @@ def grow_tree_depthwise(binned_t: jnp.ndarray, grad: jnp.ndarray,
     if cfg.quantized_grad and cfg.quant_renew_leaf:
         tree_arrays = _renew_leaf_stats(
             dict(tree_arrays, row_node=row_node), grad, hess, vm, M,
-            axis_name)
+            axis_name, bl, rpb)
 
     lr = jnp.float32(cfg.learning_rate)
     raw_val = -_soft_threshold(tree_arrays["ng"], cfg.lambda_l1) / (

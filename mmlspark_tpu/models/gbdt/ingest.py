@@ -36,12 +36,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P, \
-    SingleDeviceSharding
+from jax.sharding import Mesh
 
 from ...ops.binning import QuantileBinner, bin_cols_device
 from ...parallel import mesh as meshlib
+from ...parallel import placement
 from ...parallel.compat import shard_map
+from ...parallel.placement import pspec as P
 
 PathLike = Union[str, os.PathLike]
 
@@ -451,10 +452,9 @@ def binned_matrix_from_source(src: ShardedMatrixSource,
     ub = binner.upper_bounds
     bd = jnp.dtype(bin_dtype)
 
-    buf_sh = NamedSharding(mesh, P(None, meshlib.DATA_AXIS))
-    row_sh = NamedSharding(mesh, P(meshlib.DATA_AXIS, None))
-    rep_sh = NamedSharding(mesh, P())
-    ub_d = jax.device_put(ub, rep_sh)
+    buf_sh = placement.sharding(P(None, meshlib.DATA_AXIS), mesh)
+    row_sh = placement.sharding(P(meshlib.DATA_AXIS, None), mesh)
+    ub_d = placement.put_replicated(ub, mesh)
     buf = jax.jit(lambda: jnp.zeros((F, n_pad), bd),
                   out_shardings=buf_sh)()
 
@@ -505,7 +505,7 @@ def binned_matrix_from_source(src: ShardedMatrixSource,
     chunk_reads = ((lambda o=off: load_chunk(o))
                    for off in range(0, per_dev, c))
     for off, host in iter_prefetched(chunk_reads, site="ingest"):
-        buf = step(buf, jax.device_put(host, row_sh), ub_d,
+        buf = step(buf, placement.device_put(host, row_sh), ub_d,
                    np.int32(off))
     return buf
 
@@ -530,8 +530,8 @@ def vector_from_source(src: Optional[ShardedMatrixSource], mesh: Mesh,
         seg = src.read(lo, min(lo + per_dev, n))
         if seg.shape[0] < per_dev:
             seg = np.pad(seg, (0, per_dev - seg.shape[0]))
-        local.append(jax.device_put(seg, SingleDeviceSharding(dev)))
-    sharding = NamedSharding(mesh, P(meshlib.DATA_AXIS))
+        local.append(placement.put_on_device(seg, dev))
+    sharding = placement.sharding(P(meshlib.DATA_AXIS), mesh)
     return jax.make_array_from_single_device_arrays(
         (n_pad,), sharding, local)
 
@@ -557,6 +557,7 @@ def construct_from_files(path, label_path, weight_path=None, *,
     mesh = mesh or meshlib.get_default_mesh()
     _validate_bin_dtype(bin_dtype, max_bin)
     xsrc = ShardedMatrixSource(path)
+    placement.plan_for("gbdt.ingest_files", mesh=mesh, rows=xsrc.n)
     if xsrc.ndim != 2:
         raise ValueError("feature shards must be 2-D [rows, features]")
     bad_cats = [int(i) for i in categorical_features

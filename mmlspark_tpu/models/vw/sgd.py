@@ -31,10 +31,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ...parallel import mesh as meshlib
+from ...parallel import placement
 from ...parallel.compat import shard_map
+from ...parallel.placement import pspec as P
 
 
 class SGDConfig(NamedTuple):
@@ -232,6 +234,7 @@ def _prep_sgd_data(indices: np.ndarray, values: np.ndarray,
         sample_weight, np.float32)
     nshards = meshlib.num_shards(mesh)
     bs = cfg.batch_size
+    placement.plan_for("vw.fit", mesh=mesh, rows=n)
     # pad rows so each shard has a whole number of batches
     mult = nshards * bs
     idx_p, _ = meshlib.pad_rows(indices.astype(np.int32), mult)
@@ -240,10 +243,10 @@ def _prep_sgd_data(indices: np.ndarray, values: np.ndarray,
     sw_p, _ = meshlib.pad_rows(sw, mult)
     sw_p = sw_p * meshlib.validity_mask(n, len(sw_p))  # padded rows learn nothing
 
-    idx_d, _ = meshlib.shard_rows(idx_p, mesh)
-    val_d, _ = meshlib.shard_rows(val_p, mesh)
-    y_d, _ = meshlib.shard_rows(y_p, mesh)
-    sw_d, _ = meshlib.shard_rows(sw_p, mesh)
+    idx_d, _ = placement.shard_rows(idx_p, mesh)
+    val_d, _ = placement.shard_rows(val_p, mesh)
+    y_d, _ = placement.shard_rows(y_p, mesh)
+    sw_d, _ = placement.shard_rows(sw_p, mesh)
     return idx_d, val_d, y_d, sw_d
 
 

@@ -154,6 +154,19 @@ REGISTRY: Tuple[EnvVar, ...] = (
            doc="Pallas kernel unroll cap; 0 keeps the dynamic fori_loop "
                "everywhere (escape hatch for pathological Mosaic "
                "compiles)"),
+    EnvVar(name="MMLSPARK_TPU_HIST_BLOCKS", default="0",
+           section="performance",
+           doc="canonical histogram-reduction block count for "
+               "topology-independent GBDT training: device counts "
+               "dividing it grow bit-identical trees (`8` covers 1/2/4/8 "
+               "devices); 0 keeps the plain psum path (resolved via "
+               "`placement.resolve_hist_blocks` before any cache key; "
+               "`GrowConfig.hist_blocks` overrides per fit)"),
+    EnvVar(name="MMLSPARK_TPU_MESH_DEVICES", default="(all devices)",
+           section="performance",
+           doc="cap the default mesh to the first N devices (scaling A/B "
+               "legs, placement debugging); explicit `make_mesh` "
+               "shape/devices arguments are honored as given"),
     EnvVar(name="MMLSPARK_TPU_COMPILE_CACHE_DIR", default="(off)",
            section="performance",
            doc="wires jax's persistent compilation cache to this "

@@ -185,7 +185,18 @@ def histogram_cols(binned_t: jnp.ndarray, stats_t: jnp.ndarray, num_bins: int,
     return _hist_xla(binned_t, stats_t, B)
 
 
-def quantize_stats(base_t: jnp.ndarray, key=None):
+def quant_q_max(rows: int) -> float:
+    """THE int8 quantization target for ``rows`` accumulated stats: shrinks
+    below 127 once a histogram cell could overflow the int32 accumulator
+    (q_max * rows must stay under 2^31). One definition shared by the
+    plain path (rows = the local shard) and the deterministic blocked path
+    (rows = rows-per-block) — if the accumulator ever widens, both paths
+    move together or the bit-identity contract silently breaks."""
+    return float(max(1, min(127, (2 ** 31 - 1) // max(int(rows), 1))))
+
+
+def quantize_stats(base_t: jnp.ndarray, key=None, *, amax=None, q_max=None,
+                   u=None):
     """Per-row-stat int8 quantization (LightGBM quantized training,
     use_quantized_grad): symmetric per-channel scale, stochastic rounding
     when a PRNG key is given (round-to-nearest otherwise). Returns
@@ -196,17 +207,23 @@ def quantize_stats(base_t: jnp.ndarray, key=None):
     The quantization target shrinks below 127 for shards so large that a
     histogram cell could overflow the int32 accumulator (q_max * n must
     stay under 2^31): giant shards trade precision gracefully instead of
-    wrapping negative."""
+    wrapping negative.
+
+    ``amax`` / ``q_max`` / ``u`` override the locally-derived scale
+    maximum, accumulator bound and stochastic-rounding uniforms — the
+    deterministic blocked-reduction path (growth.GrowConfig.hist_blocks)
+    supplies GLOBAL values so every mesh topology quantizes each row
+    identically."""
     n = base_t.shape[1]
-    q_max = float(max(1, min(127, (2**31 - 1) // max(n, 1))))
-    amax = jnp.max(jnp.abs(base_t), axis=1)
+    if q_max is None:
+        q_max = quant_q_max(n)
+    if amax is None:
+        amax = jnp.max(jnp.abs(base_t), axis=1)
     scales = jnp.where(amax > 0, amax / q_max, 1.0)
     x = base_t / scales[:, None]
-    if key is not None:
+    if u is None and key is not None:
         u = jax.random.uniform(key, base_t.shape)
-        q = jnp.floor(x + u)
-    else:
-        q = jnp.round(x)
+    q = jnp.floor(x + u) if u is not None else jnp.round(x)
     return jnp.clip(q, -q_max, q_max).astype(jnp.int8), scales
 
 

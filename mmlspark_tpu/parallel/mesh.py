@@ -1,4 +1,4 @@
-"""Device mesh + sharding helpers — the runtime substrate.
+"""Device mesh management — the runtime substrate.
 
 Replaces the reference's entire L2 communication layer (driver ServerSocket
 rendezvous + LGBM_NetworkInit TCP ring + VW spanning tree; reference:
@@ -11,6 +11,12 @@ Canonical axis names:
   ``data``  — batch/row sharding (DP; the only parallelism the reference had)
   ``model`` — tensor parallelism (TP) for the DNN path
   ``seq``   — sequence/context parallelism (SP / ring attention), new capability
+
+Sharding/placement helpers (NamedSharding/PartitionSpec construction,
+``shard_rows``, ``put_replicated``) live in :mod:`.placement` — THE
+device-placement funnel (graftlint's ``placement-funnel`` rule keeps the
+raw jax.sharding surface out of everything else). This module owns only
+mesh topology + host-side padding arithmetic.
 """
 
 from __future__ import annotations
@@ -20,7 +26,9 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
+
+from ..observability.env_registry import env_int
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -36,8 +44,16 @@ def make_mesh(shape: Optional[Dict[str, int]] = None,
     ``shape`` maps axis name -> size; by default all devices go on ``data``
     (the reference's one-partition-per-task topology,
     LightGBMBase.scala:187-235, becomes one row-shard per device).
+    ``MMLSPARK_TPU_MESH_DEVICES`` caps the default device set to the first
+    N devices (A/B scaling legs, placement debugging) — an explicit
+    ``devices`` or ``shape`` argument is honored as given.
     """
+    explicit = devices is not None
     devices = list(devices if devices is not None else jax.devices())
+    if not explicit and shape is None:
+        cap = env_int("MMLSPARK_TPU_MESH_DEVICES", 0)
+        if cap > 0:
+            devices = devices[:cap]
     if shape is None:
         shape = {DATA_AXIS: len(devices)}
     sizes = list(shape.values())
@@ -76,20 +92,6 @@ def num_shards(mesh: Optional[Mesh] = None, axis: str = DATA_AXIS) -> int:
     return mesh.shape[axis] if axis in mesh.shape else 1
 
 
-def row_sharding(mesh: Optional[Mesh] = None, axis: str = DATA_AXIS,
-                 ndim: int = 1) -> NamedSharding:
-    """Sharding that splits the leading (row) axis over ``axis``."""
-    mesh = mesh or get_default_mesh()
-    spec = [None] * ndim
-    spec[0] = axis
-    return NamedSharding(mesh, P(*spec))
-
-
-def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
-    mesh = mesh or get_default_mesh()
-    return NamedSharding(mesh, P())
-
-
 def pad_rows(arr: np.ndarray, multiple: int, fill=0) -> Tuple[np.ndarray, int]:
     """Pad the row axis to a multiple so every shard is equal-sized.
 
@@ -103,26 +105,6 @@ def pad_rows(arr: np.ndarray, multiple: int, fill=0) -> Tuple[np.ndarray, int]:
         return arr, n
     pad_width = [(0, target - n)] + [(0, 0)] * (arr.ndim - 1)
     return np.pad(arr, pad_width, constant_values=fill), n
-
-
-def shard_rows(arr: np.ndarray, mesh: Optional[Mesh] = None,
-               axis: str = DATA_AXIS, fill=0):
-    """Pad rows to the shard multiple and place on the mesh, row-sharded.
-
-    Returns (device_array, valid_row_count); callers carry a validity mask where
-    padding could bias a result.
-    """
-    mesh = mesh or get_default_mesh()
-    k = num_shards(mesh, axis)
-    padded, n = pad_rows(np.asarray(arr), k, fill=fill)
-    out = jax.device_put(padded, row_sharding(mesh, axis, padded.ndim))
-    return out, n
-
-
-def put_replicated(tree, mesh: Optional[Mesh] = None):
-    mesh = mesh or get_default_mesh()
-    sh = replicated(mesh)
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
 
 
 def validity_mask(n_valid: int, n_total: int) -> np.ndarray:

@@ -1,0 +1,273 @@
+"""THE device-placement funnel — every estimator's device hop in one layer.
+
+The reference decides data placement per model family (LightGBM partitions
+rows per Spark task, VW ships a weight vector over its spanning tree); this
+framework previously mirrored that accident: GBDT had ``_to_device`` /
+``_from_device``, the DNN path wired its own pjit shardings, and the long
+tail (VW/SGD, SAR, isolation forest) stayed host-bound. This module is the
+ONE place those decisions live now (ROADMAP item 6):
+
+* **replicate vs batch-dim shard** — :func:`plan_for` decides per site from
+  the mesh and row count, and every decision lands in the flight ring as a
+  ``placement`` event, so "where did my data go" is answerable post-hoc.
+* **backend resolved before cache keys** (the PR 4 rule): a
+  :class:`PlacementPlan` carries the resolved backend and mesh identity, so
+  callers key compiled-program caches on concrete values, never on "auto".
+* **the raw jax surface** (``jax.device_put``, ``NamedSharding``,
+  ``PartitionSpec``, ``SingleDeviceSharding``) is constructed only here —
+  enforced by graftlint's ``placement-funnel`` rule (``parallel/compat.py``
+  is the one other sanctioned module). Call sites express intent through
+  :func:`pspec` / :func:`sharding` / the transfer helpers below.
+
+Determinism: :func:`resolve_hist_blocks` is the placement half of the
+topology-independent GBDT training contract (``GrowConfig.hist_blocks``) —
+it validates the canonical block count against the mesh and row padding
+BEFORE the value enters any compiled-program cache key.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import (Mesh, NamedSharding, PartitionSpec,
+                          SingleDeviceSharding)
+
+from ..observability import flight as _flight
+from ..observability.env_registry import env_int
+from . import mesh as meshlib
+
+DATA_AXIS = meshlib.DATA_AXIS
+
+__all__ = [
+    "DATA_AXIS", "PlacementPlan", "plan_for", "pspec", "sharding",
+    "replicated", "row_sharding", "shard_rows", "put_replicated",
+    "device_put", "put_on_device", "put_tree", "to_device", "to_host",
+    "resolve_hist_blocks", "reset_decision_log",
+]
+
+
+# ---------------------------------------------------------------------------
+# Spec + sharding constructors (the only sanctioned PartitionSpec /
+# NamedSharding call sites in the package)
+# ---------------------------------------------------------------------------
+
+
+def pspec(*entries) -> PartitionSpec:
+    """The one sanctioned ``PartitionSpec`` constructor. Call sites alias it
+    (``from ...parallel.placement import pspec as P``) so spec-building code
+    reads exactly as it did against jax.sharding, but the construction stays
+    inside the funnel."""
+    return PartitionSpec(*entries)
+
+
+def sharding(spec: PartitionSpec, mesh: Optional[Mesh] = None) -> NamedSharding:
+    """``NamedSharding`` over ``mesh`` (default mesh when None)."""
+    return NamedSharding(mesh or meshlib.get_default_mesh(), spec)
+
+
+def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
+    return sharding(pspec(), mesh)
+
+
+def row_sharding(mesh: Optional[Mesh] = None, axis: str = DATA_AXIS,
+                 ndim: int = 1) -> NamedSharding:
+    """Sharding that splits the leading (row) axis over ``axis``."""
+    spec = [None] * ndim
+    spec[0] = axis
+    return sharding(pspec(*spec), mesh)
+
+
+# ---------------------------------------------------------------------------
+# Transfer funnels
+# ---------------------------------------------------------------------------
+
+
+def device_put(x, shd):
+    """The package's one ``jax.device_put`` call site (sharding-addressed)."""
+    return jax.device_put(x, shd)
+
+
+def put_on_device(x, device):
+    """Place a host array whole on ONE device (multi-host staging: each
+    process feeds only its addressable devices' segments)."""
+    return jax.device_put(x, SingleDeviceSharding(device))
+
+
+def shard_rows(arr: np.ndarray, mesh: Optional[Mesh] = None,
+               axis: str = DATA_AXIS, fill=0):
+    """Pad rows to the shard multiple and place on the mesh, row-sharded.
+
+    Returns (device_array, valid_row_count); callers carry a validity mask
+    where padding could bias a result.
+    """
+    mesh = mesh or meshlib.get_default_mesh()
+    k = meshlib.num_shards(mesh, axis)
+    padded, n = meshlib.pad_rows(np.asarray(arr), k, fill=fill)
+    out = device_put(padded, row_sharding(mesh, axis, padded.ndim))
+    return out, n
+
+
+def put_replicated(tree, mesh: Optional[Mesh] = None):
+    sh = replicated(mesh)
+    return jax.tree_util.tree_map(lambda x: device_put(x, sh), tree)
+
+
+def put_tree(tree, specs, mesh: Optional[Mesh] = None):
+    """Place a pytree with per-leaf PartitionSpecs (``specs`` mirrors the
+    tree) — the DNN/transformer parameter placement path."""
+    mesh = mesh or meshlib.get_default_mesh()
+    return jax.tree_util.tree_map(
+        lambda x, s: device_put(x, NamedSharding(mesh, s)), tree, specs)
+
+
+def to_device(x) -> jnp.ndarray:
+    """h2d funnel for default (committed/replicated-on-one) placement —
+    the predict hot path's single upload rides this."""
+    return jnp.asarray(x)
+
+
+def to_host(x) -> np.ndarray:
+    """d2h funnel — the predict hot path's single download rides this."""
+    return np.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# Placement decisions
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PlacementPlan:
+    """A resolved placement decision: concrete mesh, shard count and backend
+    (never "auto"), safe to fold into compiled-program cache keys."""
+
+    mesh: Mesh
+    nshards: int
+    backend: str
+    decision: str            # "shard_rows" | "replicate"
+    axis: str = DATA_AXIS    # mesh axis the batch dim shards over
+
+    @property
+    def donate_buffers(self) -> bool:
+        """Whether round-loop buffer donation is safe/profitable on this
+        backend. ACCELERATORS ONLY: on the XLA CPU backend donating sharded
+        shard_map buffers produced nondeterministic heap corruption
+        (review-reproduced on jax 0.4.37: ~40% of runs segfaulted
+        mid-host-loop; 0/6 with donation off), and host-RAM copies are not
+        the bottleneck donation targets anyway."""
+        return self.backend != "cpu"
+
+    def batch(self, ndim: int = 1) -> NamedSharding:
+        """Row-sharded NamedSharding when this plan shards, replicated
+        otherwise — callers never re-derive the decision."""
+        if self.decision == "shard_rows":
+            return row_sharding(self.mesh, axis=self.axis, ndim=ndim)
+        return replicated(self.mesh)
+
+    def replicated(self) -> NamedSharding:
+        return replicated(self.mesh)
+
+
+# one flight event per DISTINCT decision, not per transfer: the set is
+# bounded by (site, mesh shape, decision) combinations actually exercised
+_SEEN_DECISIONS: set = set()
+
+
+def reset_decision_log() -> None:
+    """Forget emitted decisions (tests assert fresh events)."""
+    _SEEN_DECISIONS.clear()
+
+
+def plan_for(site: str, *, mesh: Optional[Mesh] = None,
+             rows: Optional[int] = None, replicate: bool = False,
+             axis: Optional[str] = None, **note) -> PlacementPlan:
+    """Resolve the placement decision for one estimator site.
+
+    The decision is batch-dim sharding whenever the mesh has >1 shard on
+    the batch axis, else replication (``replicate=True`` forces it — e.g.
+    the fused predictor, whose executable cache is keyed on exact batch
+    shapes). ``rows`` is recorded on the event for post-hoc reading but
+    does NOT flip the decision: shard sites pad short batches to the
+    shard multiple and shard them anyway (``shard_rows``), so a
+    row-count heuristic here would log a placement that never happened.
+    ``axis`` names the mesh axis the batch dim shards over (default the
+    ``data`` axis — sites that follow the mesh's leading axis pass it
+    explicitly). The backend is resolved HERE, before any caller builds
+    a cache key. Every distinct decision is emitted as a ``placement``
+    flight event.
+    """
+    mesh = mesh or meshlib.get_default_mesh()
+    axis = axis or DATA_AXIS
+    nshards = meshlib.num_shards(mesh, axis)
+    backend = jax.default_backend()
+    if replicate or nshards <= 1:
+        decision = "replicate"
+    else:
+        decision = "shard_rows"
+    mesh_shape = tuple(sorted(dict(mesh.shape).items()))
+    seen_key = (site, mesh_shape, backend, decision, axis,
+                tuple(sorted(note.items())))
+    if seen_key not in _SEEN_DECISIONS:
+        _SEEN_DECISIONS.add(seen_key)
+        _flight.record("placement", site=site, decision=decision,
+                       mesh=dict(mesh.shape), nshards=nshards,
+                       backend=backend, axis=axis,
+                       rows=-1 if rows is None else int(rows), **note)
+    return PlacementPlan(mesh=mesh, nshards=nshards, backend=backend,
+                         decision=decision, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# Deterministic histogram-reduction geometry (GrowConfig.hist_blocks)
+# ---------------------------------------------------------------------------
+
+
+def resolve_hist_blocks(requested, mesh: Mesh, n_pad: int,
+                        voting: bool = False) -> int:
+    """Resolve ``GrowConfig.hist_blocks`` to a concrete block count.
+
+    ``"auto"`` reads ``MMLSPARK_TPU_HIST_BLOCKS`` (0 = the plain psum path,
+    today's default numerics). An explicit count pins the canonical
+    reduction geometry: histograms are computed per row block and folded in
+    block order, so every device count dividing the block count grows
+    BIT-IDENTICAL trees (1/2/4/8 devices at the default 8). Must run before
+    the config enters any compiled-program cache key (the PR 4 rule) — the
+    resolved int keys the step cache via the GrowConfig itself.
+
+    An explicit request that cannot hold on this mesh/padding raises; the
+    env-knob path degrades to 0 with a flight event instead (an operator
+    hint must not kill unrelated fits).
+    """
+    nshards = meshlib.num_shards(mesh)
+    from_env = False
+    if requested == "auto":
+        requested, from_env = env_int("MMLSPARK_TPU_HIST_BLOCKS", 0), True
+    if not isinstance(requested, int) or isinstance(requested, bool):
+        raise ValueError(
+            f"hist_blocks must be an int or 'auto', got {requested!r}")
+    if requested in (0, 1):
+        return 0
+    hb = int(requested)
+    problem = None
+    if voting:
+        problem = "voting_parallel's shard-local ballot is inherently " \
+                  "topology-dependent"
+    elif hb % nshards:
+        problem = f"block count {hb} is not a multiple of the mesh's " \
+                  f"{nshards} data shards"
+    elif n_pad % hb:
+        problem = f"padded row count {n_pad} is not a multiple of {hb} " \
+                  "(pad rows to the block count for topology-independent " \
+                  "training)"
+    if problem is None:
+        return hb
+    if from_env:
+        _flight.record("placement", site="gbdt.hist_blocks",
+                       decision="fallback_plain", requested=hb,
+                       nshards=nshards, reason=problem)
+        return 0
+    raise ValueError(f"hist_blocks={hb}: {problem}")

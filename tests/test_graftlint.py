@@ -186,6 +186,37 @@ class TestFunnelRules:
         assert not hits(active, "deadline-header-literal",
                         "mmlspark_tpu/robustness/policy.py")
 
+    def test_placement_funnel(self, tmp_path):
+        active, suppressed = run_rule(tmp_path, "placement-funnel", {
+            "mmlspark_tpu/parallel/placement.py":
+                "def pspec(*entries):\n    return entries\n",
+            "mmlspark_tpu/parallel/compat.py": """\
+                import jax
+
+                def put(x):
+                    return jax.device_put(x)   # allowlisted module
+            """,
+            "mmlspark_tpu/models/rogue.py": """\
+                import jax
+                from jax.sharding import Mesh, NamedSharding
+                from jax import device_put
+
+                def put(x, mesh, spec):
+                    import jax.sharding
+                    sh = jax.sharding.PartitionSpec("data")
+                    out = jax.device_put(x, NamedSharding(mesh, sh))
+                    ok = jax.device_put(x)  # graftlint: disable=placement-funnel (test)
+                    return out, sh, ok, device_put
+            """})
+        got = hits(active, "placement-funnel", "mmlspark_tpu/models/rogue.py")
+        # the Mesh import is legal (topology, not placement); the
+        # NamedSharding / bare-device_put / module imports, the
+        # jax.sharding.PartitionSpec attribute and jax.device_put are not
+        assert [f.line for f in got] == [2, 3, 6, 7, 8], active
+        assert [f.line for f in suppressed] == [9]
+        assert not hits(active, "placement-funnel",
+                        "mmlspark_tpu/parallel/compat.py")
+
     def test_retry_sleep_funnel(self, tmp_path):
         active, suppressed = run_rule(tmp_path, "retry-sleep-funnel", {
             "mmlspark_tpu/robustness/policy.py":

@@ -13,7 +13,8 @@ from jax.sharding import PartitionSpec as P
 
 from mmlspark_tpu.parallel.compat import shard_map
 from mmlspark_tpu.parallel.mesh import (make_mesh, num_shards, pad_rows,
-                                        shard_rows, validity_mask)
+                                        validity_mask)
+from mmlspark_tpu.parallel.placement import shard_rows
 from mmlspark_tpu.parallel.ring_attention import (blockwise_attention,
                                                   local_attention,
                                                   ring_attention)

@@ -700,6 +700,99 @@ class TestPreemptionResume:
         b = (tmp_path / "resumed.txt").read_text()
         assert a == b, "resumed trees differ from the uninterrupted run"
 
+    @pytest.mark.chaos
+    def test_sharded_kill_resumes_on_smaller_mesh(self, tmp_path):
+        """The sharded round loop wears the whole robustness plane: a fit
+        hard-killed mid-round on an 8-device mesh resumes — on a 2-DEVICE
+        mesh — to trees bit-identical with an uninterrupted 8-device run.
+        Works because (a) checkpoints carry the exact accumulated score
+        matrices (gathered to host, so the payload is topology-free) and
+        (b) MMLSPARK_TPU_HIST_BLOCKS=8 pins the canonical histogram
+        reduction geometry, making the remaining rounds independent of the
+        device count (tests/test_placement.py proves the general
+        identity)."""
+        det = {"MMLSPARK_TPU_HIST_BLOCKS": "8",
+               "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+
+        def fit(out, ckpt, devices, extra=None):
+            e = _gateway_env(det)
+            e["XLA_FLAGS"] = \
+                f"--xla_force_host_platform_device_count={devices}"
+            e.update(extra or {})
+            return subprocess.run(
+                [sys.executable, "-c", _FIT_DRIVER, str(out), str(ckpt)],
+                env=e, capture_output=True, text=True, timeout=600)
+
+        control = fit(tmp_path / "control.txt", tmp_path / "ck_c", 8)
+        assert control.returncode == 0, control.stderr[-2000:]
+
+        killed = fit(tmp_path / "never.txt", tmp_path / "ck", 8,
+                     {failpoints.FAILPOINTS_ENV: "gbdt.round:exit@8"})
+        assert killed.returncode == 17, (killed.returncode, killed.stderr)
+        assert not (tmp_path / "never.txt").exists()
+
+        resumed = fit(tmp_path / "resumed.txt", tmp_path / "ck", 2)
+        assert resumed.returncode == 0, resumed.stderr[-2000:]
+        assert (tmp_path / "control.txt").read_text() == \
+            (tmp_path / "resumed.txt").read_text(), \
+            "2-device resume diverged from the uninterrupted 8-device run"
+
+
+class TestShardedRobustnessPlane:
+    """gbdt.round failpoints + the round-loop heartbeat fire under
+    shard_map exactly as they do single-device (the host loop hosting them
+    is topology-agnostic; these pin that it stays so)."""
+
+    @staticmethod
+    def _fit(**kw):
+        import numpy as np
+
+        from mmlspark_tpu.models.gbdt.booster import train_booster
+        from mmlspark_tpu.models.gbdt.growth import GrowConfig
+
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(240, 5)).astype(np.float32)
+        y = (X[:, 0] - X[:, 1] > 0).astype(np.float32)
+        cfg = GrowConfig(num_leaves=7, min_data_in_leaf=5)
+        # iteration_callback pins the HOST round loop (the fused
+        # single-dispatch paths have no per-round failpoint evaluation)
+        return train_booster(X, y, objective="binary", num_iterations=4,
+                             cfg=cfg, max_bin=63, bin_sample_count=240,
+                             iteration_callback=lambda it, m: None, **kw)
+
+    @pytest.mark.chaos
+    def test_round_failpoint_fires_in_sharded_fit(self):
+        from mmlspark_tpu.observability import metrics
+
+        failpoints.configure("gbdt.round:error@2", seed=3)
+        try:
+            with pytest.raises(failpoints.InjectedFault):
+                self._fit()
+        finally:
+            failpoints.clear()
+        assert metrics.counter("failpoints_fired_total", site="gbdt.round",
+                               kind="error").value >= 1.0
+
+    def test_round_heartbeat_lives_and_closes(self):
+        from mmlspark_tpu.observability import watchdog
+
+        beats = []
+        orig = watchdog.register
+
+        def spying(site, **kw):
+            hb = orig(site, **kw)
+            if site == "gbdt_round_loop":
+                beats.append(hb)
+            return hb
+
+        watchdog.register = spying
+        try:
+            self._fit()
+        finally:
+            watchdog.register = orig
+        assert beats, "sharded host round loop never registered its " \
+                      "heartbeat"
+
 
 class TestChaosAcceptance:
     @pytest.mark.chaos

@@ -8,7 +8,8 @@
 #
 #   tools/ci_check.sh            # human summary + JSON artifact
 #   GRAFTLINT_JSON=out.json tools/ci_check.sh
-#   CI_SKIP_CHAOS=1 tools/ci_check.sh   # lint/docs gates only
+#   CI_SKIP_CHAOS=1 tools/ci_check.sh      # skip the chaos smoke
+#   CI_SKIP_MULTICHIP=1 tools/ci_check.sh  # skip the 8-device dry run
 set -u -o pipefail
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -97,8 +98,25 @@ EOF
     fi
 fi
 
+# dryrun_multichip lane: the cross-device-count tree-identity suite on a
+# virtual 8-device CPU mesh (xla_force_host_platform_device_count) — the
+# full histogram-engine matrix, including the tiers tier-1 deselects as
+# `slow`. Proves every engine grows bit-identical trees on 1/2/8 devices
+# before any real-pod run trusts the sharded path.
+if [ "${CI_SKIP_MULTICHIP:-0}" != "1" ]; then
+    if (cd "$ROOT" && env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+            XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+            python -m pytest tests/test_placement.py -q \
+            -p no:cacheprovider); then
+        echo "ci_check: dryrun_multichip clean"
+    else
+        echo "ci_check: dryrun_multichip FAILED" >&2
+        rc=1
+    fi
+fi
+
 if [ "$rc" -ne 0 ]; then
-    echo "ci_check: FAILED (graftlint findings, env-docs drift, or chaos smoke)" >&2
+    echo "ci_check: FAILED (graftlint findings, env-docs drift, chaos smoke, or multichip dry run)" >&2
 else
     echo "ci_check: clean"
 fi

@@ -30,6 +30,12 @@ walks in ``tests/test_lint.py``:
   ``io/`` is an unjittered, deadline-blind retry (or a poll that should
   ride an Event); the sanctioned delays are ``robustness/policy.py``'s
   ``backoff`` / ``RetryPolicy.sleep_before``.
+* ``placement-funnel`` — ``parallel/placement.py`` is THE device-placement
+  layer (ROADMAP item 6): only it may call ``jax.device_put`` or construct
+  ``NamedSharding``/``PartitionSpec``/``SingleDeviceSharding``
+  (``parallel/compat.py`` allowlisted). An ad-hoc placement call site
+  re-opens the per-model-family placement divergence the funnel closed,
+  and its decision is invisible to the flight recorder.
 """
 
 from __future__ import annotations
@@ -99,6 +105,42 @@ def _match_deadline_header(mod: Module) -> Matches:
         if isinstance(node, ast.Constant) and isinstance(node.value, str) \
                 and node.value.strip().lower() == "x-deadline-ms":
             yield node.lineno, repr(node.value)
+
+
+_PLACEMENT_NAMES = frozenset(
+    {"NamedSharding", "PartitionSpec", "SingleDeviceSharding"})
+
+
+def _match_placement(mod: Module) -> Matches:
+    """Raw jax placement surface: importing the sharding constructors
+    (from jax.sharding OR re-exported through jax), importing the
+    jax.sharding module wholesale (any constructor is then one attribute
+    away), touching constructors via an attribute path ending in
+    ``.sharding.<Name>``, or calling ``device_put`` as ``jax.device_put``/
+    a bare import. Importing ``Mesh`` by name stays legal — mesh topology
+    is :mod:`parallel.mesh`'s business, placement is not."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module in ("jax.sharding", "jax"):
+                for alias in node.names:
+                    if alias.name in _PLACEMENT_NAMES or (
+                            node.module == "jax"
+                            and alias.name in ("device_put", "sharding")):
+                        yield (node.lineno,
+                               f"from {node.module} import {alias.name}")
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax.sharding":
+                    yield node.lineno, "import jax.sharding"
+        elif isinstance(node, ast.Attribute):
+            if (node.attr == "device_put"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "jax"):
+                yield node.lineno, "jax.device_put"
+            elif (node.attr in _PLACEMENT_NAMES
+                  and isinstance(node.value, ast.Attribute)
+                  and node.value.attr == "sharding"):
+                yield node.lineno, f"<module>.sharding.{node.attr}"
 
 
 def _match_loop_sleep(mod: Module) -> Matches:
@@ -198,6 +240,20 @@ FUNNEL_RULES: Tuple[FunnelRule, ...] = (
         remedy="use robustness.policy.DEADLINE_HEADER (a re-spelled "
                "literal silently breaks deadline propagation at that hop)",
         anchors=(("mmlspark_tpu/robustness/policy.py", None),),
+    ),
+    FunnelRule(
+        rule="placement-funnel",
+        description="device placement (device_put / NamedSharding / "
+                    "PartitionSpec / SingleDeviceSharding) only via "
+                    "parallel/placement.py",
+        scope=("mmlspark_tpu",),
+        allow=("mmlspark_tpu/parallel/placement.py",
+               "mmlspark_tpu/parallel/compat.py"),
+        match=_match_placement,
+        remedy="route through parallel.placement (pspec / sharding / "
+               "shard_rows / device_put / put_on_device) so the decision "
+               "is funneled and flight-logged",
+        anchors=(("mmlspark_tpu/parallel/placement.py", "pspec"),),
     ),
     FunnelRule(
         rule="retry-sleep-funnel",
