@@ -1,0 +1,71 @@
+"""asyncserve: the event-loop serving plane with continuous batching.
+
+The threaded stack (``io/serving.py``) pays one OS thread and one TCP
+handshake per request and dequeues fixed ``get_batch`` windows; this
+package rebuilds the request plane for the 100k+ RPS north star
+(ROADMAP item 3) while keeping the scoring contract byte-compatible:
+
+- :class:`~.server.AsyncServingServer` — a loop-native HTTP/1.1 front
+  (``asyncio`` streams, keep-alive, no ``ThreadingHTTPServer``): one
+  event loop multiplexes every connection, and each parked request is
+  an ``asyncio.Future`` instead of a blocked handler thread.
+- **Continuous batching** — requests are admitted into the *forming*
+  device batch the moment a slot frees, not at fixed dequeue windows:
+  while the scoring thread runs batch N on the device, the loop decodes
+  arrivals straight into the next staging buffer, and the instant the
+  device frees the scorer takes whatever has formed (the Gemma-on-TPU
+  serving playbook: the device batch never drains and refills).
+- :class:`~.slots.SlotTable` — pre-pinned ping-pong staging buffers:
+  rows decode once into a pre-allocated pow2-bucket array, so a scoring
+  call does zero Python-side copies beyond the one h2d/d2h the fused
+  predictor already guarantees (the upload rides
+  ``parallel/placement.py`` inside the predictor).
+- **Full contract parity** with the threaded engine: tracing headers +
+  ``X-Request-Id`` echo, the shared ``/metrics`` ``/healthz`` ``/varz``
+  ``/debug/*`` funnels, deadline propagation, bounded-queue 429 shed,
+  SIGTERM drain, and the ``serving.handle`` / ``serving.batch``
+  failpoints — the gateway and the existing tests transfer unchanged.
+
+Engine selection: ``MMLSPARK_TPU_SERVING_ENGINE=threaded|async`` (the
+threaded stack stays the default until a bench round retires it),
+overridable per query via ``serve().engine(...)`` and per worker via
+``serving_main --engine``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ...observability import flight as _flight
+
+ENGINE_ENV = "MMLSPARK_TPU_SERVING_ENGINE"
+ENGINES = ("threaded", "async")
+
+
+def resolve_engine(requested: Optional[str] = None) -> str:
+    """Resolve the serving engine before any server is built.
+
+    An explicit ``requested`` value must be valid (a typo'd flag fails
+    loudly); the env-knob path degrades to ``threaded`` with a flight
+    event instead — an operator hint must not kill a worker at boot
+    (the ``resolve_hist_blocks`` idiom).
+    """
+    if requested is not None:
+        if requested not in ENGINES:
+            raise ValueError(f"unknown serving engine {requested!r} "
+                             f"(known: {list(ENGINES)})")
+        return requested
+    env = (os.environ.get(ENGINE_ENV, "") or "threaded").strip().lower()
+    if env not in ENGINES:
+        _flight.record("serving_engine", decision="fallback_threaded",
+                       requested=env)
+        return "threaded"
+    return env
+
+
+from .server import AsyncServingQuery, AsyncServingServer  # noqa: E402
+from .slots import SlotTable  # noqa: E402
+
+__all__ = ["AsyncServingQuery", "AsyncServingServer", "SlotTable",
+           "resolve_engine", "ENGINE_ENV", "ENGINES"]

@@ -1,0 +1,95 @@
+"""Pre-pinned staging buffers: the zero-copy half of continuous batching.
+
+The threaded engine builds every device batch as list-of-rows ->
+``np.asarray`` — one full Python-side copy per batch, on the scoring
+thread, while the device waits. Here the copy disappears: rows decode
+straight into a pre-allocated ``[slots, width]`` staging array at
+ADMISSION time (on the event loop, overlapped with device compute),
+and the scoring call receives a pow2-bucket *view* of that array — the
+only remaining transfers are the one h2d the fused predictor performs
+through ``parallel/placement.py`` and its one d2h.
+
+Two ping-pong buffers make this safe without copies: the loop fills
+the FORMING buffer while the scoring thread reads the DISPATCHED one;
+:meth:`SlotTable.flip` swaps them at dispatch. One scoring thread owns
+the device (the PR 2 executable cache is process-wide but the round
+loop is single-owner), so two buffers are exactly enough.
+
+Sizing: ``slots`` is the device-batch slot count — the pow2 bucket cap
+the compiled predictor sees. ``MMLSPARK_TPU_ASERVE_SLOTS`` overrides
+it fleet-wide (0 keeps the per-query ``max_batch``); the admission
+backlog bound stays ``MMLSPARK_TPU_MAX_QUEUE_DEPTH``, shared with the
+threaded engine.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from ...observability.env_registry import env_int
+from ..serving import bucket_size
+
+SLOTS_ENV = "MMLSPARK_TPU_ASERVE_SLOTS"
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << (max(1, int(n)) - 1).bit_length()
+
+
+def resolve_slots(max_batch: int) -> int:
+    """The effective slot count: the env override when set (>0), else
+    ``max_batch``; always rounded up to a power of two so the bucket
+    ladder is exact."""
+    n = env_int(SLOTS_ENV, 0)
+    if n <= 0:
+        n = max_batch
+    return _pow2_ceil(n)
+
+
+class SlotTable:
+    """Ping-pong pow2 staging for one serving query's feature rows."""
+
+    def __init__(self, slots: int, width: int, dtype=np.float32):
+        if slots < 1 or width < 1:
+            raise ValueError(f"slot table needs slots>=1 and width>=1, "
+                             f"got {slots}x{width}")
+        self.slots = _pow2_ceil(slots)
+        self.width = int(width)
+        self._bufs = (np.zeros((self.slots, self.width), dtype),
+                      np.zeros((self.slots, self.width), dtype))
+        self._active = 0
+
+    @property
+    def forming(self) -> np.ndarray:
+        """The buffer the loop is currently decoding arrivals into."""
+        return self._bufs[self._active]
+
+    def write(self, slot: int, row) -> None:
+        """Decode one request's features into ``forming[slot]`` — THE
+        admission-time copy (list/JSON -> pinned row), after which the
+        row is never touched again until the device upload."""
+        row = np.asarray(row, dtype=self._bufs[0].dtype)
+        if row.shape != (self.width,):
+            raise ValueError(f"feature row has shape {row.shape}, "
+                             f"expected ({self.width},)")
+        self._bufs[self._active][slot, :] = row
+
+    def flip(self) -> np.ndarray:
+        """Dispatch: hand the forming buffer to the scoring thread and
+        make the other buffer the new forming target."""
+        dispatched = self._bufs[self._active]
+        self._active ^= 1
+        return dispatched
+
+    @staticmethod
+    def bucket_view(buf: np.ndarray, n: int) -> Tuple[np.ndarray, int]:
+        """``(view, bucket)``: the pow2-bucket slice the compiled
+        predictor scores. Padding rows repeat row 0 (the
+        ``bucketed_model_transform`` convention) so stale bytes from a
+        previous batch can't leak NaN-shaped behavior into the pad."""
+        b = bucket_size(n, buf.shape[0])
+        if n < b:
+            buf[n:b] = buf[0] if n else 0.0
+        return buf[:b], b

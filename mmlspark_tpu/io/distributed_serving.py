@@ -37,7 +37,7 @@ import time
 import uuid
 from dataclasses import asdict, dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from ..core.dataset import Dataset
 from ..observability import flight as _flight
@@ -139,9 +139,13 @@ GATEWAY_RETRY_STATUS = (429, 502, 503)
 class GatewayServer:
     """Public HTTP front that load-balances over registered workers.
 
-    Routing: least-inflight worker (round-robin among ties) — the
-    MultiChannelMap.nextList distribution of the reference — skipping
-    workers whose circuit breaker is open. Failover: connection-level
+    Routing: least-loaded worker, skipping workers whose circuit
+    breaker is open. The load signal is the federation plane's scraped
+    per-worker ``serving_queue_depth`` gauge when every candidate has a
+    fresh scrape (a worker's own backlog sees traffic this gateway
+    never forwarded), degrading to gateway-local least-inflight with
+    round-robin among ties — the MultiChannelMap.nextList distribution
+    of the reference — while scrapes are stale. Failover: connection-level
     failures open the worker's breaker immediately (the worker is gone);
     retryable statuses (502/503; a 429 shed retries without a breaker
     strike — overload is not sickness) accumulate toward its error-rate /
@@ -306,17 +310,42 @@ class GatewayServer:
         return live
 
     def _pick(self, exclude=()) -> Optional[WorkerInfo]:
+        """Route to the least-loaded live worker.
+
+        Load signal, in preference order: the federation plane's scraped
+        per-worker ``serving_queue_depth`` gauges (the worker's OWN
+        backlog — it sees queued work this gateway never forwarded:
+        other gateways, direct clients, slow batches) PLUS this
+        gateway's in-flight delta, used when every candidate has a
+        fresh scrape; otherwise gateway-local least-inflight alone,
+        with round-robin among ties (the scrape plane being stale or
+        partial must degrade routing quality, not bias it toward the
+        workers that happen to have data). The inflight term is what
+        keeps a burst between federation sweeps from herding onto the
+        worker whose scrape happened to read shallow — depths only
+        refresh per sweep, inflight moves per request."""
         workers = [w for w in self._live_workers()
                    if w.worker_id not in exclude]
         if not workers:
             return None
+        depths = self.federation.gauge_values("serving_queue_depth")
         with self._lock:
-            load = [(self._inflight.get(self._addr(w), 0), i)
-                    for i, w in enumerate(workers)]
+            if depths and all(self._addr(w) in depths for w in workers):
+                load = [(depths[self._addr(w)]
+                         + self._inflight.get(self._addr(w), 0), i)
+                        for i, w in enumerate(workers)]
+                mode = "queue_depth"
+            else:
+                load = [(self._inflight.get(self._addr(w), 0), i)
+                        for i, w in enumerate(workers)]
+                mode = "fallback"
             min_load = min(load)[0]
             candidates = [i for l, i in load if l == min_load]
             self._rr += 1
-            return workers[candidates[self._rr % len(candidates)]]
+            picked = workers[candidates[self._rr % len(candidates)]]
+        _metrics.safe_counter("gateway_route_mode_total",
+                              api=self.api_name, mode=mode).inc()
+        return picked
 
     def _retry_after(self, base: Optional[Dict[str, str]] = None,
                      est: Optional[float] = None) -> Dict[str, str]:
@@ -580,16 +609,25 @@ class DistributedServing:
                  num_workers: int = 2, host: str = "localhost",
                  api_name: str = "serving", max_batch: int = 32,
                  max_latency_ms: float = 5.0,
-                 registry: Optional[ServiceRegistry] = None):
+                 registry: Optional[ServiceRegistry] = None,
+                 engine: Optional[str] = None):
+        from .aserve import resolve_engine
         self.registry = registry or ServiceRegistry()
         self.workers: List[ServingQuery] = []
         self._infos: List[WorkerInfo] = []
+        use_async = resolve_engine(engine) == "async"
         for _ in range(num_workers):
-            server = ServingServer(host, 0, api_name)
-            q = ServingQuery(server, transform, max_batch=max_batch,
-                             max_latency=max_latency_ms / 1000.0)
+            if use_async:
+                from .aserve import AsyncServingQuery, AsyncServingServer
+                aserver = AsyncServingServer(host, 0, api_name,
+                                             slots=max_batch)
+                q: Any = AsyncServingQuery(aserver, transform=transform)
+            else:
+                server = ServingServer(host, 0, api_name)
+                q = ServingQuery(server, transform, max_batch=max_batch,
+                                 max_latency=max_latency_ms / 1000.0)
             info = WorkerInfo(worker_id=uuid.uuid4().hex[:12], host=host,
-                              port=server.port, api_name=api_name)
+                              port=q.server.port, api_name=api_name)
             self.workers.append(q)
             self._infos.append(info)
         self.gateway = GatewayServer(self.registry, host, 0, api_name)
@@ -597,6 +635,9 @@ class DistributedServing:
     def start(self) -> "DistributedServing":
         for q, info in zip(self.workers, self._infos):
             q.start()
+            # async workers bind (and learn an ephemeral port) at
+            # start() — the registry entry must carry the real port
+            info.port = q.server.port
             self.registry.register(info)
         self.gateway.start()
         return self

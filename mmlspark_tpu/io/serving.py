@@ -123,18 +123,6 @@ def write_http_response(handler: BaseHTTPRequestHandler, status: int,
         _metrics.safe_counter(counter, code=str(status), **labels).inc()
 
 
-def write_metrics_response(handler: BaseHTTPRequestHandler,
-                           extra: bytes = b"") -> None:
-    """Answer a scrape on any ``BaseHTTPRequestHandler`` in-band — shared
-    by ``ServingServer`` and the distributed-serving gateway so the
-    exposition content type stays defined in exactly one place.
-    ``extra`` appends pre-rendered families (the gateway's federated
-    ``cluster_*`` suffix)."""
-    write_http_response(
-        handler, 200, render_metrics() + extra,
-        {"Content-Type": "text/plain; version=0.0.4; charset=utf-8"})
-
-
 _device_probe: Optional[Dict[str, Any]] = None
 
 
@@ -210,18 +198,16 @@ def varz_payload(api_name: str, federation: Optional[Any] = None
     return payload
 
 
-def write_debug_response(handler: BaseHTTPRequestHandler, route: str,
-                         api_name: str,
-                         federation: Optional[Any] = None) -> None:
-    """Answer any debug route in-band (never queued: these must work
-    even when the batching worker or every backend worker is wedged).
-    ``federation`` is the gateway's :class:`MetricsFederator`: it extends
-    ``/metrics`` with the merged ``cluster_*`` families, ``/varz`` with
-    the scrape-health section, and backs ``/debug/cluster``."""
+def debug_body(route: str, api_name: str,
+               federation: Optional[Any] = None) -> tuple:
+    """``(body_bytes, content_type)`` for any debug route — the one
+    payload builder both serving engines (the threaded handler below and
+    the asyncio front in ``io/aserve``) answer debug traffic from, so
+    the exposition formats cannot drift between engines."""
     if route == "metrics":
-        write_metrics_response(
-            handler, b"" if federation is None else federation.render_metrics())
-        return
+        extra = b"" if federation is None else federation.render_metrics()
+        return (render_metrics() + extra,
+                "text/plain; version=0.0.4; charset=utf-8")
     if route == "healthz":
         payload: Any = healthz_payload()
     elif route == "varz":
@@ -234,9 +220,23 @@ def write_debug_response(handler: BaseHTTPRequestHandler, route: str,
                                  "gateway)"})
     else:
         payload = _flight.snapshot()
-    body = json.dumps(payload, default=repr).encode("utf-8")
-    write_http_response(handler, 200, body,
-                        {"Content-Type": "application/json"},
+    return (json.dumps(payload, default=repr).encode("utf-8"),
+            "application/json")
+
+
+def write_debug_response(handler: BaseHTTPRequestHandler, route: str,
+                         api_name: str,
+                         federation: Optional[Any] = None) -> None:
+    """Answer any debug route in-band (never queued: these must work
+    even when the batching worker or every backend worker is wedged).
+    ``federation`` is the gateway's :class:`MetricsFederator`: it extends
+    ``/metrics`` with the merged ``cluster_*`` families, ``/varz`` with
+    the scrape-health section, and backs ``/debug/cluster``."""
+    body, ctype = debug_body(route, api_name, federation)
+    if route == "metrics":
+        write_http_response(handler, 200, body, {"Content-Type": ctype})
+        return
+    write_http_response(handler, 200, body, {"Content-Type": ctype},
                         counter="debug_requests_total",
                         api=api_name, endpoint=route)
 
@@ -393,15 +393,20 @@ class ServingServer:
                             # admission control: past the backlog bound,
                             # queueing only converts overload into
                             # timeouts — shed now and tell the client
-                            # when the queue will have drained
+                            # when the queue will have drained.
+                            # status (not counter=): these branches sit
+                            # inside the try, and the finally counts
+                            # serving_responses_total once — a counter=
+                            # here double-counted every shed (429 + a
+                            # phantom 504), a divergence the async
+                            # engine's exact-count parity surfaced
                             with outer._lock:
                                 outer._inflight.pop(req.id, None)
                             outer._shed("queue_full")
+                            status = 429
                             write_http_response(
                                 self, 429, b'{"error": "overloaded"}',
-                                outer.retry_after_hint(),
-                                counter="serving_responses_total",
-                                api=outer.api_name)
+                                outer.retry_after_hint())
                             return
                         if outer._draining and outer._withdraw(req):
                             # drain began between the flag check and the
@@ -409,11 +414,10 @@ class ServingServer:
                             # slipping into an already-flushed queue
                             # would die as a silent 504 after stop()
                             outer._shed("draining")
+                            status = 503
                             write_http_response(
                                 self, 503, b'{"error": "draining"}',
-                                outer.retry_after_hint(),
-                                counter="serving_responses_total",
-                                api=outer.api_name)
+                                outer.retry_after_hint())
                             return
                         outer._update_queue_depth()
                         # a deadlined request never parks past its budget:
@@ -946,6 +950,7 @@ class ServingBuilder:
         self._reply_col = "reply"
         self._timeout = 30.0
         self._max_queue_depth: Optional[int] = None
+        self._engine: Optional[str] = None
 
     def address(self, host: str, port: int = 0, api_name: str = "serving"
                 ) -> "ServingBuilder":
@@ -999,9 +1004,29 @@ class ServingBuilder:
         self._reply_col = col
         return self
 
-    def start(self) -> ServingQuery:
+    def engine(self, name: str) -> "ServingBuilder":
+        """Pick the serving engine: ``"threaded"`` (this module's
+        ``ThreadingHTTPServer`` stack, the default) or ``"async"`` (the
+        ``io/aserve`` event-loop plane with continuous batching).
+        Unset, ``MMLSPARK_TPU_SERVING_ENGINE`` decides."""
+        self._engine = name
+        return self
+
+    def start(self):
         if self._transform is None:
             raise ValueError("no transform set; call .transform(fn) or .pipeline(model)")
+        # late import: aserve shares this module's funnels (debug_body,
+        # bucket_size), so the engine switch must not create an import
+        # cycle at module load
+        from .aserve import resolve_engine
+        if resolve_engine(self._engine) == "async":
+            from .aserve import AsyncServingQuery, AsyncServingServer
+            aserver = AsyncServingServer(
+                self._host, self._port, self._name, self._timeout,
+                max_queue_depth=self._max_queue_depth,
+                slots=self._max_batch)
+            return AsyncServingQuery(aserver, transform=self._transform,
+                                     reply_col=self._reply_col).start()
         server = ServingServer(self._host, self._port, self._name,
                                self._timeout,
                                max_queue_depth=self._max_queue_depth)

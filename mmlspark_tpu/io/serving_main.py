@@ -59,6 +59,42 @@ def _load_transform(model_path: str, input_col: str, output_col: str,
     return transform
 
 
+def _build_async_query(args):
+    """Async-engine worker: a ``.txt`` booster model rides the zero-copy
+    rows path (requests decode straight into the slot table, one h2d
+    per device dispatch); saved pipelines keep the Dataset transform
+    contract on the same event-loop front."""
+    from .aserve import AsyncServingQuery, AsyncServingServer
+    from .aserve.server import RowSpec
+    from .http import to_jsonable
+
+    if args.model.endswith(".txt"):
+        from ..models.gbdt.booster import Booster
+        with open(args.model) as f:
+            booster = Booster.from_string(f.read())
+        width = int(booster.binner_state.get("num_features") or 0)
+        if width > 0:
+            server = AsyncServingServer(
+                args.host, args.port, args.api_name,
+                max_queue_depth=args.max_queue_depth,
+                slots=args.max_batch,
+                row_spec=RowSpec(width, extract=args.input_col))
+
+            def scorer(X):
+                return booster.predict(X)
+
+            out_col = args.output_col
+            return AsyncServingQuery(
+                server, scorer=scorer,
+                reply_fn=lambda req, p: {out_col: to_jsonable(p)})
+    transform = _load_transform(args.model, args.input_col,
+                                args.output_col, max_batch=args.max_batch)
+    server = AsyncServingServer(args.host, args.port, args.api_name,
+                                max_queue_depth=args.max_queue_depth,
+                                slots=args.max_batch)
+    return AsyncServingQuery(server, transform=transform)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="mmlspark_tpu.io.serving_main")
     sub = p.add_subparsers(dest="role", required=True)
@@ -68,6 +104,12 @@ def main(argv=None) -> int:
                    help="saved pipeline dir or LightGBM .txt model")
     w.add_argument("--registry", required=True,
                    help="shared registry directory")
+    w.add_argument("--engine", choices=["threaded", "async"], default=None,
+                   help="serving engine (default: "
+                        "MMLSPARK_TPU_SERVING_ENGINE or threaded). "
+                        "async = io/aserve event loop with continuous "
+                        "batching; .txt booster models additionally get "
+                        "zero-copy slot-table admission")
     w.add_argument("--host", default="0.0.0.0")
     w.add_argument("--advertise-host", default=None,
                    help="address other hosts reach this worker at "
@@ -138,23 +180,32 @@ def main(argv=None) -> int:
         signal.signal(sig, lambda *a: stop.set())
 
     if args.role == "worker":
-        transform = _load_transform(args.model, args.input_col,
-                                    args.output_col,
-                                    max_batch=args.max_batch)
-        server = ServingServer(args.host, args.port, args.api_name,
-                               max_queue_depth=args.max_queue_depth)
-        query = ServingQuery(server, transform, max_batch=args.max_batch,
-                             max_latency=args.max_latency_ms / 1000.0)
+        from .aserve import resolve_engine
+        engine = resolve_engine(args.engine)
+        if engine == "async":
+            query = _build_async_query(args)
+            server = query.server
+        else:
+            transform = _load_transform(args.model, args.input_col,
+                                        args.output_col,
+                                        max_batch=args.max_batch)
+            server = ServingServer(args.host, args.port, args.api_name,
+                                   max_queue_depth=args.max_queue_depth)
+            query = ServingQuery(server, transform,
+                                 max_batch=args.max_batch,
+                                 max_latency=args.max_latency_ms / 1000.0)
         advertise = args.advertise_host or args.host
         if advertise in ("0.0.0.0", "::"):
             # a wildcard bind address is not reachable from other hosts:
             # fall back to this container/host's name (docker service DNS)
             import socket
             advertise = socket.gethostname()
+        # start BEFORE building the registry entry: the async engine
+        # binds its socket (and learns an ephemeral port) at start()
+        query.start()
         info = WorkerInfo(worker_id=uuid.uuid4().hex[:12],
                           host=advertise,
                           port=server.port, api_name=args.api_name)
-        query.start()
         registry.register(info)
         # console, not the JSON funnel: orchestration (docker entrypoints,
         # tests) parses this exact ready-line from stdout

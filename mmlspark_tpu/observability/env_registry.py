@@ -195,6 +195,21 @@ REGISTRY: Tuple[EnvVar, ...] = (
            section="performance",
            doc="`1`/`true`/`yes` degrades every streaming adopter to the "
                "plain sequential loop (no background reader thread)"),
+    EnvVar(name="MMLSPARK_TPU_SERVING_ENGINE", default="threaded",
+           section="performance",
+           doc="serving engine behind `serve()` / `serving_main`: "
+               "`threaded` (ThreadingHTTPServer + get_batch windows) or "
+               "`async` (io/aserve event loop, continuous batching, "
+               "zero-copy slot admission); `serve().engine(...)` and "
+               "`serving_main --engine` override; an unknown env value "
+               "degrades to `threaded` with a flight event"),
+    EnvVar(name="MMLSPARK_TPU_ASERVE_SLOTS", default="(max_batch)",
+           section="performance",
+           doc="async engine slot-table size — rows per pre-pinned "
+               "staging buffer, i.e. the device batch cap the compiled "
+               "predictor sees (pow2-rounded; 0 follows the query's "
+               "`max_batch`); the admission backlog bound stays "
+               "`MMLSPARK_TPU_MAX_QUEUE_DEPTH`"),
     # -- explainability ----------------------------------------------------
     EnvVar(name="MMLSPARK_TPU_SHAP_HOST", default="(auto by backend)",
            section="performance",

@@ -367,6 +367,36 @@ class MetricsFederator:
                 st = self._workers[label] = _WorkerState(label)
             return st
 
+    def gauge_values(self, family: str,
+                     max_age: Optional[float] = None) -> Dict[str, float]:
+        """Per-worker value of one gauge family from each worker's last
+        successful scrape — the feed for load-aware gateway routing
+        (``cluster_serving_queue_depth`` is ``serving_queue_depth`` seen
+        from here). Workers whose scrape is stale (older than
+        ``max_age``, default 3 sweep intervals) or failed are omitted,
+        so the caller can tell "depth 0" apart from "no fresh data" and
+        fall back. Series within a family (label sets, e.g. one per
+        api) sum per worker."""
+        if max_age is None:
+            max_age = 3.0 * self.interval
+        now = time.time()
+        out: Dict[str, float] = {}
+        with self._lock:
+            states = list(self._workers.items())
+        for label, st in states:
+            if st.error is not None or not st.last_success:
+                continue
+            if now - st.last_success > max_age:
+                continue
+            fam = st.families.get(family)
+            if fam is None:
+                continue
+            kind, rows = fam
+            if kind == "histogram":
+                continue
+            out[label] = sum(float(v) for _labels, v in rows)
+        return out
+
     # -- export --------------------------------------------------------------
     def _scrape_health_families(self) -> Families:
         now = time.time()

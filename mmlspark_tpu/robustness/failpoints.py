@@ -66,8 +66,8 @@ from ..observability import metrics as _metrics
 
 __all__ = [
     "SITES", "InjectedFault", "FaultAction", "Rule",
-    "configure", "clear", "active", "rules", "hit_count",
-    "fault_point",
+    "configure", "clear", "active", "ensure_configured", "rules",
+    "hit_count", "fault_point",
     "FAILPOINTS_ENV", "SEED_ENV",
 ]
 
@@ -311,6 +311,18 @@ def clear() -> None:
 
 
 def active() -> bool:
+    return bool(_rules)
+
+
+def ensure_configured() -> bool:
+    """Load the env spec if this process hasn't yet; True when any
+    rules are installed. The async serving plane gates its off-loop
+    fault evaluation on this — a ``delay`` rule sleeps inside
+    :func:`fault_point`, which must never run ON the event loop (one
+    injected delay there would stall every in-flight connection, not
+    the one request chaos meant to slow)."""
+    if _rules is None:
+        configure()
     return bool(_rules)
 
 

@@ -24,9 +24,13 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="localhost")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--api-name", default="serving")
+    p.add_argument("--engine", choices=["threaded", "async"],
+                   default=None)
     p.add_argument("--drain-settle-seconds", type=float, default=None)
     args = p.parse_args(argv)
 
+    from mmlspark_tpu.io.aserve import (AsyncServingQuery,
+                                        AsyncServingServer, resolve_engine)
     from mmlspark_tpu.io.distributed_serving import (ServiceRegistry,
                                                      WorkerInfo)
     from mmlspark_tpu.io.serving import ServingQuery, ServingServer
@@ -45,15 +49,20 @@ def main(argv=None) -> int:
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *a: stop.set())
 
-    server = ServingServer(args.host, args.port, args.api_name)
-    query = ServingQuery(server, transform, max_batch=16,
-                         max_latency=0.005)
-    info = WorkerInfo(worker_id=uuid.uuid4().hex[:12], host=args.host,
-                      port=server.port, api_name=args.api_name)
+    if resolve_engine(args.engine) == "async":
+        aserver = AsyncServingServer(args.host, args.port, args.api_name,
+                                     slots=16)
+        query = AsyncServingQuery(aserver, transform=transform)
+    else:
+        server = ServingServer(args.host, args.port, args.api_name)
+        query = ServingQuery(server, transform, max_batch=16,
+                             max_latency=0.005)
     query.start()
+    info = WorkerInfo(worker_id=uuid.uuid4().hex[:12], host=args.host,
+                      port=query.server.port, api_name=args.api_name)
     registry.register(info)
     _logging.console(f"worker {info.worker_id} serving on "
-                     f"{server.host}:{server.port}")
+                     f"{query.server.host}:{query.server.port}")
     try:
         stop.wait()
     finally:

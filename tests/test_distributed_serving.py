@@ -69,6 +69,50 @@ def test_worker_crash_fails_over():
         d.stop()
 
 
+def test_load_aware_routing_prefers_shallow_queue():
+    """With fresh federation scrapes, _pick routes by the workers' OWN
+    queue depth; with stale scrapes it falls back to the gateway-local
+    least-inflight/round-robin signal."""
+    import time as _time
+
+    from mmlspark_tpu.observability.federation import parse_prometheus_text
+
+    reg = ServiceRegistry()
+    reg.register(WorkerInfo("deep", "localhost", 1111))
+    reg.register(WorkerInfo("shallow", "localhost", 2222))
+    g = GatewayServer(reg)        # never started: _pick is pure routing
+    try:                          # (teardown closes the socket directly —
+        # stop() on a never-started server would wait on serve_forever)
+        fed = g.federation
+        now = _time.time()
+        for label, depth in (("localhost:1111", 7.0),
+                             ("localhost:2222", 1.0)):
+            st = fed._worker(label)
+            st.families = parse_prometheus_text(
+                "# TYPE serving_queue_depth gauge\n"
+                f'serving_queue_depth{{api="serving"}} {depth}\n')
+            st.last_success = st.last_attempt = now
+        picks = {g._pick().worker_id for _ in range(10)}
+        assert picks == {"shallow"}, picks
+
+        # between federation sweeps the scraped depths are frozen — the
+        # gateway-local inflight delta must keep a burst from herding
+        # onto the shallow-scraped worker (7+0 < 1+9 flips the pick)
+        g._inflight["localhost:2222"] = 9
+        picks = {g._pick().worker_id for _ in range(10)}
+        assert picks == {"deep"}, picks
+        g._inflight.clear()
+
+        # one worker's scrape goes stale -> partial data must not bias
+        # routing toward the scraped worker: fall back to least-inflight
+        fed._worker("localhost:2222").last_success = now - 3600
+        g._inflight["localhost:2222"] = 5       # shallow queue, busy hop
+        picks = {g._pick().worker_id for _ in range(10)}
+        assert picks == {"deep"}, picks
+    finally:
+        g._httpd.server_close()
+
+
 def test_no_workers_gives_503():
     reg = ServiceRegistry()
     g = GatewayServer(reg).start()

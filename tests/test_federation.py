@@ -140,6 +140,32 @@ class TestFederatorScrapes:
             fed.stop()
             srv.stop()
 
+    def test_gauge_values_freshness_and_summing(self):
+        """The load-aware routing feed: per-worker gauge sums from the
+        last successful scrape, with stale/failed workers omitted so
+        "depth 0" and "no data" stay distinguishable."""
+        from mmlspark_tpu.observability.federation import \
+            parse_prometheus_text
+
+        fed = MetricsFederator(lambda: [], interval=1.0)
+        now = time.time()
+        exposition = ("# TYPE serving_queue_depth gauge\n"
+                      'serving_queue_depth{api="a"} 3\n'
+                      'serving_queue_depth{api="b"} 2\n')
+        fresh = fed._worker("w1")
+        fresh.families = parse_prometheus_text(exposition)
+        fresh.last_success = now
+        stale = fed._worker("w2")
+        stale.families = parse_prometheus_text(exposition)
+        stale.last_success = now - 3600
+        failing = fed._worker("w3")
+        failing.families = parse_prometheus_text(exposition)
+        failing.last_success = now
+        failing.error = "HTTP 500"
+        got = fed.gauge_values("serving_queue_depth")
+        assert got == {"w1": 5.0}, got          # series summed; only fresh
+        assert fed.gauge_values("no_such_family") == {}
+
     def test_disabled_sweep_is_inert(self):
         calls = []
 

@@ -317,6 +317,68 @@ def test_obs_import_cycle(tmp_path):
 # hot-path-host-sync
 # --------------------------------------------------------------------------
 
+class TestAsyncBlockingCall:
+    def test_blocking_calls_in_async_def(self, tmp_path):
+        active, suppressed = run_rule(tmp_path, "async-blocking-call", {
+            "mmlspark_tpu/io/aserve/server.py": """\
+                import queue
+                import socket
+                import time
+
+                import requests
+
+
+                async def handle(conn, q):
+                    time.sleep(0.1)
+                    requests.get("http://x")
+                    sock = socket.create_connection(("x", 80))
+                    data = sock.recv(4096)
+                    item = q.get()
+                    item2 = q.get(timeout=1.0)
+                    ok = q.get(timeout=1.0)  # graftlint: disable=async-blocking-call (test)
+                    return data, item, item2, ok
+            """})
+        got = hits(active, "async-blocking-call",
+                   "mmlspark_tpu/io/aserve/server.py")
+        assert [f.line for f in got] == [9, 10, 11, 12, 13, 14], active
+        assert [f.line for f in suppressed] == [15]
+
+    def test_sync_code_and_nested_defs_exempt(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "async-blocking-call", {
+            "mmlspark_tpu/io/aserve/server.py": """\
+                import asyncio
+                import os
+                import time
+
+
+                def plain(q):
+                    # sync function: blocking is its business
+                    time.sleep(0.1)
+                    return q.get()
+
+
+                async def handler(loop, q, headers):
+                    # nested sync helper runs where it's CALLED (a worker
+                    # thread via to_thread) — not on the loop
+                    def pull():
+                        return q.get(timeout=1.0)
+
+                    item = await asyncio.to_thread(pull)
+                    # keyed mapping lookups are not queue reads
+                    val = headers.get("content-length")
+                    env = os.environ.get("HOME", "/")
+                    await asyncio.sleep(0)
+                    return item, val, env
+            """})
+        assert not active, active
+
+    def test_rots_without_async_defs(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "async-blocking-call", {
+            "mmlspark_tpu/plain.py": "def f():\n    return 1\n"})
+        rot = hits(active, "async-blocking-call")
+        assert len(rot) == 1 and "lint-rot" in rot[0].message, active
+
+
 class TestHotPathHostSync:
     def test_streaming_chunk_loop(self, tmp_path):
         active, suppressed = run_rule(tmp_path, "hot-path-host-sync", {

@@ -359,6 +359,10 @@ class TestAdmissionControl:
             assert metrics.counter("serving_shed_total", api="shed",
                                    reason="queue_full").value == 1.0
             assert any(e["kind"] == "shed" for e in flight.events())
+            # a shed counts ONCE, as a 429 — not also as a phantom 504
+            # (exact-count parity with the async engine's accounting)
+            assert metrics.counter("serving_responses_total", api="shed",
+                                   code="429").value == 1.0
             assert done.get(timeout=10)[0] == 504   # the parked request
         finally:
             server.stop()
@@ -545,11 +549,13 @@ def _wait_for(proc, pattern, timeout=90):
     raise AssertionError(f"pattern {pattern!r} not seen in {out}")
 
 
-def _spawn_worker(registry, env, port=0):
+def _spawn_worker(registry, env, port=0, engine=None):
+    cmd = [sys.executable, "-m", "tests._chaos_worker",
+           "--registry", str(registry), "--port", str(port)]
+    if engine:
+        cmd += ["--engine", engine]
     proc = subprocess.Popen(
-        [sys.executable, "-m", "tests._chaos_worker",
-         "--registry", str(registry), "--port", str(port)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         env=env)
     m, _ = _wait_for(proc, r"worker \w+ serving on ([\w.]+):(\d+)")
     return proc, int(m.group(2))
@@ -593,18 +599,25 @@ def _warm_workers(host, port, n_workers, timeout=60):
 
 class TestGracefulDrain:
     @pytest.mark.chaos
-    def test_sigterm_drain_zero_client_visible_errors(self, tmp_path):
+    # the async variant is slow-marked per the tier-1 wall budget (>10 s
+    # of subprocess spawns + fixed drain waits); ci lanes still run it,
+    # and the in-process drain contract rides tier-1 in test_aserve
+    @pytest.mark.parametrize("engine", [
+        "threaded", pytest.param("async", marks=pytest.mark.slow)])
+    def test_sigterm_drain_zero_client_visible_errors(self, tmp_path,
+                                                      engine):
         """Continuous traffic through the gateway while one of two
         workers is SIGTERM'd: every request answers 200 with its own
         echo, the drained worker exits cleanly, and its registry entry
-        is gone."""
+        is gone. Both serving engines keep this contract — the drain
+        plane is engine-transparent."""
         registry = tmp_path / "registry"
         env = _gateway_env({
             "MMLSPARK_TPU_GATEWAY_HEALTH_INTERVAL_SECONDS": "0.3",
             "MMLSPARK_TPU_DRAIN_SETTLE_SECONDS": "0.4",
         })
-        wa, porta = _spawn_worker(registry, env)
-        wb, portb = _spawn_worker(registry, env)
+        wa, porta = _spawn_worker(registry, env, engine=engine)
+        wb, portb = _spawn_worker(registry, env, engine=engine)
         gw, host, port = _spawn_gateway(registry, env)
         _warm_workers(host, port, 2)
         results, stop = [], threading.Event()

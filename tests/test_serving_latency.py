@@ -39,8 +39,11 @@ def _measure(host, port, path, n, payload=b'{"x": 1.0}'):
     return np.asarray(lat) * 1e3  # ms
 
 
-def serving_latency_stats(n_seq=200, n_conc=8, conc_each=50):
-    """Start a trivial-model serving query, return latency stats (ms)."""
+def serving_latency_stats(n_seq=200, n_conc=8, conc_each=50,
+                          engine=None):
+    """Start a trivial-model serving query, return latency stats (ms).
+    ``engine`` picks the serving engine (None = env default) — bench.py
+    measures both in one round for the threaded-vs-async A/B."""
 
     def transform(ds):
         vals = ds["value"]
@@ -48,9 +51,12 @@ def serving_latency_stats(n_seq=200, n_conc=8, conc_each=50):
             "reply", [{"entity": {"y": (v or {}).get("x", 0.0)},
                        "statusCode": 200} for v in vals])
 
-    q = (serve().address("localhost", 0, "bench")
+    b = (serve().address("localhost", 0, "bench")
          .batch(max_batch=64, max_latency_ms=5)
-         .transform(transform).start())
+         .transform(transform))
+    if engine is not None:
+        b = b.engine(engine)
+    q = b.start()
     host, port = q.server.host, q.server.port
     path = "/bench"
     try:

@@ -9,6 +9,7 @@
 #   tools/ci_check.sh            # human summary + JSON artifact
 #   GRAFTLINT_JSON=out.json tools/ci_check.sh
 #   CI_SKIP_CHAOS=1 tools/ci_check.sh      # skip the chaos smoke
+#   CI_SKIP_ASYNC=1 tools/ci_check.sh      # skip the async-serving smoke
 #   CI_SKIP_MULTICHIP=1 tools/ci_check.sh  # skip the 8-device dry run
 set -u -o pipefail
 
@@ -98,6 +99,76 @@ EOF
     fi
 fi
 
+# async-serving smoke lane: a live round-trip on the io/aserve engine
+# (continuous batching + keep-alive front) plus an injected-503 chaos
+# replay — the same proof the chaos lane gives the threaded engine, on
+# the async plane, without pytest.
+if [ "${CI_SKIP_ASYNC:-0}" != "1" ]; then
+    if (cd "$ROOT" && python - <<'EOF'
+import json
+import urllib.error
+import urllib.request
+
+from mmlspark_tpu.io.aserve import AsyncServingQuery
+from mmlspark_tpu.io.serving import serve
+from mmlspark_tpu.observability import flight, metrics
+from mmlspark_tpu.robustness import failpoints
+
+metrics.set_enabled(True)
+
+# deterministic replay (batch-side site, so the live smoke's
+# serving.handle counter below stays exactly 1)
+def pattern(seed):
+    failpoints.configure("serving.batch:error_503:0.5", seed=seed)
+    out = [failpoints.fault_point("serving.batch") is not None
+           for _ in range(32)]
+    failpoints.clear()
+    return out
+
+assert pattern(23) == pattern(23), "seeded chaos did not replay"
+
+failpoints.configure("serving.handle:error_503@2", seed=23)
+q = (serve().address("localhost", 0, "ci_async").engine("async")
+     .transform(lambda ds: ds.with_column("reply", [
+         {"entity": {"i": v["i"]}, "statusCode": 200}
+         for v in ds["value"]])).start())
+assert isinstance(q, AsyncServingQuery), type(q)
+try:
+    def post(payload):
+        req = urllib.request.Request(
+            q.server.url, data=json.dumps(payload).encode(), method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    status, body = post({"i": 0})
+    assert status == 200 and json.loads(body) == {"i": 0}, \
+        f"async round-trip failed: {status} {body!r}"
+    status, _ = post({"i": 1})
+    assert status == 503, f"injected fault not served: {status}"
+    status, body = post({"i": 2})
+    assert status == 200 and json.loads(body) == {"i": 2}, \
+        f"recovery failed: {status} {body!r}"
+finally:
+    q.stop()
+
+assert metrics.counter("failpoints_fired_total", site="serving.handle",
+                       kind="error_503").value == 1.0
+assert any(e["kind"] == "failpoint" and e["site"] == "serving.handle"
+           for e in flight.events()), "fault missing from the flight ring"
+print("async smoke: round-trip clean, injected 503 served, recovery "
+      "clean, replay deterministic")
+EOF
+    ); then
+        :
+    else
+        echo "ci_check: async-serving smoke FAILED" >&2
+        rc=1
+    fi
+fi
+
 # dryrun_multichip lane: the cross-device-count tree-identity suite on a
 # virtual 8-device CPU mesh (xla_force_host_platform_device_count) — the
 # full histogram-engine matrix, including the tiers tier-1 deselects as
@@ -116,7 +187,7 @@ if [ "${CI_SKIP_MULTICHIP:-0}" != "1" ]; then
 fi
 
 if [ "$rc" -ne 0 ]; then
-    echo "ci_check: FAILED (graftlint findings, env-docs drift, chaos smoke, or multichip dry run)" >&2
+    echo "ci_check: FAILED (graftlint findings, env-docs drift, chaos/async smoke, or multichip dry run)" >&2
 else
     echo "ci_check: clean"
 fi

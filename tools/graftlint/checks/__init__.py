@@ -10,3 +10,4 @@ from . import resources      # noqa: F401
 from . import locks          # noqa: F401
 from . import envvars        # noqa: F401
 from . import failpoints    # noqa: F401
+from . import asyncrules    # noqa: F401

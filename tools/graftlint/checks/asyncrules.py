@@ -1,0 +1,108 @@
+"""Async-blocking-call rule.
+
+The ``io/aserve`` plane multiplexes every connection over ONE event
+loop: a single blocking call inside an ``async def`` body stalls every
+in-flight request at once — the whole-process version of the hot-loop
+host-sync problem. This rule (``async-blocking-call``) flags the
+blocking idioms reviews would otherwise have to catch by hand, inside
+any ``async def`` in ``mmlspark_tpu/``:
+
+* ``time.sleep(...)`` — the loop-wide stall; use ``asyncio.sleep``.
+* ``requests.<anything>(...)`` — synchronous HTTP holds the loop for a
+  full network round-trip; use the loop's streams (or a thread).
+* synchronous socket traffic — ``socket.socket`` /
+  ``socket.create_connection`` / ``socket.getaddrinfo`` module calls,
+  and ``.recv(...)`` / ``.sendall(...)`` / ``.accept(...)`` method
+  calls (asyncio transports expose none of these names).
+* blocking ``queue.Queue.get`` — ``.get()`` with no arguments, or with
+  a ``block=``/``timeout=`` keyword. ``dict.get`` always takes a key
+  argument, so plain mapping lookups never match.
+
+Sync helpers *defined inside* an async function don't count against it
+(they run wherever they're called — usually a worker thread via
+``to_thread``/``run_in_executor``, which is the sanctioned escape
+hatch); each nested ``async def`` is scanned as its own surface.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import (Checker, CheckerRotError, Finding, Module, Repo,
+                    call_name, register)
+
+#: method names that only exist on synchronous sockets (asyncio
+#: transports/streams use write/drain/read instead)
+_SOCKET_METHODS = frozenset({"recv", "sendall", "accept"})
+#: socket-module constructors/resolvers that block on the network
+_SOCKET_MODULE_CALLS = frozenset({"socket", "create_connection",
+                                  "getaddrinfo"})
+
+
+def _async_body_nodes(fn: ast.AsyncFunctionDef) -> Iterator[ast.AST]:
+    """Nodes that execute ON the event loop when ``fn`` runs — nested
+    function/lambda bodies excluded (they run where they're called)."""
+    stack = list(fn.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _blocking_call(call: ast.Call) -> Optional[str]:
+    qual, name = call_name(call)
+    if qual == "time" and name == "sleep":
+        return "time.sleep — blocks the event loop; use asyncio.sleep"
+    if qual is not None and (qual == "requests"
+                             or qual.startswith("requests.")):
+        return (f"{qual}.{name} — synchronous HTTP holds the loop for "
+                "a full round-trip")
+    if qual == "socket" and name in _SOCKET_MODULE_CALLS:
+        return (f"socket.{name} — synchronous socket work on the loop; "
+                "use asyncio streams")
+    if qual is not None and name in _SOCKET_METHODS:
+        return (f".{name}() — synchronous socket traffic on the loop; "
+                "use asyncio streams")
+    if name == "get" and isinstance(call.func, ast.Attribute):
+        kw = {k.arg for k in call.keywords}
+        if (not call.args and not call.keywords) or \
+                kw & {"block", "timeout"}:
+            return (".get() — a blocking queue read parks the whole "
+                    "loop; hand the wait to a thread or use "
+                    "asyncio.Queue")
+    return None
+
+
+class AsyncBlockingCall(Checker):
+    rule = "async-blocking-call"
+    description = "no blocking calls (time.sleep / requests.* / sync " \
+                  "socket send-recv / blocking queue.Queue.get) inside " \
+                  "async def bodies"
+
+    def check(self, repo: Repo) -> Iterator[Finding]:
+        seen_async = 0
+        for mod in repo.package():
+            for fn in ast.walk(mod.tree):
+                if not isinstance(fn, ast.AsyncFunctionDef):
+                    continue
+                seen_async += 1
+                for node in _async_body_nodes(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    detail = _blocking_call(node)
+                    if detail:
+                        yield self.finding(
+                            mod, node.lineno,
+                            f"blocking call in async {fn.name}(): "
+                            f"{detail}")
+        if seen_async < 1:
+            raise CheckerRotError(
+                "no async def found in the package (io/aserve moved?) — "
+                "the rule matches nothing")
+
+
+register(AsyncBlockingCall())
