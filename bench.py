@@ -586,6 +586,10 @@ def _run_leg(on_tpu: bool) -> None:
         # (docs/mmlspark-serving.md:10-11). Host-only loop: no device in the
         # transform path (see docs/performance.md for the tunnel caveat).
         **_guard(_serving_latency, {}),
+        # worker cold-vs-warm start (AOT serving bundles, ROADMAP item 4):
+        # process spawn -> first successful /predict, with and without a
+        # prewarmed bundle
+        **_guard(_cold_warm_start, {}),
     }
     # roofline estimates: judge "fast" against hardware peak, not only the
     # 15/s anchor (assumptions documented in the helpers)
@@ -678,6 +682,98 @@ def _guard(fn, fallback):
     except Exception as e:  # noqa: BLE001
         print(f"[bench] secondary metric failed: {e!r}", file=sys.stderr)
         return fallback
+
+
+def _cold_warm_start() -> dict:
+    """Fleet cold-start contrast: seconds from worker process spawn to its
+    first successful /predict, cold (JIT compiles on the worker) vs warm
+    (prewarmed from an AOT serving bundle, ``mmlspark_tpu/bundles``).
+    Both workers run WITHOUT the bench's persistent compile cache — the
+    scenario is a fleet machine where nothing is mounted but the model
+    and (warm case) the bundle; the bundle's own shipped xla_cache is
+    what the warm path reads. Includes interpreter + jax import, which
+    is the honest number a rolling restart pays."""
+    import re
+    import signal
+    import urllib.request
+
+    import numpy as np
+
+    from mmlspark_tpu.models.gbdt.booster import train_booster
+    from mmlspark_tpu.models.gbdt.growth import GrowConfig
+
+    env = dict(os.environ)
+    env.pop("MMLSPARK_TPU_COMPILE_CACHE_DIR", None)
+    # the COLD worker must be genuinely cold: an ambient bundle knob
+    # would run the prewarm path and contaminate the contrast
+    env.pop("MMLSPARK_TPU_BUNDLE_DIR", None)
+    with tempfile.TemporaryDirectory() as d:
+        rng = np.random.default_rng(0)
+        # a forest deep/wide enough that the fused predict executable's
+        # XLA compile is a real cost (the quantity a fleet rollout pays
+        # per worker per bucket) — a toy model would measure only
+        # interpreter+jax import, which both paths pay identically
+        X = rng.normal(size=(4000, 16)).astype(np.float32)
+        y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+        booster = train_booster(X=X, y=y, num_iterations=30,
+                                objective="binary",
+                                cfg=GrowConfig(num_leaves=63))
+        model = os.path.join(d, "model.txt")
+        with open(model, "w") as f:
+            f.write(booster.model_string())
+        bundle = os.path.join(d, "model.bundle")
+        t0 = time.perf_counter()
+        subprocess.run([sys.executable, "-m", "mmlspark_tpu.bundles",
+                        "build", "--model", model, "--out", bundle,
+                        "--max-batch", "32"],
+                       env=env, check=True, timeout=600,
+                       stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL)
+        build_s = time.perf_counter() - t0
+
+        def start_worker(extra):
+            t0 = time.monotonic()
+            p = subprocess.Popen(
+                [sys.executable, "-m", "mmlspark_tpu.io.serving_main",
+                 "worker", "--model", model, "--registry",
+                 os.path.join(d, "reg"), "--host", "localhost",
+                 "--port", "0", "--max-batch", "32"] + extra,
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True)
+            try:
+                m = re.search(r"serving on \S+:(\d+)",
+                              p.stdout.readline() or "")
+                if not m:
+                    raise RuntimeError("worker printed no ready-line")
+                port = int(m.group(1))
+                body = json.dumps({"features": [0.1] * 16}).encode()
+                deadline = time.monotonic() + 120
+                while True:
+                    try:
+                        req = urllib.request.Request(
+                            f"http://localhost:{port}/serving",
+                            data=body, method="POST")
+                        with urllib.request.urlopen(req, timeout=5) as r:
+                            if r.status == 200:
+                                return time.monotonic() - t0
+                    except OSError:
+                        pass
+                    if time.monotonic() > deadline:
+                        raise RuntimeError("no successful /predict in 120s")
+                    time.sleep(0.02)
+            finally:
+                p.send_signal(signal.SIGTERM)
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+        cold = start_worker([])
+        warm = start_worker(["--bundle", bundle])
+    return {"cold_start_seconds": round(cold, 3),
+            "warm_start_seconds": round(warm, 3),
+            "bundle_build_seconds": round(build_s, 3),
+            "cold_vs_warm_start_x": round(cold / max(warm, 1e-9), 2)}
 
 
 def _serving_latency() -> dict:

@@ -1,0 +1,19 @@
+"""Serving bundles: offline AOT build + online prewarm (ROADMAP item 4).
+
+``python -m mmlspark_tpu.bundles build --model m.txt --out m.bundle``
+writes an atomic, versioned, checksummed directory of ``jax.export``-
+serialized fused predict executables; ``serving_main --bundle`` (or
+``MMLSPARK_TPU_BUNDLE_DIR``) prewarms a worker's predictor cache from
+it before the worker binds or registers — a warm-bundle restart serves
+its first request with zero compile events in the flight ring. See
+``docs/serving.md`` ("Serving bundles & cold start").
+"""
+
+from .bundle import (BundleError, FORMAT_VERSION, MANIFEST_NAME,
+                     boosters_of, build_bundle, load_model_boosters,
+                     model_hash, prewarm, read_manifest,
+                     runtime_fingerprint)
+
+__all__ = ["BundleError", "FORMAT_VERSION", "MANIFEST_NAME", "boosters_of",
+           "build_bundle", "load_model_boosters", "model_hash", "prewarm",
+           "read_manifest", "runtime_fingerprint"]

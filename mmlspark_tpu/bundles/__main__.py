@@ -1,0 +1,77 @@
+"""CLI for serving bundles.
+
+    python -m mmlspark_tpu.bundles build \
+        --model /models/churn.txt --out /models/churn.bundle \
+        --max-batch 32
+    python -m mmlspark_tpu.bundles inspect /models/churn.bundle
+
+``build`` AOT-lowers the fused predict executables for every pow2
+batch bucket the serving engines dispatch (override with
+``--batch-sizes``), serializes them via ``jax.export``, and writes the
+bundle atomically. ``inspect`` prints the manifest without touching
+jax — safe on any machine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="mmlspark_tpu.bundles")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    b = sub.add_parser("build", help="AOT-build a serving bundle")
+    b.add_argument("--model", required=True,
+                   help="saved pipeline dir or LightGBM .txt model")
+    b.add_argument("--out", required=True, help="bundle directory to write")
+    b.add_argument("--batch-sizes", default=None,
+                   help="comma-separated batch sizes (default: the pow2 "
+                        "ladder up to --max-batch — the only shapes the "
+                        "serving engines dispatch)")
+    b.add_argument("--max-batch", type=int, default=32,
+                   help="serving batch cap the pow2 ladder runs to "
+                        "(match the worker's --max-batch)")
+    b.add_argument("--num-iterations", default="-1",
+                   help="comma-separated num_iteration values to bundle "
+                        "(-1 = the full model)")
+    b.add_argument("--include-raw", action="store_true",
+                   help="also bundle the untransformed predict_raw "
+                        "executables")
+    b.add_argument("--force", action="store_true",
+                   help="replace an existing bundle directory")
+
+    i = sub.add_parser("inspect", help="print a bundle's manifest")
+    i.add_argument("bundle", help="bundle directory")
+
+    args = p.parse_args(argv)
+
+    from ..observability.logging import console
+    if args.cmd == "inspect":
+        from .bundle import read_manifest
+        # console, not the JSON log funnel: CLI output parsed by humans
+        # and scripts, like the serving_main ready-line
+        console(json.dumps(read_manifest(args.bundle), indent=2,
+                           sort_keys=True))
+        return 0
+
+    from .bundle import build_bundle
+    batch_sizes = None
+    if args.batch_sizes:
+        batch_sizes = [int(x) for x in args.batch_sizes.split(",") if x]
+    num_iterations = tuple(
+        int(x) for x in args.num_iterations.split(",") if x)
+    manifest = build_bundle(
+        args.model, args.out, batch_sizes=batch_sizes,
+        max_batch=args.max_batch, num_iterations=num_iterations,
+        include_raw=args.include_raw, force=args.force)
+    console(f"bundle written: {args.out} "
+            f"({len(manifest['entries'])} programs, "
+            f"fingerprint {manifest['fingerprint']['backend']}/"
+            f"{manifest['fingerprint']['device_kind']})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,503 @@
+"""AOT serving bundles: zero-compile fleet cold start.
+
+A serving worker restart pays XLA compiles before its first reply —
+fatal for a fleet rolling thousands of workers under live load (ROADMAP
+item 4). The fix is the reference framework's own premise turned up one
+level: ship pre-BUILT artifacts onto the cluster, where "built" now
+means *whole-program AOT-lowered*, not source — the fused predict
+executables the ``_PREDICT_CACHE`` machinery (models/gbdt/booster.py)
+lazily compiles online are exactly the artifact to serialize offline.
+
+Offline half (``build_bundle`` / ``python -m mmlspark_tpu.bundles
+build``): load the model, enumerate the predictor cache keys its pow2
+batch/tree buckets dispatch to (``Booster.predict_plan`` — the SAME
+key computation the serving hot path uses, so offline and online can
+never disagree), AOT-lower each program through the placement funnel,
+serialize via ``jax.export``, and write an atomic, versioned,
+checksummed bundle directory. The bundle also carries a populated
+persistent-compile-cache dir (``xla_cache/``, the PR 4 funnel) so even
+the deserialize-then-compile step at load time is a disk fetch where
+the backend supports it.
+
+Online half (``prewarm`` — wired into ``serving_main --bundle`` /
+``MMLSPARK_TPU_BUNDLE_DIR``): verify the manifest + per-file checksums
++ runtime fingerprint, deserialize and compile every entry, and install
+the finished programs into ``_PREDICT_CACHE`` **before the worker
+binds**. The first request then takes the cache-hit path: zero compile
+events in the flight ring, readiness gated on ``/healthz`` until the
+prewarm completes.
+
+A fingerprint mismatch (different jax/XLA, backend, device kind, or
+model bytes) is a LOUD structured warning plus fallback to online JIT
+— never a silent load that could serve wrong numerics: the executables
+are only ever installed under keys recomputed from the live model, so
+a stale bundle cannot be consulted for a model it wasn't built from.
+
+Only this package may touch ``jax.export`` (graftlint
+``bundle-io-funnel``): deserializing executables is an IO boundary with
+version-skew and integrity concerns that must stay behind one door.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..observability import flight as _flight
+from ..observability import metrics as _metrics
+from ..observability.logging import get_logger
+from ..utils import compile_cache as _compile_cache
+
+logger = get_logger("mmlspark_tpu.bundles")
+
+FORMAT_VERSION = 1
+MANIFEST_NAME = "MANIFEST.json"
+PROGRAMS_DIR = "programs"
+XLA_CACHE_DIR = "xla_cache"
+
+
+class BundleError(Exception):
+    """A bundle that cannot be used (missing, torn, or mismatched).
+
+    Raised by the offline/strict paths; the serving prewarm path catches
+    it and degrades to online JIT with the structured warning instead —
+    a bad bundle must never keep a worker from coming up."""
+
+
+# ---------------------------------------------------------------------------
+# Hashing / fingerprint
+# ---------------------------------------------------------------------------
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def model_hash(model_path: str) -> str:
+    """Content hash of a model artifact: file bytes for a ``.txt``
+    booster, a stable digest over (relpath, file-sha) pairs for a saved
+    pipeline directory. The bundle pins this so a bundle built from one
+    model can never prewarm a different one."""
+    if os.path.isdir(model_path):
+        h = hashlib.sha256()
+        for root, dirs, files in os.walk(model_path):
+            dirs.sort()
+            for name in sorted(files):
+                p = os.path.join(root, name)
+                rel = os.path.relpath(p, model_path).replace(os.sep, "/")
+                h.update(rel.encode("utf-8"))
+                h.update(_sha256_file(p).encode("ascii"))
+        return h.hexdigest()
+    return _sha256_file(model_path)
+
+
+def runtime_fingerprint() -> Dict[str, Any]:
+    """What must match between bundle build and bundle load for the
+    serialized executables to be trusted: jax/XLA version, resolved
+    backend platform (the placement funnel's decision input), and the
+    device kind. Captured AFTER the placement funnel resolves the
+    backend, so the fingerprint records what the programs were actually
+    lowered for."""
+    import jax
+
+    from .. import __version__
+    from ..parallel import placement
+
+    # resolve placement exactly the way the online predict path does —
+    # the funnel's backend decision is part of what the bundle pins
+    placement.plan_for("gbdt.predict", replicate=True)
+    devices = jax.devices()
+    return {
+        "framework_version": __version__,
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": devices[0].device_kind if devices else None,
+    }
+
+
+def _fingerprint_mismatches(built: Dict[str, Any],
+                            now: Dict[str, Any]) -> List[str]:
+    return [f"{k}: built={built.get(k)!r} runtime={now.get(k)!r}"
+            for k in sorted(set(built) | set(now))
+            if built.get(k) != now.get(k)]
+
+
+# ---------------------------------------------------------------------------
+# Model loading (shared by the build CLI and the serving prewarm)
+# ---------------------------------------------------------------------------
+
+
+def boosters_of(model: Any) -> List[Any]:
+    """Every :class:`Booster` an in-memory model object dispatches
+    predictions through, in a stable order: the booster itself, or the
+    ``.booster`` of each fitted GBDT stage of a pipeline. The bundle
+    indexes entries by position in this list. Callers that already hold
+    the loaded model (the serving worker) pass this to :func:`prewarm`
+    so the model text is never parsed twice on the startup path."""
+    from ..models.gbdt.booster import Booster
+
+    if isinstance(model, Booster):
+        return [model]
+    out = []
+    stages = getattr(model, "stages", None) or [model]
+    for stage in stages:
+        b = getattr(stage, "booster", None)
+        if isinstance(b, Booster):
+            out.append(b)
+    return out
+
+
+def load_model_boosters(model_path: str) -> List[Any]:
+    """:func:`boosters_of` for a model still on disk: the booster itself
+    for a ``.txt`` native model, the fitted GBDT stages of a saved
+    pipeline directory."""
+    from ..models.gbdt.booster import Booster
+
+    if model_path.endswith(".txt"):
+        with open(model_path) as f:
+            return [Booster.from_string(f.read())]
+    from ..core.pipeline import load_stage
+    return boosters_of(load_stage(model_path))
+
+
+def _default_batch_sizes(max_batch: int) -> List[int]:
+    """The pow2 ladder serving actually dispatches: both engines bucket
+    micro-batches to powers of two up to the batch cap
+    (``bucket_size`` / ``SlotTable.bucket_view``), so these are the only
+    batch shapes a warmed worker will ever look up."""
+    sizes, b = [], 1
+    while b < max_batch:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_batch)
+    return sizes
+
+
+# ---------------------------------------------------------------------------
+# Build (offline)
+# ---------------------------------------------------------------------------
+
+
+def build_bundle(model_path: str, out_dir: str,
+                 batch_sizes: Optional[List[int]] = None,
+                 max_batch: int = 32,
+                 num_iterations: Tuple[int, ...] = (-1,),
+                 include_raw: bool = False,
+                 force: bool = False) -> Dict[str, Any]:
+    """AOT-lower and serialize every fused predict executable a serving
+    deployment of ``model_path`` will dispatch to; write an atomic,
+    versioned, checksummed bundle directory. Returns the manifest.
+
+    The bundle is built in a sibling temp directory and renamed into
+    place, so a crashed build never leaves a half-written bundle where
+    a prewarm could find it."""
+    import jax
+    from jax import export as jax_export
+
+    t0 = time.perf_counter()
+    boosters = load_model_boosters(model_path)
+    if not boosters:
+        raise BundleError(f"no boosters found in model {model_path!r} — "
+                          "nothing to bundle")
+    if batch_sizes is None:
+        batch_sizes = _default_batch_sizes(max_batch)
+    out_dir = os.path.abspath(out_dir)
+    if os.path.exists(out_dir) and not force:
+        raise BundleError(f"bundle dir {out_dir} already exists "
+                          "(pass force=True / --force to replace)")
+    tmp = f"{out_dir}.tmp-{os.getpid()}"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(os.path.join(tmp, PROGRAMS_DIR))
+    xla_cache = os.path.join(tmp, XLA_CACHE_DIR)
+    os.makedirs(xla_cache)
+    # wire the persistent compile cache at the bundle's own xla_cache
+    # (env knob wins when set): the AOT compiles below populate it, so
+    # a prewarming worker's deserialize-then-compile step becomes a
+    # disk fetch on backends with persistent-cache support. ensure() is
+    # first-call-wins per process — a warm process (in-process build
+    # after training) may already have locked a different dir, in which
+    # case the shipped xla_cache stays EMPTY and prewarm pays real XLA
+    # compiles: say so loudly rather than ship a silently-hollow cache
+    active = _compile_cache.ensure(xla_cache)
+    if active != xla_cache:
+        logger.warning(
+            "bundle xla_cache not populated: the process compile cache "
+            "was already wired to %r (first-call-wins) — prewarming "
+            "workers will recompile from StableHLO; build bundles in a "
+            "fresh process (the CLI) for a warm shipped cache", active,
+            bundle=out_dir)
+        _flight.record("bundle", event="xla_cache_not_populated",
+                       bundle=out_dir, active=active or "")
+
+    from ..models.gbdt.booster import iter_predict_plans
+
+    entries: List[Dict[str, Any]] = []
+    transforms = (True, False) if include_raw else (True,)
+    seen_keys = set()
+    for bi, booster in enumerate(boosters):
+        # THE enumeration lives in booster.iter_predict_plans — shared
+        # with predict_key_manifest so bundle and manifest cannot drift.
+        # Dedup spans boosters too: keys are model-INDEPENDENT (trees
+        # ride as arguments), so two same-shape pipeline stages share
+        # one executable — exporting twice would overwrite the same
+        # {key_hash}.jaxexp file and waste a duplicate AOT compile
+        for meta, plan in iter_predict_plans(booster, batch_sizes,
+                                             num_iterations, transforms):
+            if plan.key in seen_keys:
+                continue
+            seen_keys.add(plan.key)
+            entries.append(_export_entry(
+                jax_export, booster, plan, tmp, booster_index=bi, **meta))
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "created_at": time.time(),
+        "model": {"path": os.path.abspath(model_path),
+                  "sha256": model_hash(model_path),
+                  "boosters": len(boosters)},
+        "fingerprint": runtime_fingerprint(),
+        "jax_export_platforms": sorted(
+            {p for e in entries for p in e.pop("_platforms")}),
+        "entries": entries,
+    }
+    with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    if os.path.exists(out_dir):          # force=True: replace atomically-ish
+        shutil.rmtree(out_dir)
+    os.rename(tmp, out_dir)
+    dt = time.perf_counter() - t0
+    _metrics.safe_histogram("bundle_build_seconds").observe(dt)
+    _flight.record("bundle", event="built", path=out_dir,
+                   entries=len(entries), seconds=round(dt, 3))
+    logger.info("bundle built", path=out_dir, entries=len(entries),
+                seconds=round(dt, 3))
+    return manifest
+
+
+def _export_entry(jax_export, booster, plan, tmp_dir: str, **meta
+                  ) -> Dict[str, Any]:
+    """AOT-lower one plan's program (through the placement funnel — the
+    builder already resolves placement in ``runtime_fingerprint``) and
+    serialize it via ``jax.export`` under its key hash."""
+    from ..models.gbdt.booster import predict_key_hash
+
+    args = booster.predict_plan_args(plan)
+    exported = jax_export.export(plan.builder())(*args)
+    blob = bytes(exported.serialize())
+    # warm the persistent compile cache with the real XLA compile while
+    # we are here: exactly what a prewarming worker will re-run
+    import jax
+    jax.jit(exported.call).lower(*args).compile()
+    key_hash = predict_key_hash(plan.key)
+    fname = f"{key_hash}.jaxexp"
+    with open(os.path.join(tmp_dir, PROGRAMS_DIR, fname), "wb") as f:
+        f.write(blob)
+    return {
+        **meta,
+        "n_pad": plan.n_pad,
+        "t_pad": plan.T_pad,
+        "key_hash": key_hash,
+        "file": f"{PROGRAMS_DIR}/{fname}",
+        "sha256": hashlib.sha256(blob).hexdigest(),
+        "size_bytes": len(blob),
+        "_platforms": list(exported.platforms),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Load / prewarm (online)
+# ---------------------------------------------------------------------------
+
+
+def read_manifest(bundle_dir: str) -> Dict[str, Any]:
+    """Parse + structurally validate a bundle's manifest (no program
+    deserialization). Raises :class:`BundleError` on anything torn."""
+    path = os.path.join(bundle_dir, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise BundleError(f"unreadable bundle manifest {path}: "
+                          f"{type(e).__name__}: {e}") from e
+    if not isinstance(manifest, dict) or "entries" not in manifest \
+            or "fingerprint" not in manifest:
+        raise BundleError(f"malformed bundle manifest {path}")
+    if manifest.get("format_version") != FORMAT_VERSION:
+        raise BundleError(
+            f"bundle format_version {manifest.get('format_version')!r} "
+            f"(this build reads {FORMAT_VERSION})")
+    return manifest
+
+
+def _count_load(status: str) -> None:
+    _metrics.safe_counter("bundle_loads_total", status=status).inc()
+
+
+def _warn_fallback(bundle_dir: str, status: str, **fields) -> None:
+    """THE loud structured degradation: one warning record + one flight
+    event + the status-labeled counter — and the caller falls back to
+    online JIT. Wrong numerics are impossible by construction (programs
+    install only under keys recomputed from the live model), so the
+    failure mode of a bad bundle is cold-start latency, surfaced here."""
+    logger.warning("serving bundle unusable, falling back to JIT "
+                   "compilation: %s", status, bundle=bundle_dir,
+                   status=status, **fields)
+    _flight.record("bundle", event=status, bundle=bundle_dir, **fields)
+    _count_load(status)
+
+
+def prewarm(model_path: str, bundle_dir: str,
+            boosters: Optional[List[Any]] = None) -> Dict[str, Any]:
+    """Populate ``_PREDICT_CACHE`` from a bundle before a worker binds.
+
+    Returns stats ``{status, entries_loaded, entries_skipped, seconds}``.
+    Degrades (never raises) on any defect: missing/torn bundle, version
+    or fingerprint skew, checksum mismatch, per-entry deserialization
+    failure — each a structured warning + ``bundle_*`` telemetry, with
+    the worker falling back to online JIT for the affected programs.
+
+    ``boosters`` lets the caller pass the already-loaded model (the
+    serving worker has it); otherwise the model loads from
+    ``model_path``. Keys are recomputed from THAT model, so a bundle
+    built from different model bytes cannot install anything even
+    before the fingerprint check rejects it.
+    """
+    t0 = time.perf_counter()
+    stats = {"status": "ok", "entries_loaded": 0, "entries_skipped": 0}
+    _flight.record("bundle", event="prewarm_begin", bundle=bundle_dir)
+    try:
+        manifest = read_manifest(bundle_dir)
+    except BundleError as e:
+        _warn_fallback(bundle_dir, "corrupt", error=str(e))
+        stats["status"] = "corrupt"
+        return _finish(stats, t0)
+
+    # the bundle's shipped xla_cache joins the persistent-cache funnel
+    # (only when the operator hasn't pointed the env knob elsewhere, and
+    # only if writable — jax appends new entries to the active dir)
+    xla_cache = os.path.join(bundle_dir, XLA_CACHE_DIR)
+    if os.path.isdir(xla_cache) and os.access(xla_cache, os.W_OK):
+        _compile_cache.ensure(xla_cache)
+    else:
+        _compile_cache.ensure()
+
+    fp_now = runtime_fingerprint()
+    mismatches = _fingerprint_mismatches(manifest["fingerprint"], fp_now)
+    mh = model_hash(model_path) if os.path.exists(model_path) else None
+    if mh is not None and mh != manifest.get("model", {}).get("sha256"):
+        mismatches.append(
+            f"model_sha256: built={manifest.get('model', {}).get('sha256')!r}"
+            f" runtime={mh!r}")
+    if mismatches:
+        _warn_fallback(bundle_dir, "fingerprint_mismatch",
+                       mismatches=mismatches)
+        stats["status"] = "fingerprint_mismatch"
+        return _finish(stats, t0)
+
+    if boosters is None:
+        boosters = load_model_boosters(model_path)
+    loaded = skipped = 0
+    for entry in manifest["entries"]:
+        if _load_entry(bundle_dir, entry, boosters):
+            loaded += 1
+        else:
+            skipped += 1
+    stats.update(entries_loaded=loaded, entries_skipped=skipped)
+    if loaded == 0 and manifest["entries"]:
+        stats["status"] = "empty"
+        _warn_fallback(bundle_dir, "empty",
+                       entries=len(manifest["entries"]))
+    else:
+        _count_load("ok")
+    return _finish(stats, t0)
+
+
+def _finish(stats: Dict[str, Any], t0: float) -> Dict[str, Any]:
+    stats["seconds"] = round(time.perf_counter() - t0, 3)
+    _metrics.safe_histogram("bundle_prewarm_seconds").observe(
+        stats["seconds"])
+    _flight.record("bundle", event="prewarm_complete", **stats)
+    logger.info("bundle prewarm complete", **stats)
+    return stats
+
+
+def _load_entry(bundle_dir: str, entry: Dict[str, Any],
+                boosters: List[Any]) -> bool:
+    """Deserialize + AOT-compile one manifest entry and install it in
+    the predictor cache. False (with telemetry) on any defect — the
+    affected bucket falls back to online JIT, nothing else."""
+    import jax
+    from jax import export as jax_export
+
+    from ..models.gbdt.booster import (predict_key_hash,
+                                       preload_predict_program)
+
+    def skip(reason: str, **fields) -> bool:
+        _metrics.safe_counter("bundle_entries_skipped_total",
+                              reason=reason).inc()
+        _flight.record("bundle", event="entry_skipped", reason=reason,
+                       key_hash=entry.get("key_hash", ""), **fields)
+        logger.warning("bundle entry skipped: %s", reason,
+                       key_hash=entry.get("key_hash", ""), **fields)
+        return False
+
+    try:
+        bi = int(entry.get("booster_index", 0))
+        batch_size = int(entry["batch_size"])
+        num_iteration = int(entry["num_iteration"])
+        transformed = bool(entry["transformed"])
+        entry["file"], entry["sha256"]
+    except (KeyError, TypeError, ValueError) as e:
+        # a structurally bad entry (hand-edited bundle, torn build)
+        # degrades like every other defect — prewarm NEVER raises
+        return skip("malformed_entry", error=f"{type(e).__name__}: {e}")
+    if not 0 <= bi < len(boosters):
+        return skip("booster_index_out_of_range", booster_index=bi)
+    booster = boosters[bi]
+    plan = booster.predict_plan(batch_size, num_iteration,
+                                transformed=transformed)
+    key_hash = predict_key_hash(plan.key)
+    if key_hash != entry.get("key_hash"):
+        # the live model computes a different key than the build did —
+        # a key miss, not a corruption: count it distinctly so rollouts
+        # can see bundles drifting from the models they front
+        _metrics.safe_counter("bundle_key_miss_total").inc()
+        return skip("key_mismatch", expected=entry.get("key_hash", ""),
+                    computed=key_hash)
+    root = os.path.abspath(bundle_dir)
+    path = os.path.normpath(
+        os.path.join(root, *entry["file"].split("/")))
+    if not path.startswith(root + os.sep):
+        # a crafted manifest must not walk the checksum/deserialize
+        # pipeline out of the bundle directory
+        return skip("path_escape", file=entry["file"])
+    try:
+        # one read serves both the checksum and the deserialize — the
+        # in-memory hash also closes the hash-then-reread TOCTOU window
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        return skip("missing_program", error=f"{type(e).__name__}: {e}")
+    if hashlib.sha256(blob).hexdigest() != entry.get("sha256"):
+        return skip("checksum_mismatch", file=entry["file"])
+    try:
+        exported = jax_export.deserialize(bytearray(blob))
+        args = booster.predict_plan_args(plan)
+        compiled = jax.jit(exported.call).lower(*args).compile()
+    except Exception as e:  # noqa: BLE001 — any skew degrades to JIT
+        return skip("deserialize_failed", error=f"{type(e).__name__}: {e}")
+    if not preload_predict_program(plan.key, compiled):
+        return skip("already_cached")
+    _metrics.safe_counter("bundle_entries_loaded_total").inc()
+    _flight.record("bundle", event="entry_loaded", key_hash=key_hash,
+                   batch_size=batch_size,
+                   n_pad=plan.n_pad, t_pad=plan.T_pad)
+    return True

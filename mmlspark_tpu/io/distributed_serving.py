@@ -48,6 +48,7 @@ from ..observability.federation import MetricsFederator
 from ..observability.logging import get_logger
 from ..robustness import failpoints as _failpoints
 from ..robustness import policy as _policy
+from .http import HTTPConnectionPool
 from .serving import (ServingQuery, ServingServer, debug_route,
                       write_debug_response, write_http_response)
 
@@ -183,6 +184,12 @@ class GatewayServer:
                 default_open_seconds=self.health_interval))
         self.retry_budget = retry_budget or _policy.RetryBudget(
             api=api_name)
+        # keep-alive connections to workers, pooled per host:port — the
+        # hop used to pay one TCP handshake per proxied request
+        # (ROADMAP item 3 leftover); reuse is counted in
+        # gateway_connection_reuse_total, stale pooled sockets retry on
+        # a fresh connection inside _exchange
+        self._pool = HTTPConnectionPool()
         self._latency = _policy.Ewma()
         self._inflight: Dict[str, int] = {}
         self._rr = 0
@@ -192,7 +199,33 @@ class GatewayServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # keep-alive toward clients, mirroring the worker handlers
+            # (the gateway->worker hop pools via HTTPConnectionPool);
+            # Nagle off for the same delayed-ACK-stall reason
+            protocol_version = "HTTP/1.1"
+            timeout = 65.0
+            disable_nagle_algorithm = True
+
             def _handle(self, method):
+                if outer._stop.is_set():
+                    # stopped gateway: EOF, not a ghost reply
+                    self.close_connection = True
+                    return
+                # consume the body before ANY reply path (the worker
+                # handler's keep-alive rule): an unread body leaves the
+                # persistent connection's next request parsing garbage;
+                # chunked framing isn't decoded here — reject and close
+                if self.headers.get("Transfer-Encoding"):
+                    self.close_connection = True
+                    write_http_response(
+                        self, 411,
+                        b'{"error": "Transfer-Encoding unsupported; '
+                        b'send Content-Length"}',
+                        counter="gateway_responses_total",
+                        api=outer.api_name)
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
                 # enabled() gate: same disabled-path contract as
                 # ServingServer — set_enabled(False) restores plain
                 # proxying of GET /metrics (and /healthz etc.) to the
@@ -208,8 +241,6 @@ class GatewayServer:
                         write_debug_response(self, route, outer.api_name,
                                              federation=outer.federation)
                         return
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
                 # edge hop: adopt the client's trace or mint one; the
                 # active context is what _route injects into the worker
                 # hop, so edge, gateway, and worker spans share a trace_id
@@ -293,6 +324,9 @@ class GatewayServer:
         self.federation.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
+        # close, not clear: an in-flight _exchange releasing after this
+        # point must see a closed pool and close its socket
+        self._pool.close()
 
     # -- routing -------------------------------------------------------------
     @staticmethod
@@ -430,8 +464,6 @@ class GatewayServer:
                     if deadline is not None:
                         timeout = max(0.05, min(
                             timeout, deadline.remaining_seconds()))
-                    conn = http.client.HTTPConnection(
-                        w.host, w.port, timeout=timeout)
                     # outbound hop: the active trace context rides the
                     # wire (worker spans stitch to this gateway's), and
                     # the deadline budget is attenuated for the hop
@@ -439,20 +471,8 @@ class GatewayServer:
                     if deadline is not None:
                         out_headers[_policy.DEADLINE_HEADER] = \
                             deadline.header_value()
-                    t0 = time.perf_counter()
-                    conn.request(method, f"/{w.api_name}", body=body,
-                                 headers=out_headers)
-                    resp = conn.getresponse()
-                    payload = resp.read()
-                    headers = {"Content-Type":
-                               resp.getheader("Content-Type", "text/plain")}
-                    # shed/drain hints must reach the client
-                    ra = resp.getheader("Retry-After")
-                    if ra:
-                        headers["Retry-After"] = ra
-                    status = resp.status
-                    conn.close()
-                    self._latency.update(time.perf_counter() - t0)
+                    status, payload, headers = self._exchange(
+                        w, method, body, out_headers, timeout)
                 if status in GATEWAY_RETRY_STATUS:
                     # worker answered but can't serve: soft breaker
                     # strike (except shed — overload is not sickness),
@@ -505,8 +525,11 @@ class GatewayServer:
                         self._retry_after()
                 # connection-level failure OR a worker dying mid-response
                 # (BadStatusLine/IncompleteRead): the worker is GONE —
-                # open its breaker now, retry on another worker; the
-                # health loop's half-open probes readmit it on recovery.
+                # drop its pooled keep-alive sockets (they share the fate
+                # of the one that just died), open its breaker now, retry
+                # on another worker; the health loop's half-open probes
+                # readmit it on recovery.
+                self._pool.clear(w.host, w.port)
                 # A read TIMEOUT is the one exception: the worker
                 # accepted the connection and is merely slow — the same
                 # condition the 504 branch above insists must only
@@ -550,6 +573,65 @@ class GatewayServer:
                     self._inflight[addr] = max(
                         0, self._inflight.get(addr, 1) - 1)
 
+    def _exchange(self, w: WorkerInfo, method: str, body,
+                  out_headers: Dict[str, str], timeout: float):
+        """One gateway->worker HTTP exchange over the keep-alive pool:
+        ``(status, payload, headers)``.
+
+        Stale-socket recovery: a failure on a REUSED pooled connection
+        retries here on a fresh connection (without a breaker strike or
+        failover; each discarded socket is counted in
+        ``gateway_stale_connections_total``) ONLY when the worker
+        provably never processed the request — the send itself failed,
+        or the worker closed its keep-alive side cleanly before emitting
+        a single response byte (``RemoteDisconnected``: the idle-reap /
+        restart signature). A mid-response failure (``IncompleteRead``,
+        a reset after bytes arrived) or a timeout means a handler HAS
+        the request — re-sending would double-score, so those propagate
+        to the failover/breaker machinery exactly like fresh-socket
+        failures."""
+        while True:
+            conn, reused = self._pool.acquire(w.host, w.port, timeout)
+            t0 = time.perf_counter()
+            try:
+                conn.request(method, f"/{w.api_name}", body=body,
+                             headers=out_headers)
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                if reused and not isinstance(e, TimeoutError):
+                    _metrics.safe_counter("gateway_stale_connections_total",
+                                          api=self.api_name).inc()
+                    continue    # drains any other stale pooled sockets too
+                raise
+            try:
+                resp = conn.getresponse()
+                payload = resp.read()
+            except http.client.RemoteDisconnected:
+                conn.close()
+                if reused:
+                    _metrics.safe_counter("gateway_stale_connections_total",
+                                          api=self.api_name).inc()
+                    continue
+                raise
+            except (OSError, http.client.HTTPException):
+                conn.close()
+                raise
+            headers = {"Content-Type":
+                       resp.getheader("Content-Type", "text/plain")}
+            # shed/drain hints must reach the client
+            ra = resp.getheader("Retry-After")
+            if ra:
+                headers["Retry-After"] = ra
+            self._latency.update(time.perf_counter() - t0)
+            # a fully-read response leaves the connection reusable unless
+            # the worker announced close
+            self._pool.release(w.host, w.port, conn,
+                               reusable=not resp.will_close)
+            if reused:
+                _metrics.safe_counter("gateway_connection_reuse_total",
+                                      api=self.api_name).inc()
+            return resp.status, payload, headers
+
     # -- health / breaker recovery -------------------------------------------
     def _health_loop(self):
         while not self._stop.wait(self.health_interval):
@@ -569,7 +651,11 @@ class GatewayServer:
                 # worker left the registry: prune its breaker — under
                 # ephemeral-port churn a board keyed by dead addresses
                 # would grow (and re-open against) slots nobody routes to
+                # — and its pooled keep-alive sockets with it
                 self.breakers.forget(addr)
+                host, _, port = addr.rpartition(":")
+                if port.isdigit():
+                    self._pool.clear(host, int(port))
                 continue
             if br.state == _policy.OPEN and br.probe_due():
                 br.begin_probe()

@@ -123,6 +123,28 @@ def write_http_response(handler: BaseHTTPRequestHandler, status: int,
         _metrics.safe_counter(counter, code=str(status), **labels).inc()
 
 
+# -- readiness gate ---------------------------------------------------------
+# Liveness ("the process answers") and readiness ("route traffic here") are
+# different questions for a rolling fleet: a worker prewarming its predictor
+# cache from an AOT bundle is alive but must not take traffic yet, or the
+# rollout routes requests onto a cold compiler. serving_main flips this gate
+# False before prewarm and True only once the worker is warmed, bound, and
+# about to register; processes that never gate (tests, ad-hoc serve()) stay
+# ready by default.
+_ready = True
+
+
+def set_ready(ready: bool) -> None:
+    """Flip the process-wide readiness gate surfaced on ``/healthz``."""
+    global _ready
+    _ready = bool(ready)
+    _metrics.safe_gauge("serving_ready").set(1 if ready else 0)
+
+
+def is_ready() -> bool:
+    return _ready
+
+
 _device_probe: Optional[Dict[str, Any]] = None
 
 
@@ -159,8 +181,8 @@ def healthz_payload() -> Dict[str, Any]:
     """Liveness + device presence. Device enumeration is best-effort: a
     health probe must answer even when the accelerator runtime is sick —
     that is precisely when operators probe it."""
-    info: Dict[str, Any] = {"status": "ok", "pid": os.getpid(),
-                            "time": time.time()}
+    info: Dict[str, Any] = {"status": "ok", "ready": is_ready(),
+                            "pid": os.getpid(), "time": time.time()}
     info.update(_probe_devices())
     return info
 
@@ -312,7 +334,43 @@ class ServingServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # keep-alive: the gateway hop pools one connection per worker
+            # instead of paying a TCP handshake per proxied request
+            # (write_http_response always sets Content-Length, which is
+            # all HTTP/1.1 persistence needs); idle connections reap on
+            # the read timeout so parked keep-alive threads are bounded.
+            # Nagle off: on a persistent connection the two-segment
+            # request/response pattern hits the delayed-ACK stall (~40 ms
+            # per request) that per-request HTTP/1.0 sockets never showed
+            protocol_version = "HTTP/1.1"
+            timeout = 65.0
+            disable_nagle_algorithm = True
+
             def _handle(self, method: str):
+                if not outer._started:
+                    # stop() already ran — a pooled keep-alive connection
+                    # that outlived the server must see EOF (the crash/
+                    # kill_worker semantics failover tests rely on), not
+                    # a reply from a "dead" worker
+                    self.close_connection = True
+                    return
+                # consume the body up front: EVERY reply path (incl. the
+                # shed/drain/failpoint early returns below) must leave the
+                # socket positioned at the next request, or a keep-alive
+                # peer's following request parses against leftover body
+                # bytes. Chunked framing isn't decoded here — reject it
+                # loudly and close, never desync on an unread payload
+                if self.headers.get("Transfer-Encoding"):
+                    self.close_connection = True
+                    write_http_response(
+                        self, 411,
+                        b'{"error": "Transfer-Encoding unsupported; '
+                        b'send Content-Length"}',
+                        counter="serving_responses_total",
+                        api=outer.api_name)
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
                 # the enabled() gate keeps the disabled-path contract
                 # (set_enabled(False) restores exactly the uninstrumented
                 # routing) and gives an API that legitimately owns GET
@@ -375,9 +433,6 @@ class ServingServer:
                     with _spans.span("serving_request",
                                      api=outer.api_name, method=method,
                                      path=self.path):
-                        length = int(self.headers.get("Content-Length")
-                                     or 0)
-                        body = self.rfile.read(length) if length else b""
                         req = ServedRequest(
                             id=uuid.uuid4().hex, method=method,
                             path=self.path,

@@ -26,6 +26,9 @@ import uuid
 
 def _load_transform(model_path: str, input_col: str, output_col: str,
                     max_batch: int = 64):
+    """``(transform, model)`` — the model object rides along so the
+    bundle-prewarm path can reuse it instead of parsing the file twice
+    on the exact startup path the prewarm exists to shorten."""
     import numpy as np
 
     from ..core.dataset import Dataset
@@ -43,7 +46,7 @@ def _load_transform(model_path: str, input_col: str, output_col: str,
             return ds.with_column("reply", [
                 make_reply({output_col: to_jsonable(p)}) for p in preds])
 
-        return transform
+        return transform, booster
 
     from ..core.pipeline import load_stage
     from .serving import bucketed_model_transform
@@ -56,14 +59,14 @@ def _load_transform(model_path: str, input_col: str, output_col: str,
         return ds.with_column("reply", [
             make_reply({output_col: to_jsonable(v)}) for v in vals])
 
-    return transform
+    return transform, model
 
 
 def _build_async_query(args):
-    """Async-engine worker: a ``.txt`` booster model rides the zero-copy
-    rows path (requests decode straight into the slot table, one h2d
-    per device dispatch); saved pipelines keep the Dataset transform
-    contract on the same event-loop front."""
+    """``(query, model)`` for an async-engine worker: a ``.txt`` booster
+    model rides the zero-copy rows path (requests decode straight into
+    the slot table, one h2d per device dispatch); saved pipelines keep
+    the Dataset transform contract on the same event-loop front."""
     from .aserve import AsyncServingQuery, AsyncServingServer
     from .aserve.server import RowSpec
     from .http import to_jsonable
@@ -86,13 +89,14 @@ def _build_async_query(args):
             out_col = args.output_col
             return AsyncServingQuery(
                 server, scorer=scorer,
-                reply_fn=lambda req, p: {out_col: to_jsonable(p)})
-    transform = _load_transform(args.model, args.input_col,
-                                args.output_col, max_batch=args.max_batch)
+                reply_fn=lambda req, p: {out_col: to_jsonable(p)}), booster
+    transform, model = _load_transform(args.model, args.input_col,
+                                       args.output_col,
+                                       max_batch=args.max_batch)
     server = AsyncServingServer(args.host, args.port, args.api_name,
                                 max_queue_depth=args.max_queue_depth,
                                 slots=args.max_batch)
-    return AsyncServingQuery(server, transform=transform)
+    return AsyncServingQuery(server, transform=transform), model
 
 
 def main(argv=None) -> int:
@@ -120,6 +124,15 @@ def main(argv=None) -> int:
     w.add_argument("--output-col", default="prediction")
     w.add_argument("--max-batch", type=int, default=32)
     w.add_argument("--max-latency-ms", type=float, default=5.0)
+    w.add_argument("--bundle", default=None,
+                   help="AOT serving-bundle directory to prewarm the "
+                        "predictor cache from before binding (default: "
+                        "MMLSPARK_TPU_BUNDLE_DIR; see `python -m "
+                        "mmlspark_tpu.bundles build`). /healthz reports "
+                        "ready:false until the prewarm completes, and "
+                        "the worker registers with the gateway only "
+                        "after — a rolling restart never routes traffic "
+                        "onto a cold compiler")
     w.add_argument("--max-queue-depth", type=int, default=None,
                    help="shed (429 + Retry-After) above this many queued "
                         "requests (default: MMLSPARK_TPU_MAX_QUEUE_DEPTH "
@@ -180,15 +193,43 @@ def main(argv=None) -> int:
         signal.signal(sig, lambda *a: stop.set())
 
     if args.role == "worker":
+        import os
+
         from .aserve import resolve_engine
+        from .serving import set_ready
         engine = resolve_engine(args.engine)
+        # readiness gate DOWN before any model/bundle work: a probe that
+        # reaches this worker early must read ready:false, and the
+        # gateway can't route here because registration happens last
+        set_ready(False)
+        bundle_dir = args.bundle or \
+            (os.environ.get("MMLSPARK_TPU_BUNDLE_DIR") or "").strip()
+
+        def maybe_prewarm(model) -> None:
+            # prewarm BEFORE binding: the predictor cache fills from the
+            # AOT bundle (or degrades to JIT with a loud warning), so
+            # the first routed request never observes a compile. The
+            # just-loaded model rides along — prewarm must not parse the
+            # model text a second time on the startup path (an empty
+            # booster list is passed as-is for the same reason)
+            if bundle_dir:
+                from ..bundles import boosters_of, prewarm
+                prewarm(args.model, bundle_dir,
+                        boosters=boosters_of(model))
+
         if engine == "async":
-            query = _build_async_query(args)
+            # the async server binds at start(), safely after prewarm
+            query, model = _build_async_query(args)
             server = query.server
+            maybe_prewarm(model)
         else:
-            transform = _load_transform(args.model, args.input_col,
-                                        args.output_col,
-                                        max_batch=args.max_batch)
+            # ServingServer binds at CONSTRUCTION — build it only after
+            # the prewarm, so nothing can connect into a cold worker's
+            # accept backlog and stall there for the prewarm's duration
+            transform, model = _load_transform(args.model, args.input_col,
+                                               args.output_col,
+                                               max_batch=args.max_batch)
+            maybe_prewarm(model)
             server = ServingServer(args.host, args.port, args.api_name,
                                    max_queue_depth=args.max_queue_depth)
             query = ServingQuery(server, transform,
@@ -203,6 +244,10 @@ def main(argv=None) -> int:
         # start BEFORE building the registry entry: the async engine
         # binds its socket (and learns an ephemeral port) at start()
         query.start()
+        # ready only once warmed AND bound; registration (how gateways
+        # discover us) strictly after, so rolling restarts route no
+        # traffic at a not-ready worker
+        set_ready(True)
         info = WorkerInfo(worker_id=uuid.uuid4().hex[:12],
                           host=advertise,
                           port=server.port, api_name=args.api_name)

@@ -19,7 +19,7 @@ import os
 import threading
 import time
 from collections import OrderedDict
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -335,6 +335,88 @@ def _predict_program(key, build):
     else:
         _metrics.safe_counter("gbdt_predict_cache_hits_total").inc()
     return fn
+
+
+def preload_predict_program(key, fn) -> bool:
+    """Install an ALREADY-COMPILED program under ``key`` — the serving-
+    bundle prewarm path (``mmlspark_tpu/bundles``): a worker restarting
+    from an AOT bundle populates the predictor cache before its first
+    request, so the serving hot path never pays (or even observes) a
+    compile. Never clobbers a live entry (a program the process already
+    built and warmed beats a deserialized one); returns whether the
+    preload took. Counted separately from hits/misses so cold-start
+    dashboards can tell prewarmed capacity from organically-warmed."""
+    with _PREDICT_CACHE_LOCK:
+        if key in _PREDICT_CACHE:
+            taken = False
+        else:
+            _PREDICT_CACHE[key] = fn
+            taken = True
+            while len(_PREDICT_CACHE) > _PREDICT_CACHE_MAX:
+                _PREDICT_CACHE.popitem(last=False)
+    if taken:
+        _metrics.safe_counter("gbdt_predict_cache_preloads_total").inc()
+    return taken
+
+
+def predict_key_hash(key) -> str:
+    """Stable content hash of a predictor cache key — the name a bundle
+    stores an exported executable under. ``repr`` over the key tuple is
+    deterministic for everything a key may contain (ints, bools, strings,
+    None, nested tuples, and the ``_freeze_kwargs`` ndarray rendering,
+    whose payload is raw bytes)."""
+    import hashlib
+    return hashlib.sha256(repr(key).encode("utf-8")).hexdigest()
+
+
+class PredictPlan(NamedTuple):
+    """One fused predict executable's identity + builder, shared by the
+    online dispatch path (:meth:`Booster._predict_device`) and the
+    offline AOT bundle builder so the two can never disagree on a cache
+    key. ``builder()`` returns the jitted (un-compiled) program."""
+
+    key: tuple
+    t_end: int
+    n_pad: int
+    T_pad: int
+    num_features: int
+    builder: Callable
+
+
+def iter_predict_plans(booster: "Booster", batch_sizes,
+                       num_iterations=(-1,), transforms=(True,)):
+    """Yield ``(meta, plan)`` for every DISTINCT fused predict
+    executable a serving deployment of ``booster`` dispatches over the
+    given batch sizes / iteration counts / transform variants. THE one
+    enumeration: the key-manifest export below and the bundle builder
+    (``mmlspark_tpu/bundles``) both iterate this, so what a bundle pins
+    and what a manifest reports can never drift. Batch sizes aliasing
+    into one pow2 bucket dedupe to one plan (the executable is
+    shared)."""
+    seen = set()
+    for transformed in transforms:
+        for it in num_iterations:
+            for b in batch_sizes:
+                plan = booster.predict_plan(int(b), int(it),
+                                            transformed=transformed)
+                if plan.key in seen:
+                    continue
+                seen.add(plan.key)
+                yield ({"batch_size": int(b), "num_iteration": int(it),
+                        "transformed": bool(transformed)}, plan)
+
+
+def predict_key_manifest(booster: "Booster", batch_sizes,
+                         num_iterations=(-1,),
+                         transformed: bool = True) -> List[Dict]:
+    """Key-manifest export: the (batch bucket x iteration) predictor
+    cache keys a serving deployment of ``booster`` will dispatch to —
+    what the bundle builder enumerates and what its MANIFEST.json pins."""
+    return [{**meta, "n_pad": plan.n_pad, "t_pad": plan.T_pad,
+             "key_hash": predict_key_hash(plan.key)}
+            for meta, plan in iter_predict_plans(
+                booster, batch_sizes, num_iterations,
+                transforms=(transformed,))]
 
 
 def _freeze_kwargs(kwargs: dict):
@@ -738,6 +820,69 @@ class Booster:
             cache.move_to_end(key)
         return a
 
+    def predict_plan(self, n: int, num_iteration: int = -1,
+                     transformed: bool = True,
+                     num_features: Optional[int] = None) -> "PredictPlan":
+        """The fused predict executable a batch of ``n`` rows dispatches
+        to: its process-wide cache key plus everything needed to build
+        (or AOT-export) the program WITHOUT running it.
+
+        This is the one place the predictor cache key is computed —
+        :meth:`_predict_device` (the online hot path) and the offline
+        serving-bundle builder (``mmlspark_tpu/bundles``) both call it,
+        so a key manifested into a bundle at build time is byte-identical
+        to the key the restarted worker looks up at serve time. Host-only:
+        no device transfer and no compile happen here."""
+        if num_iteration is None or num_iteration < 0:
+            num_iteration = self.num_iterations
+        t_end = min(num_iteration * self.num_class, self.num_trees)
+        # power-of-two row bucket for SMALL batches only: serving's varying
+        # micro-batch sizes hit log2 cached executables instead of one
+        # trace per size. Large batch scoring keeps its exact shape —
+        # padding 600k rows to 1M would waste up to 2x forest compute.
+        if 0 < n <= 8192:
+            n_pad = 1 << (n - 1).bit_length()
+        else:
+            n_pad = max(n, 1)
+        T_pad = self._tree_bucket(t_end)
+        M = int(np.asarray(self.trees.feat).shape[1])
+        BW = int(np.asarray(self.trees.cat_bitset).shape[-1])
+        cat_max_bin = int(self.binner_state.get("max_bin") or 0)
+        F_bin = int(self.binner_state["upper_bounds"].shape[0])
+        if num_features is None:
+            num_features = F_bin
+        spec_key = transform = None
+        if transformed:
+            spec_key = (self.objective, self.num_class,
+                        _freeze_kwargs(self.objective_kwargs))
+            transform = score_transform(self.objective, self.num_class,
+                                        **self.objective_kwargs)
+        # mirrors _is_cat()/_device_forest_args WITHOUT touching the
+        # device: the key only records whether the optional args exist
+        has_cat = any(0 <= int(i) < F_bin for i in
+                      (self.binner_state.get("categorical_features") or ()))
+        has_mdec = self.missing_dec is not None
+        key = (T_pad, M, BW, n_pad, num_features, self.num_class,
+               self.depth_cap, cat_max_bin, has_cat, has_mdec, spec_key)
+        depth_cap, K = self.depth_cap, self.num_class
+        return PredictPlan(
+            key=key, t_end=t_end, n_pad=n_pad, T_pad=T_pad,
+            num_features=num_features,
+            builder=lambda: _build_predict_program(
+                T_pad, M, BW, depth_cap, K, cat_max_bin, transform))
+
+    def predict_plan_args(self, plan: "PredictPlan"):
+        """The exact argument tuple ``plan``'s program is called with —
+        real device forest args plus a shape-only stand-in for the
+        feature batch. What the bundle builder traces/AOT-lowers against
+        (and the prewarm path compiles deserialized exports against)."""
+        packed, thr, base, is_cat, mdec = self._device_forest_args(
+            plan.T_pad)
+        active = self._device_active(plan.T_pad, plan.t_end)
+        x_sds = jax.ShapeDtypeStruct((plan.n_pad, plan.num_features),
+                                     jnp.float32)
+        return (packed, thr, base, active, is_cat, mdec, x_sds)
+
     def _predict_device(self, X: np.ndarray, num_iteration: int,
                         transformed: bool) -> np.ndarray:
         """Shared device-resident scoring driver for predict/predict_raw.
@@ -753,36 +898,14 @@ class Booster:
         # replicates — its executable cache is keyed on exact batch shapes
         placement.plan_for("gbdt.predict", replicate=True)
         X = np.asarray(X, dtype=np.float32)
-        if num_iteration is None or num_iteration < 0:
-            num_iteration = self.num_iterations
-        t_end = min(num_iteration * self.num_class, self.num_trees)
         n = X.shape[0]
-        # power-of-two row bucket for SMALL batches only: serving's varying
-        # micro-batch sizes hit log2 cached executables instead of one
-        # trace per size. Large batch scoring keeps its exact shape —
-        # padding 600k rows to 1M would waste up to 2x forest compute.
-        if 0 < n <= 8192:
-            n_pad = 1 << (n - 1).bit_length()
-        else:
-            n_pad = max(n, 1)
-        T_pad = self._tree_bucket(t_end)
-        M = int(np.asarray(self.trees.feat).shape[1])
-        BW = int(np.asarray(self.trees.cat_bitset).shape[-1])
-        cat_max_bin = int(self.binner_state.get("max_bin") or 0)
-        spec_key = transform = None
-        if transformed:
-            spec_key = (self.objective, self.num_class,
-                        _freeze_kwargs(self.objective_kwargs))
-            transform = score_transform(self.objective, self.num_class,
-                                        **self.objective_kwargs)
-        packed, thr, base, is_cat, mdec = self._device_forest_args(T_pad)
-        active = self._device_active(T_pad, t_end)
-        key = (T_pad, M, BW, n_pad, X.shape[1], self.num_class,
-               self.depth_cap, cat_max_bin, is_cat is not None,
-               mdec is not None, spec_key)
-        fn = _predict_program(key, lambda: _build_predict_program(
-            T_pad, M, BW, self.depth_cap, self.num_class, cat_max_bin,
-            transform))
+        plan = self.predict_plan(n, num_iteration, transformed,
+                                 num_features=X.shape[1])
+        packed, thr, base, is_cat, mdec = self._device_forest_args(
+            plan.T_pad)
+        active = self._device_active(plan.T_pad, plan.t_end)
+        fn = _predict_program(plan.key, plan.builder)
+        n_pad = plan.n_pad
         Xp = np.pad(X, ((0, n_pad - n), (0, 0))) if n_pad != n else X
         out = fn(packed, thr, base, active, is_cat, mdec, _to_device(Xp))
         return _from_device(out)[:n]

@@ -203,6 +203,14 @@ REGISTRY: Tuple[EnvVar, ...] = (
                "zero-copy slot admission); `serve().engine(...)` and "
                "`serving_main --engine` override; an unknown env value "
                "degrades to `threaded` with a flight event"),
+    EnvVar(name="MMLSPARK_TPU_BUNDLE_DIR", default="(off)",
+           section="performance",
+           doc="AOT serving-bundle directory `serving_main` workers "
+               "prewarm the predictor cache from before binding "
+               "(`--bundle` overrides; build with `python -m "
+               "mmlspark_tpu.bundles build`); a fingerprint-mismatched "
+               "or corrupt bundle degrades to JIT with a structured "
+               "warning"),
     EnvVar(name="MMLSPARK_TPU_ASERVE_SLOTS", default="(max_batch)",
            section="performance",
            doc="async engine slot-table size — rows per pre-pinned "

@@ -36,13 +36,19 @@ def cache_dir() -> Optional[str]:
     return _DIR
 
 
-def ensure() -> Optional[str]:
+def ensure(fallback_dir: Optional[str] = None) -> Optional[str]:
     """Idempotently wire jax's persistent compilation cache.
 
     Reads ``MMLSPARK_TPU_COMPILE_CACHE_DIR`` once per process (first call
     wins — jax reads the flag at compile time, so flipping it mid-process
     would silently apply to some programs and not others). Returns the
     active cache dir, or None when disabled/unsupported.
+
+    ``fallback_dir`` engages only when the env knob is unset: the
+    serving-bundle paths (``mmlspark_tpu/bundles``) pass the bundle's own
+    ``xla_cache/`` so bundle build populates it and bundle prewarm reads
+    it, without overriding an operator's explicit cache choice. The
+    first-call-wins rule is unchanged.
     """
     global _INITIALIZED, _DIR
     with _LOCK:
@@ -50,6 +56,8 @@ def ensure() -> Optional[str]:
             return _DIR
         _INITIALIZED = True
         d = (os.environ.get("MMLSPARK_TPU_COMPILE_CACHE_DIR") or "").strip()
+        if not d:
+            d = (fallback_dir or "").strip()
         if not d:
             return None
         try:

@@ -1,0 +1,359 @@
+"""AOT serving bundles (mmlspark_tpu/bundles): build/load round-trip,
+engine-parameterized prewarm parity, and the degradation contract.
+
+The load-bearing claims, each pinned here:
+
+* a warm-bundle worker serves its first predict with ZERO ``compile``
+  events in the flight ring, on BOTH serving engines, answering
+  ``/healthz`` ready — the ROADMAP item 4 acceptance;
+* bundle numerics are bit-identical to the JIT path (trees ride as
+  *arguments*, so the exported program is model-independent — but the
+  proof is still asserted, not assumed);
+* a corrupted or version-skewed bundle degrades to JIT with the
+  structured warning and correct numerics — never a silent wrong load.
+
+The subprocess cold-vs-warm contrast lives in ``TestColdVsWarm``
+(slow-marked: it spawns real ``serving_main`` workers); the in-process
+tests above it carry the tier-1 acceptance.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.bundles import (BundleError, build_bundle, model_hash,
+                                  prewarm, read_manifest)
+from mmlspark_tpu.bundles.bundle import MANIFEST_NAME
+from mmlspark_tpu.models.gbdt.booster import (Booster, _PREDICT_CACHE,
+                                              predict_key_hash,
+                                              predict_key_manifest,
+                                              preload_predict_program,
+                                              train_booster)
+from mmlspark_tpu.models.gbdt.growth import GrowConfig
+from mmlspark_tpu.observability import flight, metrics
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    """A tiny trained booster saved as a native .txt model, plus a
+    bundle built from it (one build serves every test)."""
+    d = tmp_path_factory.mktemp("bundles")
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 6)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    booster = train_booster(X=X, y=y, num_iterations=3, objective="binary",
+                            cfg=GrowConfig(num_leaves=7,
+                                           min_data_in_leaf=5))
+    model = d / "model.txt"
+    model.write_text(booster.model_string())
+    build_bundle(str(model), str(d / "model.bundle"), max_batch=8)
+    return d
+
+
+def _load(model_dir):
+    with open(model_dir / "model.txt") as f:
+        return Booster.from_string(f.read())
+
+
+def _fresh_start():
+    """Simulate a fresh process: empty predictor cache + flight ring."""
+    _PREDICT_CACHE.clear()
+    flight.clear()
+
+
+def _compile_events():
+    return [e for e in flight.events() if e.get("kind") == "compile"]
+
+
+class TestBuild:
+    def test_manifest_shape_and_checksums(self, model_dir):
+        man = read_manifest(model_dir / "model.bundle")
+        assert man["format_version"] == 1
+        assert man["model"]["sha256"] == model_hash(
+            str(model_dir / "model.txt"))
+        # pow2 ladder 1,2,4,8 -> four distinct executables
+        assert len(man["entries"]) == 4
+        for e in man["entries"]:
+            p = os.path.join(model_dir / "model.bundle",
+                             *e["file"].split("/"))
+            assert os.path.exists(p)
+            assert e["sha256"] and e["size_bytes"] == os.path.getsize(p)
+        for k in ("jax_version", "backend", "device_kind",
+                  "framework_version"):
+            assert man["fingerprint"][k]
+
+    def test_key_manifest_matches_build(self, model_dir):
+        b = _load(model_dir)
+        man = read_manifest(model_dir / "model.bundle")
+        expected = {e["key_hash"]
+                    for e in predict_key_manifest(b, [1, 2, 4, 8])}
+        assert {e["key_hash"] for e in man["entries"]} == expected
+
+    def test_pow2_aliasing_dedupes(self, model_dir):
+        b = _load(model_dir)
+        # 3 and 4 share the pow2-4 bucket -> one manifest entry
+        man = predict_key_manifest(b, [3, 4])
+        assert len(man) == 1 and man[0]["n_pad"] == 4
+
+    def test_refuses_existing_dir_without_force(self, model_dir):
+        with pytest.raises(BundleError):
+            build_bundle(str(model_dir / "model.txt"),
+                         str(model_dir / "model.bundle"))
+
+    def test_atomic_no_tmp_left_behind(self, model_dir):
+        leftovers = [n for n in os.listdir(model_dir)
+                     if ".tmp-" in n]
+        assert leftovers == []
+
+
+class TestPrewarm:
+    def test_zero_compile_and_bit_identical_numerics(self, model_dir):
+        b = _load(model_dir)
+        rng = np.random.default_rng(1)
+        Xq = rng.normal(size=(5, 6)).astype(np.float32)
+        # JIT reference first (its own fresh cache)
+        _fresh_start()
+        p_jit = b.predict(Xq)
+        # warm path: prewarm a fresh cache from the bundle
+        _fresh_start()
+        stats = prewarm(str(model_dir / "model.txt"),
+                        str(model_dir / "model.bundle"), boosters=[b])
+        assert stats["status"] == "ok"
+        assert stats["entries_loaded"] == 4
+        flight.clear()
+        p_warm = b.predict(Xq)
+        assert _compile_events() == []
+        assert np.array_equal(p_warm, p_jit)
+
+    def test_preload_never_clobbers(self, model_dir):
+        b = _load(model_dir)
+        _fresh_start()
+        b.predict(np.zeros((2, 6), np.float32))   # organically warmed
+        plan = b.predict_plan(2)
+        assert plan.key in _PREDICT_CACHE
+        live = _PREDICT_CACHE[plan.key]
+        assert preload_predict_program(plan.key, lambda *a: None) is False
+        assert _PREDICT_CACHE[plan.key] is live
+
+    def test_fingerprint_mismatch_degrades_loudly(self, model_dir, tmp_path):
+        import shutil
+        skewed = tmp_path / "skewed.bundle"
+        shutil.copytree(model_dir / "model.bundle", skewed)
+        man = json.loads((skewed / MANIFEST_NAME).read_text())
+        man["fingerprint"]["jax_version"] = "9.9.9"
+        (skewed / MANIFEST_NAME).write_text(json.dumps(man))
+        b = _load(model_dir)
+        _fresh_start()
+        before = metrics.counter("bundle_loads_total",
+                                 status="fingerprint_mismatch").value
+        stats = prewarm(str(model_dir / "model.txt"), str(skewed),
+                        boosters=[b])
+        assert stats["status"] == "fingerprint_mismatch"
+        assert stats["entries_loaded"] == 0
+        assert metrics.counter("bundle_loads_total",
+                               status="fingerprint_mismatch"
+                               ).value == before + 1
+        ev = [e for e in flight.events() if e.get("kind") == "bundle"
+              and e.get("event") == "fingerprint_mismatch"]
+        assert ev and any("jax_version" in m for m in ev[0]["mismatches"])
+        # nothing installed: predictions come from the JIT path, correct
+        Xq = np.ones((3, 6), np.float32)
+        p = b.predict(Xq)
+        _PREDICT_CACHE.clear()
+        assert np.array_equal(p, b.predict(Xq))
+
+    def test_model_skew_degrades(self, model_dir, tmp_path):
+        # same model content, different bytes -> model_sha256 mismatch
+        reser = tmp_path / "reser.txt"
+        reser.write_text(
+            json.dumps(json.loads((model_dir / "model.txt").read_text()),
+                       indent=1))
+        b = Booster.from_string(reser.read_text())
+        _fresh_start()
+        stats = prewarm(str(reser), str(model_dir / "model.bundle"),
+                        boosters=[b])
+        assert stats["status"] == "fingerprint_mismatch"
+
+    def test_corrupt_program_skipped_rest_load(self, model_dir, tmp_path):
+        import shutil
+        corrupt = tmp_path / "corrupt.bundle"
+        shutil.copytree(model_dir / "model.bundle", corrupt)
+        man = json.loads((corrupt / MANIFEST_NAME).read_text())
+        victim = os.path.join(corrupt, *man["entries"][0]["file"].split("/"))
+        blob = bytearray(open(victim, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        with open(victim, "wb") as f:
+            f.write(blob)
+        b = _load(model_dir)
+        _fresh_start()
+        stats = prewarm(str(model_dir / "model.txt"), str(corrupt),
+                        boosters=[b])
+        assert stats["status"] == "ok"
+        assert stats["entries_loaded"] == 3
+        assert stats["entries_skipped"] == 1
+        skipped = [e for e in flight.events() if e.get("kind") == "bundle"
+                   and e.get("event") == "entry_skipped"]
+        assert skipped and skipped[0]["reason"] == "checksum_mismatch"
+
+    def test_malformed_entry_degrades(self, model_dir, tmp_path):
+        # a structurally bad entry (hand-edited bundle, format drift)
+        # skips with telemetry; prewarm NEVER raises
+        import shutil
+        bad = tmp_path / "badentry.bundle"
+        shutil.copytree(model_dir / "model.bundle", bad)
+        man = json.loads((bad / MANIFEST_NAME).read_text())
+        del man["entries"][0]["batch_size"]
+        man["entries"][1]["num_iteration"] = "not-a-number"
+        (bad / MANIFEST_NAME).write_text(json.dumps(man))
+        b = _load(model_dir)
+        _fresh_start()
+        stats = prewarm(str(model_dir / "model.txt"), str(bad),
+                        boosters=[b])
+        assert stats["status"] == "ok"
+        assert stats["entries_loaded"] == 2
+        assert stats["entries_skipped"] == 2
+        reasons = {e["reason"] for e in flight.events()
+                   if e.get("kind") == "bundle"
+                   and e.get("event") == "entry_skipped"}
+        assert reasons == {"malformed_entry"}
+
+    def test_torn_manifest_degrades(self, model_dir, tmp_path):
+        import shutil
+        torn = tmp_path / "torn.bundle"
+        shutil.copytree(model_dir / "model.bundle", torn)
+        full = (torn / MANIFEST_NAME).read_text()
+        (torn / MANIFEST_NAME).write_text(full[:len(full) // 2])
+        b = _load(model_dir)
+        _fresh_start()
+        stats = prewarm(str(model_dir / "model.txt"), str(torn),
+                        boosters=[b])
+        assert stats["status"] == "corrupt"
+        # and the missing-bundle path degrades the same way
+        stats = prewarm(str(model_dir / "model.txt"),
+                        str(tmp_path / "nope.bundle"), boosters=[b])
+        assert stats["status"] == "corrupt"
+
+
+class TestReadinessGate:
+    def test_healthz_carries_ready_flag(self):
+        from mmlspark_tpu.io.serving import (healthz_payload, is_ready,
+                                             set_ready)
+        assert is_ready()            # default: processes that never gate
+        try:
+            set_ready(False)
+            assert healthz_payload()["ready"] is False
+            assert metrics.gauge("serving_ready").value == 0.0
+        finally:
+            set_ready(True)
+        assert healthz_payload()["ready"] is True
+
+
+@pytest.mark.parametrize("engine", ["threaded", "async"])
+class TestEnginePrewarmParity:
+    """Both serving engines start ready from the same bundle and serve
+    their first predict with zero compile events in the flight ring —
+    the acceptance criterion, in-process so it stays tier-1."""
+
+    def test_warm_start_zero_compiles(self, model_dir, engine):
+        from mmlspark_tpu.io.serving import serve
+        b = _load(model_dir)
+        _fresh_start()
+        stats = prewarm(str(model_dir / "model.txt"),
+                        str(model_dir / "model.bundle"), boosters=[b])
+        assert stats["status"] == "ok"
+
+        def transform(ds):
+            rows = np.asarray([v["features"] for v in ds["value"]],
+                              np.float32)
+            preds = b.predict(rows)
+            return ds.with_column("reply", [
+                {"entity": {"prediction": float(p)}, "statusCode": 200}
+                for p in preds])
+
+        flight.clear()
+        q = (serve().address("localhost", 0, "bwarm")
+             .batch(max_batch=8, max_latency_ms=5)
+             .engine(engine).transform(transform).start())
+        try:
+            body = json.dumps({"features": [0.1] * 6}).encode()
+            req = urllib.request.Request(q.server.url, data=body,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 200
+                assert "prediction" in json.loads(r.read())
+            hz = urllib.request.urlopen(
+                f"http://{q.server.host}:{q.server.port}/healthz",
+                timeout=10)
+            assert json.loads(hz.read())["ready"] is True
+            assert _compile_events() == [], _compile_events()
+        finally:
+            q.stop()
+
+
+@pytest.mark.slow
+class TestColdVsWarm:
+    """Process-level contrast through real serving_main workers: a cold
+    start records compile events on its first predict, a warm-bundle
+    start records none — on both engines."""
+
+    def _run_worker(self, model_dir, engine, env, bundle=None):
+        args = [sys.executable, "-m", "mmlspark_tpu.io.serving_main",
+                "worker", "--model", str(model_dir / "model.txt"),
+                "--registry", str(model_dir / "reg"),
+                "--host", "localhost", "--port", "0",
+                "--engine", engine, "--max-batch", "8"]
+        if bundle:
+            args += ["--bundle", str(bundle)]
+        t0 = time.monotonic()
+        p = subprocess.Popen(args, env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.DEVNULL, text=True)
+        try:
+            line = p.stdout.readline()
+            m = re.search(r"serving on \S+:(\d+)", line)
+            assert m, f"no ready-line: {line!r}"
+            port = int(m.group(1))
+            body = json.dumps({"features": [0.1] * 6}).encode()
+            deadline = time.monotonic() + 60
+            while True:
+                try:
+                    with urllib.request.urlopen(urllib.request.Request(
+                            f"http://localhost:{port}/serving", data=body,
+                            method="POST"), timeout=5) as r:
+                        assert r.status == 200
+                        break
+                except (OSError, urllib.error.URLError):
+                    assert time.monotonic() < deadline
+                    time.sleep(0.05)
+            first_predict_s = time.monotonic() - t0
+            with urllib.request.urlopen(
+                    f"http://localhost:{port}/healthz", timeout=5) as r:
+                hz = json.loads(r.read())
+            with urllib.request.urlopen(
+                    f"http://localhost:{port}/debug/flight",
+                    timeout=5) as r:
+                ring = json.loads(r.read())
+            compiles = [e for e in ring["events"]
+                        if e.get("kind") == "compile"]
+            return {"seconds": first_predict_s, "ready": hz.get("ready"),
+                    "compiles": len(compiles)}
+        finally:
+            p.send_signal(signal.SIGTERM)
+            p.wait(timeout=30)
+
+    @pytest.mark.parametrize("engine", ["threaded", "async"])
+    def test_cold_compiles_warm_does_not(self, model_dir, engine,
+                                         cpu_subprocess_env):
+        cold = self._run_worker(model_dir, engine, cpu_subprocess_env)
+        warm = self._run_worker(model_dir, engine, cpu_subprocess_env,
+                                bundle=model_dir / "model.bundle")
+        assert cold["compiles"] >= 1, cold
+        assert warm["compiles"] == 0, warm
+        assert warm["ready"] is True

@@ -181,3 +181,101 @@ def test_distributed_real_model_concurrent():
         assert sum(q.requests_served for q in d.workers) == 40
     finally:
         d.stop()
+
+
+# ---------------------------------------------------------------------------
+# Gateway→worker keep-alive connection pooling (ROADMAP item 3 leftover)
+# ---------------------------------------------------------------------------
+
+
+class TestConnectionPooling:
+    """The gateway hop reuses keep-alive connections per worker instead
+    of paying a TCP handshake per proxied request; a stale pooled socket
+    (the worker closed its keep-alive side) retries on a fresh
+    connection without a breaker strike or failover."""
+
+    def test_connections_reused_and_counted(self):
+        from mmlspark_tpu.observability import metrics
+        d = DistributedServing(_transform, num_workers=2).start()
+        try:
+            before = metrics.counter("gateway_connection_reuse_total",
+                                     api="serving").value
+            for i in range(8):
+                status, body = _post(d.gateway.host, d.gateway.port,
+                                     "/serving", {"x": i})
+                assert status == 200 and body["y"] == i * 2
+            reuse = metrics.counter("gateway_connection_reuse_total",
+                                    api="serving").value - before
+            # 2 workers -> at most 2 fresh connects; the rest reuse
+            assert reuse >= 6, reuse
+            # pool holds at most one idle conn per worker here (serial
+            # client), bounded by max_per_host regardless
+            pool = d.gateway._pool
+            for q in d.workers:
+                assert pool.idle_count(q.server.host,
+                                       q.server.port) <= pool.max_per_host
+        finally:
+            d.stop()
+
+    def test_stale_pooled_socket_retries_cleanly(self):
+        import socket as socketlib
+
+        from mmlspark_tpu.observability import metrics
+        d = DistributedServing(_transform, num_workers=2).start()
+        try:
+            for i in range(6):
+                status, _ = _post(d.gateway.host, d.gateway.port,
+                                  "/serving", {"x": i})
+                assert status == 200
+            gw = d.gateway
+            stale_before = metrics.counter(
+                "gateway_stale_connections_total", api="serving").value
+            failovers_before = metrics.counter(
+                "gateway_failovers_total", api="serving").value
+            # make every pooled socket stale the way a worker restart
+            # does: the remote half goes away, the local fd stays valid
+            with gw._pool._lock:
+                shut = 0
+                for conns in gw._pool._idle.values():
+                    for c in conns:
+                        if c.sock is not None:
+                            c.sock.shutdown(socketlib.SHUT_RDWR)
+                            shut += 1
+            assert shut >= 1, "no pooled connections to go stale"
+            # the next requests ride fresh connections transparently
+            for i in range(4):
+                status, body = _post(d.gateway.host, d.gateway.port,
+                                     "/serving", {"x": 100 + i})
+                assert status == 200 and body["y"] == (100 + i) * 2
+            stale = metrics.counter("gateway_stale_connections_total",
+                                    api="serving").value - stale_before
+            failovers = metrics.counter("gateway_failovers_total",
+                                        api="serving").value \
+                - failovers_before
+            assert stale >= 1, "stale retry path never fired"
+            # a stale keep-alive socket is NOT a sick worker: no
+            # failover, no breaker strike
+            assert failovers == 0, failovers
+            from mmlspark_tpu.robustness import policy as _policy
+            states = {a: b.state for a, b in gw.breakers.items()}
+            assert all(s == _policy.CLOSED for s in states.values()), states
+        finally:
+            d.stop()
+
+    def test_killed_worker_still_fails_over_through_pool(self):
+        d = DistributedServing(_transform, num_workers=2).start()
+        try:
+            for i in range(6):
+                status, _ = _post(d.gateway.host, d.gateway.port,
+                                  "/serving", {"x": i})
+                assert status == 200
+            d.kill_worker(0)
+            # pooled sockets to the dead worker must not produce ghost
+            # replies: every request lands on the survivor
+            for i in range(8):
+                status, body = _post(d.gateway.host, d.gateway.port,
+                                     "/serving", {"x": i})
+                assert status == 200 and body["y"] == i * 2
+            assert d.workers[1].requests_served >= 8
+        finally:
+            d.stop()

@@ -217,6 +217,33 @@ class TestFunnelRules:
         assert not hits(active, "placement-funnel",
                         "mmlspark_tpu/parallel/compat.py")
 
+    def test_bundle_io_funnel(self, tmp_path):
+        active, suppressed = run_rule(tmp_path, "bundle-io-funnel", {
+            "mmlspark_tpu/bundles/bundle.py": """\
+                def build_bundle(model_path, out_dir):
+                    from jax import export as jax_export   # the funnel
+                    return jax_export
+            """,
+            "mmlspark_tpu/io/rogue.py": """\
+                import jax
+                import jax.export
+                from jax import export
+                from jax.export import deserialize
+
+                def load(blob):
+                    exp = jax.export.deserialize(blob)
+                    ok = jax.export  # graftlint: disable=bundle-io-funnel (test)
+                    return exp, ok
+            """})
+        got = hits(active, "bundle-io-funnel", "mmlspark_tpu/io/rogue.py")
+        # the module import, both from-imports, and the attribute touch
+        # all flag; the plain `import jax` does not
+        assert [f.line for f in got] == [2, 3, 4, 7], active
+        assert [f.line for f in suppressed] == [8]
+        # the bundles package is the sanctioned owner
+        assert not hits(active, "bundle-io-funnel",
+                        "mmlspark_tpu/bundles/bundle.py")
+
     def test_retry_sleep_funnel(self, tmp_path):
         active, suppressed = run_rule(tmp_path, "retry-sleep-funnel", {
             "mmlspark_tpu/robustness/policy.py":

@@ -467,6 +467,11 @@ class TestServingMetricsEndpoint:
             conn.close()
             metrics.set_enabled(True)
             g = metrics.gauge("serving_inflight_requests", api="toggling")
+            # polled: the client sees the 504 bytes a beat before the
+            # handler thread's finally-block dec() runs
+            deadline = time.monotonic() + 5
+            while g.value != 0.0 and time.monotonic() < deadline:
+                time.sleep(0.01)
             assert g.value == 0.0
         finally:
             metrics.set_enabled(True)

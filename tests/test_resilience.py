@@ -360,9 +360,15 @@ class TestAdmissionControl:
                                    reason="queue_full").value == 1.0
             assert any(e["kind"] == "shed" for e in flight.events())
             # a shed counts ONCE, as a 429 — not also as a phantom 504
-            # (exact-count parity with the async engine's accounting)
-            assert metrics.counter("serving_responses_total", api="shed",
-                                   code="429").value == 1.0
+            # (exact-count parity with the async engine's accounting).
+            # Polled: the client sees the response bytes a beat before
+            # the handler thread's finally-block accounting runs
+            ctr = metrics.counter("serving_responses_total", api="shed",
+                                  code="429")
+            deadline = time.monotonic() + 5
+            while ctr.value < 1.0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert ctr.value == 1.0
             assert done.get(timeout=10)[0] == 504   # the parked request
         finally:
             server.stop()
@@ -381,6 +387,23 @@ class TestAdmissionControl:
         assert series and series[0]["count"] >= 3
         # the shed hint machinery saw the same signal
         assert q.server._wait_ewma.value is not None
+
+    def test_chunked_transfer_rejected_loudly(self):
+        # the HTTP/1.1 keep-alive handlers don't decode chunked framing:
+        # they must answer 411 and close, never desync the persistent
+        # connection on an unread payload
+        q = _echo_query()
+        try:
+            status, body, _ = _request(
+                q.server.host, q.server.port, "/res", b"5\r\nhello\r\n0\r\n\r\n",
+                headers={"Transfer-Encoding": "chunked"})
+            assert status == 411 and b"Content-Length" in body
+            # the server is fine afterwards
+            status, _, _ = _request(q.server.host, q.server.port, "/res",
+                                    json.dumps({"i": 7}))
+            assert status == 200
+        finally:
+            q.stop()
 
     def test_drain_refuses_new_accepts_inflight(self):
         q = _echo_query()

@@ -363,8 +363,14 @@ class TestServingEdge:
     def test_debug_endpoints_count_requests(self, serving_query):
         host, port = serving_query.server.host, serving_query.server.port
         _get(host, port, "/healthz")
-        assert metrics.counter("debug_requests_total", api="traced",
-                               endpoint="healthz", code="200").value == 1.0
+        # polled: the response bytes reach the client a beat before the
+        # handler increments the counter after the write
+        ctr = metrics.counter("debug_requests_total", api="traced",
+                              endpoint="healthz", code="200")
+        deadline = time.monotonic() + 5
+        while ctr.value < 1.0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert ctr.value == 1.0
 
     def test_disabled_routes_fall_through_byte_identical(self,
                                                          serving_query):

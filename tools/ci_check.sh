@@ -11,6 +11,7 @@
 #   CI_SKIP_CHAOS=1 tools/ci_check.sh      # skip the chaos smoke
 #   CI_SKIP_ASYNC=1 tools/ci_check.sh      # skip the async-serving smoke
 #   CI_SKIP_MULTICHIP=1 tools/ci_check.sh  # skip the 8-device dry run
+#   CI_SKIP_BUNDLE=1 tools/ci_check.sh     # skip the AOT-bundle smoke
 set -u -o pipefail
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -165,6 +166,100 @@ EOF
         :
     else
         echo "ci_check: async-serving smoke FAILED" >&2
+        rc=1
+    fi
+fi
+
+# bundle smoke lane: build an AOT serving bundle in one process, warm-start
+# a real serving_main worker from it in another, and assert the ROADMAP
+# item 4 acceptance end to end — /healthz flips ready, the first /predict
+# answers, and the flight ring holds ZERO compile events.
+if [ "${CI_SKIP_BUNDLE:-0}" != "1" ]; then
+    if (cd "$ROOT" && env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+            python - <<'EOF'
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+from mmlspark_tpu.models.gbdt.booster import train_booster
+from mmlspark_tpu.models.gbdt.growth import GrowConfig
+
+env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+with tempfile.TemporaryDirectory() as d:
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 6)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    booster = train_booster(X=X, y=y, num_iterations=3, objective="binary",
+                            cfg=GrowConfig(num_leaves=7, min_data_in_leaf=5))
+    model = os.path.join(d, "model.txt")
+    with open(model, "w") as f:
+        f.write(booster.model_string())
+
+    # process 1: offline bundle build via the CLI
+    bundle = os.path.join(d, "model.bundle")
+    subprocess.run([sys.executable, "-m", "mmlspark_tpu.bundles", "build",
+                    "--model", model, "--out", bundle, "--max-batch", "8"],
+                   env=env, check=True, timeout=300)
+    assert os.path.exists(os.path.join(bundle, "MANIFEST.json"))
+
+    # process 2: warm-start a worker from the bundle
+    p = subprocess.Popen(
+        [sys.executable, "-m", "mmlspark_tpu.io.serving_main", "worker",
+         "--model", model, "--registry", os.path.join(d, "reg"),
+         "--host", "localhost", "--port", "0", "--max-batch", "8",
+         "--bundle", bundle],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    try:
+        line = p.stdout.readline()
+        m = re.search(r"serving on \S+:(\d+)", line)
+        assert m, f"no ready-line: {line!r}"
+        port = int(m.group(1))
+        # readiness flip: poll /healthz until green
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                with urllib.request.urlopen(
+                        f"http://localhost:{port}/healthz", timeout=5) as r:
+                    hz = json.loads(r.read())
+                if hz.get("ready"):
+                    break
+            except OSError:
+                pass
+            assert time.monotonic() < deadline, "worker never became ready"
+            time.sleep(0.05)
+        body = json.dumps({"features": [0.1] * 6}).encode()
+        with urllib.request.urlopen(urllib.request.Request(
+                f"http://localhost:{port}/serving", data=body,
+                method="POST"), timeout=10) as r:
+            reply = json.loads(r.read())
+            assert r.status == 200 and "prediction" in reply, reply
+        with urllib.request.urlopen(
+                f"http://localhost:{port}/debug/flight", timeout=5) as r:
+            ring = json.loads(r.read())
+        compiles = [e for e in ring["events"] if e.get("kind") == "compile"]
+        assert compiles == [], f"warm start compiled: {compiles}"
+        loaded = [e for e in ring["events"] if e.get("kind") == "bundle"
+                  and e.get("event") == "entry_loaded"]
+        assert loaded, "no bundle entries loaded"
+    finally:
+        p.send_signal(signal.SIGTERM)
+        p.wait(timeout=30)
+print("bundle smoke: built offline, warm-started ready, first predict "
+      "with zero compile events")
+EOF
+    ); then
+        :
+    else
+        echo "ci_check: bundle smoke FAILED" >&2
         rc=1
     fi
 fi

@@ -36,6 +36,11 @@ walks in ``tests/test_lint.py``:
   (``parallel/compat.py`` allowlisted). An ad-hoc placement call site
   re-opens the per-model-family placement divergence the funnel closed,
   and its decision is invisible to the flight recorder.
+* ``bundle-io-funnel`` — ``mmlspark_tpu/bundles/`` is the one door for
+  ``jax.export`` (serializing/deserializing compiled executables): an
+  ad-hoc deserialize site bypasses the bundle manifest's fingerprint,
+  checksum and key-recomputation checks — exactly the wrong-numerics
+  risk the bundle subsystem exists to make impossible.
 """
 
 from __future__ import annotations
@@ -141,6 +146,30 @@ def _match_placement(mod: Module) -> Matches:
                   and isinstance(node.value, ast.Attribute)
                   and node.value.attr == "sharding"):
                 yield node.lineno, f"<module>.sharding.{node.attr}"
+
+
+def _match_jax_export(mod: Module) -> Matches:
+    """The jax.export surface: importing the module (``import jax.export``
+    / ``from jax import export`` / ``from jax.export import ...``) or
+    touching it as ``jax.export.<...>``. Any of these is one call away
+    from deserializing an executable outside the bundle checks."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for alias in node.names:
+                    if alias.name == "export":
+                        yield node.lineno, "from jax import export"
+            elif node.module and (node.module == "jax.export"
+                                  or node.module.startswith("jax.export.")):
+                yield node.lineno, f"from {node.module} import"
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax.export":
+                    yield node.lineno, "import jax.export"
+        elif (isinstance(node, ast.Attribute) and node.attr == "export"
+              and isinstance(node.value, ast.Name)
+              and node.value.id == "jax"):
+            yield node.lineno, "jax.export"
 
 
 def _match_loop_sleep(mod: Module) -> Matches:
@@ -254,6 +283,21 @@ FUNNEL_RULES: Tuple[FunnelRule, ...] = (
                "shard_rows / device_put / put_on_device) so the decision "
                "is funneled and flight-logged",
         anchors=(("mmlspark_tpu/parallel/placement.py", "pspec"),),
+    ),
+    FunnelRule(
+        rule="bundle-io-funnel",
+        description="jax.export (executable serialization / "
+                    "deserialization) only via mmlspark_tpu/bundles",
+        scope=("mmlspark_tpu",),
+        allow=("mmlspark_tpu/bundles/bundle.py",
+               "mmlspark_tpu/bundles/__init__.py",
+               "mmlspark_tpu/bundles/__main__.py"),
+        match=_match_jax_export,
+        remedy="route executable (de)serialization through "
+               "mmlspark_tpu.bundles (build_bundle / prewarm) — an "
+               "ad-hoc deserialize bypasses the manifest's fingerprint, "
+               "checksum, and key-recomputation checks",
+        anchors=(("mmlspark_tpu/bundles/bundle.py", "build_bundle"),),
     ),
     FunnelRule(
         rule="retry-sleep-funnel",
