@@ -89,6 +89,7 @@ def _dump_metrics_snapshot(leg: str, wall_start: float = 0.0) -> None:
     root, ext = os.path.splitext(path)
     path = f"{root}.{leg}{ext or '.json'}"
     try:
+        from mmlspark_tpu.io.serving import roofline_payload
         from mmlspark_tpu.observability import metrics as _obs_metrics
         from mmlspark_tpu.observability import watchdog as _obs_watchdog
         wall_end = time.time()
@@ -98,12 +99,62 @@ def _dump_metrics_snapshot(leg: str, wall_start: float = 0.0) -> None:
                            "seconds": round(wall_end - wall_start, 3)
                            if wall_start else None},
             "watchdog_stalls": _obs_watchdog.stall_counts(),
+            # the measured roofline/HBM ledgers ride beside the metrics so
+            # tools/roofline_report.py can re-render a dumped leg offline
+            "roofline": roofline_payload(),
             "metrics": _obs_metrics.get_registry().snapshot(),
         }
         with open(path, "w") as f:
             json.dump(payload, f, indent=2)
     except Exception as e:  # noqa: BLE001 — telemetry must not fail a bench
         print(f"metrics snapshot failed: {e!r}", file=sys.stderr)
+
+
+def _measured_roofline_keys() -> dict:
+    """``*_roofline_pct`` keys for the bench line, from the MEASURED
+    ledger (cost_analysis x observed wall time), not the analytic model
+    in ``_gbdt_roofline``. Per executable kind, the hotter of the FLOP /
+    byte percentages; absent entirely when peaks are unknown (CPU leg) —
+    the ``_pct`` suffix keeps every one of these report-only in
+    tools/bench_regression.py, which gates rates alone."""
+    out: dict = {}
+    try:
+        from mmlspark_tpu.observability import roofline as _obs_roofline
+        best: dict = {}
+        for e in _obs_roofline.snapshot_payload().get("executables", []):
+            pcts = [p for p in (e.get("flops_pct"), e.get("bytes_pct"))
+                    if p is not None]
+            if not pcts:
+                continue
+            kind = str(e.get("kind") or "unknown")
+            best[kind] = max(best.get(kind, 0.0), max(pcts))
+        for kind, pct in sorted(best.items()):
+            out[f"gbdt_{kind}_roofline_pct"] = round(pct, 3)
+    except Exception as e:  # noqa: BLE001 — telemetry must not fail a bench
+        print(f"measured roofline keys failed: {e!r}", file=sys.stderr)
+    return out
+
+
+def _roofline_epilogue(leg: str) -> None:
+    """Bench epilogue: hot executables as %-of-roofline plus the serving
+    leg as a stage-time table, rendered by tools/roofline_report.py.
+    Printed to stderr — stdout carries only the JSON line contract."""
+    try:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "roofline_report.py")
+        spec = importlib.util.spec_from_file_location("_roofline_report",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        from mmlspark_tpu.io.serving import roofline_payload
+        from mmlspark_tpu.observability import metrics as _obs_metrics
+        text = mod.render_text(roofline_payload(),
+                               _obs_metrics.get_registry().snapshot())
+        print(f"-- roofline epilogue ({leg} leg) --\n{text}",
+              file=sys.stderr, flush=True)
+    except Exception as e:  # noqa: BLE001 — telemetry must not fail a bench
+        print(f"roofline epilogue failed: {e!r}", file=sys.stderr)
 
 
 def _dump_flight_snapshot(leg: str) -> None:
@@ -625,9 +676,11 @@ def _run_leg(on_tpu: bool) -> None:
         out[f"imagelime_rows_per_sec{sfx}"] = lime_rates["rows_per_sec"]
         out[f"imagelime_perturbations_per_sec{sfx}"] = \
             lime_rates["perturbations_per_sec"]
+    out.update(_measured_roofline_keys())
     print(json.dumps(out))
     _dump_metrics_snapshot("tpu" if on_tpu else "cpu", leg_wall_start)
     _dump_flight_snapshot("tpu" if on_tpu else "cpu")
+    _roofline_epilogue("tpu" if on_tpu else "cpu")
 
 
 def _gbdt_roofline(n_rows: int, n_feat: int, max_bin: int,

@@ -48,6 +48,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..observability import flight as _flight
+from ..observability import hbm as _hbm
 from ..observability import metrics as _metrics
 from ..observability.logging import get_logger
 from ..utils import compile_cache as _compile_cache
@@ -496,6 +497,10 @@ def _load_entry(bundle_dir: str, entry: Dict[str, Any],
         return skip("deserialize_failed", error=f"{type(e).__name__}: {e}")
     if not preload_predict_program(plan.key, compiled):
         return skip("already_cached")
+    # HBM-ledger claim: the deserialized program's device footprint is
+    # opaque pre-execution, so the ledger carries the artifact size — a
+    # stable lower bound that still shows prewarm residency per site
+    _hbm.claim("bundle_prewarm", float(len(blob)))
     _metrics.safe_counter("bundle_entries_loaded_total").inc()
     _flight.record("bundle", event="entry_loaded", key_hash=key_hash,
                    batch_size=batch_size,

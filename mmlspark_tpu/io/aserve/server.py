@@ -49,7 +49,8 @@ from ...observability import watchdog as _watchdog
 from ...observability.logging import get_logger
 from ...robustness import failpoints as _failpoints
 from ...robustness import policy as _policy
-from ..serving import _BATCH_SIZE_BUCKETS, debug_body, debug_route
+from ..serving import (_BATCH_SIZE_BUCKETS, debug_body, debug_route,
+                       observe_request_stages, stage_breakdown)
 from .http import BadRequest, ParsedRequest, read_request, write_response
 from .slots import SlotTable, resolve_slots
 
@@ -78,8 +79,8 @@ class AsyncRequest:
     """One in-flight request, parked as a future on the event loop."""
 
     __slots__ = ("id", "method", "path", "headers", "body", "value",
-                 "trace", "deadline", "enqueued_at", "requeued", "slot",
-                 "future")
+                 "trace", "deadline", "enqueued_at", "dispatched_at",
+                 "scored_at", "requeued", "slot", "future")
 
     def __init__(self, parsed: ParsedRequest, trace, deadline, future):
         self.id = uuid.uuid4().hex
@@ -91,6 +92,9 @@ class AsyncRequest:
         self.trace = trace
         self.deadline = deadline
         self.enqueued_at = time.monotonic()
+        # stage-decomposition marks (monotonic): batch dispatch / reply
+        self.dispatched_at = 0.0
+        self.scored_at = 0.0
         self.requeued = False
         self.slot: Optional[int] = None
         self.future = future
@@ -207,6 +211,8 @@ class AsyncServingServer:
             loop.call_soon_threadsafe(self._shutdown)
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self.slot_table is not None:
+            self.slot_table.release_claim()
 
     def _shutdown(self) -> None:
         # on the loop: close the listener, then stop — run_forever's
@@ -322,6 +328,7 @@ class AsyncServingServer:
     # -- reply routing (event loop thread) ---------------------------------
     def _resolve(self, req: AsyncRequest, status: int, payload: bytes,
                  headers: Dict[str, str]) -> None:
+        req.scored_at = time.monotonic()   # stage mark: score ends
         if not req.future.done():
             req.future.set_result((status, payload, headers))
         self._progress.set()
@@ -389,6 +396,7 @@ class AsyncServingServer:
         wait_h = _metrics.safe_histogram("serving_queue_wait_seconds",
                                          api=self.api_name)
         for r in batch:
+            r.dispatched_at = now       # stage mark: forming_wait ends
             w = now - r.enqueued_at
             wait_h.observe(w)
             self._wait_ewma.update(w)
@@ -480,6 +488,10 @@ class AsyncServingServer:
         ctx = _tracing.context_from_headers(parsed.headers)
         token = _tracing.activate(ctx) if ctx is not None else None
         t0 = time.perf_counter()
+        # monotonic twin of t0: stage marks live on the monotonic clock,
+        # so the decomposition sums track the observed wall time
+        t0_mono = time.monotonic()
+        req: Optional[AsyncRequest] = None
         inflight = _metrics.safe_gauge("serving_inflight_requests",
                                        api=api)
         inflight.inc()
@@ -557,8 +569,14 @@ class AsyncServingServer:
             dt = time.perf_counter() - t0
             _metrics.safe_histogram("serving_request_seconds",
                                     api=api).observe(dt)
+            stages = None
+            if req is not None and _metrics.enabled():
+                stages = stage_breakdown(
+                    t0_mono, req.enqueued_at, req.dispatched_at,
+                    req.scored_at, time.monotonic())
+                observe_request_stages(api, stages)
             _tracing.maybe_mark_slow("serving_request_seconds", dt,
-                                     api=api)
+                                     stages=stages, api=api)
             if token is not None:
                 _tracing.deactivate(token)
 

@@ -28,6 +28,7 @@ from typing import Tuple
 
 import numpy as np
 
+from ...observability import hbm as _hbm
 from ...observability.env_registry import env_int
 from ..serving import bucket_size
 
@@ -60,6 +61,17 @@ class SlotTable:
         self._bufs = (np.zeros((self.slots, self.width), dtype),
                       np.zeros((self.slots, self.width), dtype))
         self._active = 0
+        # HBM-ledger claim: both ping-pong staging buffers, held for the
+        # table's lifetime (released via release_claim() at server stop)
+        self._claimed = float(sum(b.nbytes for b in self._bufs))
+        _hbm.claim("aserve_slots", self._claimed)
+
+    def release_claim(self) -> None:
+        """Give the staging buffers' HBM-ledger claim back (idempotent —
+        the owning server calls this once at stop)."""
+        if self._claimed:
+            _hbm.release("aserve_slots", self._claimed)
+            self._claimed = 0.0
 
     @property
     def forming(self) -> np.ndarray:

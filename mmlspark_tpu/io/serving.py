@@ -47,7 +47,9 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..core.dataset import Dataset
 from ..observability import flight as _flight
+from ..observability import hbm as _hbm
 from ..observability import metrics as _metrics
+from ..observability import roofline as _roofline
 from ..observability import spans as _spans
 from ..observability import tracing as _tracing
 from ..observability import watchdog as _watchdog
@@ -70,6 +72,12 @@ FLIGHT_PATH = "/debug/flight"
 #: per-worker scrape health + staleness + last failover (gateway
 #: federation view; answers with a "no federation" note elsewhere)
 CLUSTER_PATH = "/debug/cluster"
+#: roofline + HBM ledgers: per-executable achieved FLOP/s / bytes/s
+#: vs backend peaks, plus named device-memory claims
+ROOFLINE_PATH = "/debug/roofline"
+#: fleet scale-pressure signal derived from federated queue telemetry
+#: (gateway; answers with a "no federation" note elsewhere)
+AUTOSCALE_PATH = "/debug/autoscale"
 
 #: (route name, path) table shared by the serving server and the gateway
 DEBUG_ROUTES = (
@@ -78,6 +86,8 @@ DEBUG_ROUTES = (
     ("varz", VARZ_PATH),
     ("flight", FLIGHT_PATH),
     ("cluster", CLUSTER_PATH),
+    ("roofline", ROOFLINE_PATH),
+    ("autoscale", AUTOSCALE_PATH),
 )
 
 
@@ -240,6 +250,14 @@ def debug_body(route: str, api_name: str,
                          "note": "no federation in this process (cluster "
                                  "view lives on the distributed-serving "
                                  "gateway)"})
+    elif route == "roofline":
+        payload = roofline_payload()
+    elif route == "autoscale":
+        payload = (federation.autoscale_hint() if federation is not None
+                   else {"federation": None,
+                         "note": "no federation in this process (the "
+                                 "autoscale signal lives on the "
+                                 "distributed-serving gateway)"})
     else:
         payload = _flight.snapshot()
     return (json.dumps(payload, default=repr).encode("utf-8"),
@@ -261,6 +279,54 @@ def write_debug_response(handler: BaseHTTPRequestHandler, route: str,
     write_http_response(handler, 200, body, {"Content-Type": ctype},
                         counter="debug_requests_total",
                         api=api_name, endpoint=route)
+
+
+def roofline_payload() -> Dict[str, Any]:
+    """``/debug/roofline`` body: the roofline ledger (per-executable
+    achieved FLOP/s & bytes/s vs backend peaks — ratios-only with an
+    explicit ``peaks.source: "unknown"`` off-TPU) plus the HBM ledger's
+    named claims reconciled against the last PJRT sample."""
+    payload = _roofline.snapshot_payload()
+    payload["hbm"] = _hbm.snapshot_payload()
+    return payload
+
+
+# -- per-request latency decomposition --------------------------------------
+# Both engines stamp monotonic marks on each request's timeline and fold
+# them into the same four stages here, so the stage vocabulary (and the
+# invariant that stages partition the request wall time) cannot drift
+# between the threaded and async planes.
+
+#: stage vocabulary, in timeline order
+SERVING_STAGES = ("admission", "forming_wait", "score", "write")
+
+
+def stage_breakdown(start: float, admitted: float, dispatched: float,
+                    scored: float, end: float) -> Optional[Dict[str, float]]:
+    """Fold one request's monotonic marks into the four-stage
+    decomposition (``admission`` = edge parse + enqueue, ``forming_wait``
+    = queue + batch forming, ``score`` = transform/predict,
+    ``write`` = reply serialization + socket write). The stages
+    partition [start, end] exactly. None when any mark is missing —
+    only fully scored round trips decompose (shed/timeout paths answer
+    before a timeline exists)."""
+    if not (start and admitted and dispatched and scored and end):
+        return None
+    return {"admission": max(0.0, admitted - start),
+            "forming_wait": max(0.0, dispatched - admitted),
+            "score": max(0.0, scored - dispatched),
+            "write": max(0.0, end - scored)}
+
+
+def observe_request_stages(api_name: str,
+                           stages: Optional[Dict[str, float]]) -> None:
+    """Feed one request's stage breakdown into the
+    ``serving_stage_seconds{api, stage}`` histograms (both engines)."""
+    if not stages:
+        return
+    for stage, seconds in stages.items():
+        _metrics.safe_histogram("serving_stage_seconds", api=api_name,
+                                stage=stage).observe(seconds)
 
 
 # power-of-two ladder matching the jit bucket shapes (bucket_size below)
@@ -290,6 +356,11 @@ class ServedRequest:
     deadline: Optional[_policy.Deadline] = None
     #: monotonic admission time — the queue-wait clock
     enqueued_at: float = 0.0
+    #: monotonic batch-assembly mark (stage decomposition: end of
+    #: forming_wait) — 0.0 until the request joins a batch
+    dispatched_at: float = 0.0
+    #: monotonic reply mark (end of score) — 0.0 until reply() lands
+    scored_at: float = 0.0
     #: withdrawn at admission (drain race): the batch loop must skip it —
     #: its handler already answered 503
     shed: bool = False
@@ -420,6 +491,11 @@ class ServingServer:
                 ctx = _tracing.context_from_headers(self.headers)
                 token = _tracing.activate(ctx) if ctx is not None else None
                 t0 = time.perf_counter()
+                # monotonic twin of t0: the stage decomposition is
+                # computed entirely on the monotonic clock the timeline
+                # marks use, so stage sums track the observed wall time
+                t0_mono = time.monotonic()
+                req: Optional[ServedRequest] = None
                 # captured once so inc/dec hit the same object even if
                 # metrics.set_enabled is toggled while this request is
                 # parked on done.wait() — re-resolving in the finally
@@ -507,8 +583,15 @@ class ServingServer:
                     _metrics.safe_histogram(
                         "serving_request_seconds", api=outer.api_name
                     ).observe(dt)
+                    stages = None
+                    if req is not None and _metrics.enabled():
+                        stages = stage_breakdown(
+                            t0_mono, req.enqueued_at, req.dispatched_at,
+                            req.scored_at, time.monotonic())
+                        observe_request_stages(outer.api_name, stages)
                     _tracing.maybe_mark_slow("serving_request_seconds",
-                                             dt, api=outer.api_name)
+                                             dt, stages=stages,
+                                             api=outer.api_name)
                     if token is not None:
                         _tracing.deactivate(token)
 
@@ -682,6 +765,7 @@ class ServingServer:
         wait_h = _metrics.safe_histogram("serving_queue_wait_seconds",
                                          api=self.api_name)
         for r in out:
+            r.dispatched_at = now       # stage mark: forming_wait ends
             if r.enqueued_at:
                 w = now - r.enqueued_at
                 wait_h.observe(w)
@@ -726,6 +810,7 @@ class ServingServer:
             headers = {"Content-Type": "application/json", **(headers or {})}
         req.response = {"statusCode": status_code, "entity": entity or b"",
                         "headers": headers or {}}
+        req.scored_at = time.monotonic()   # stage mark: score ends
         req.done.set()
         self._progress.set()
         return True

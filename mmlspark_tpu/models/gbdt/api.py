@@ -23,6 +23,7 @@ from ...core.params import (HasFeaturesCol, HasGroupCol, HasInitScoreCol,
                             HasRawPredictionCol, HasValidationIndicatorCol,
                             HasWeightCol, Param, Params, TypeConverters)
 from ...core.pipeline import Estimator, Model
+from ...observability import hbm as _hbm
 from ...observability import metrics as _metrics
 from ...observability import spans as _spans
 from ...observability import watchdog as _watchdog
@@ -52,10 +53,19 @@ def _to_tristate_bool(v):
     return TypeConverters.to_bool(v)
 
 
+def _dataset_nbytes(ds) -> float:
+    """Device bytes one cached binned dataset pins (the ``binned_cache``
+    HBM-ledger claim): the [F, n_pad] bin matrix + label/weight/mask."""
+    return float(sum(getattr(a, "nbytes", 0) or 0
+                     for a in (ds.Xbt_d, ds.y_d, ds.w_d, ds.vmask_d)
+                     if a is not None))
+
+
 def clear_binned_dataset_cache() -> None:
     """Release the cached pre-binned device datasets (frees their HBM) —
     call after a sweep when the process moves on to other device work."""
     _BINNED_CACHE.clear()
+    _hbm.set_claim("binned_cache", 0)
 
 
 def _cache_enabled() -> bool:
@@ -104,8 +114,10 @@ def _cached_binned_dataset(X, y, w, *, max_bin, bin_sample_count, seed,
             categorical_features=categorical_features, bin_dtype=bin_dtype,
             max_bin_by_feature=max_bin_by_feature)
         _BINNED_CACHE[key] = ds
+        _hbm.claim("binned_cache", _dataset_nbytes(ds))
         while len(_BINNED_CACHE) > _BINNED_CACHE_MAX:
-            _BINNED_CACHE.popitem(last=False)
+            _k, old = _BINNED_CACHE.popitem(last=False)
+            _hbm.release("binned_cache", _dataset_nbytes(old))
     else:
         _BINNED_CACHE.move_to_end(key)
     return ds

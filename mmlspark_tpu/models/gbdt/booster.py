@@ -28,7 +28,9 @@ import numpy as np
 from jax.sharding import Mesh
 
 from ...observability import flight as _flight
+from ...observability import hbm as _hbm
 from ...observability import metrics as _metrics
+from ...observability import roofline as _roofline
 from ...observability import spans as _spans
 from ...observability import watchdog as _watchdog
 from ...observability.logging import console as _console
@@ -72,6 +74,10 @@ def _cached_program(key, build):
                        persistent_cache=_compile_cache.cache_dir() or "")
         _metrics.safe_counter("gbdt_program_builds_total",
                               cache="gbdt_step").inc()
+        # roofline ledger entry: step programs compile lazily, so no
+        # cost_analysis here — the entry still names the executable
+        _roofline.register_executable(predict_key_hash(key), kind="step",
+                                      label="gbdt_step")
         _STEP_CACHE[key] = prog
         while len(_STEP_CACHE) > _STEP_CACHE_MAX:
             _STEP_CACHE.popitem(last=False)
@@ -241,6 +247,13 @@ _PREDICT_CACHE_MAX = 64
 _PREDICT_CACHE_LOCK = threading.Lock()
 
 
+def _forest_args_nbytes(ent) -> float:
+    """Total device bytes a cached forest-argument tuple pins — the
+    ``packed_trees`` HBM-ledger claim (None members contribute 0)."""
+    return float(sum(getattr(a, "nbytes", 0) or 0 for a in ent
+                     if a is not None))
+
+
 def _cost_summary(compiled) -> dict:
     """FLOPs / bytes-accessed from XLA ``cost_analysis()`` where the
     backend exposes it ({} elsewhere) — the GSPMD observation that what
@@ -272,19 +285,42 @@ class _ObservedProgram:
     scoring never depends on the observability path.
     """
 
-    __slots__ = ("_jitted", "_key", "_compiled", "_lock")
+    __slots__ = ("_jitted", "_key", "_key_hash", "_compiled", "_lock")
 
     def __init__(self, jitted, key):
         self._jitted = jitted
         self._key = key
+        self._key_hash = predict_key_hash(key)
         self._compiled = None
         self._lock = threading.Lock()
+
+    @classmethod
+    def from_compiled(cls, compiled, key):
+        """Wrap an ALREADY-COMPILED executable (the bundle-prewarm path)
+        so prewarmed entries get the same call-site roofline timing as
+        organically-compiled ones."""
+        prog = cls(None, key)
+        prog._compiled = compiled
+        return prog
 
     def __call__(self, *args):
         fn = self._compiled
         if fn is None:
             fn = self._compile_observed(args)
-        return fn(*args)
+        if not _metrics.enabled():
+            return fn(*args)
+        # roofline call-site timer: block on the output so the sample is
+        # device wall time, not dispatch time. Cheap in context — every
+        # consumer immediately downloads the result (a blocking d2h), so
+        # the sync this timer adds was about to happen anyway.
+        t0 = time.perf_counter()
+        out = fn(*args)
+        try:
+            jax.block_until_ready(out)
+        except Exception:  # noqa: BLE001 — telemetry must not fail a call
+            pass
+        _roofline.observe_call(self._key_hash, time.perf_counter() - t0)
+        return out
 
     def _compile_observed(self, args):
         # serialized: two serving threads hitting a cold entry must not
@@ -313,6 +349,18 @@ class _ObservedProgram:
                        seconds=round(dt, 6),
                        persistent_cache=_compile_cache.cache_dir() or "",
                        **cost)
+        try:
+            devs = jax.devices()
+            if devs:
+                _roofline.note_device_kind(
+                    getattr(devs[0], "device_kind", None))
+        except Exception:  # noqa: BLE001 — peaks degrade to unknown
+            pass
+        _roofline.register_executable(
+            self._key_hash, kind="predict",
+            flops=cost.get("flops"),
+            bytes_accessed=cost.get("bytes_accessed"),
+            compile_seconds=dt, label="gbdt_predict")
         return fn
 
 
@@ -348,15 +396,26 @@ def preload_predict_program(key, fn) -> bool:
     dashboards can tell prewarmed capacity from organically-warmed."""
     with _PREDICT_CACHE_LOCK:
         if key in _PREDICT_CACHE:
-            taken = False
-        else:
-            _PREDICT_CACHE[key] = fn
-            taken = True
-            while len(_PREDICT_CACHE) > _PREDICT_CACHE_MAX:
-                _PREDICT_CACHE.popitem(last=False)
-    if taken:
-        _metrics.safe_counter("gbdt_predict_cache_preloads_total").inc()
-    return taken
+            return False
+    # wrap outside the lock (cost_analysis can be slow): prewarmed
+    # entries get the same call-site roofline timing as organic ones
+    if not isinstance(fn, _ObservedProgram):
+        cost = _cost_summary(fn)
+        prog = _ObservedProgram.from_compiled(fn, key)
+        _roofline.register_executable(
+            prog._key_hash, kind="predict",
+            flops=cost.get("flops"),
+            bytes_accessed=cost.get("bytes_accessed"),
+            label="gbdt_predict(prewarm)")
+        fn = prog
+    with _PREDICT_CACHE_LOCK:
+        if key in _PREDICT_CACHE:      # lost the race while wrapping
+            return False
+        _PREDICT_CACHE[key] = fn
+        while len(_PREDICT_CACHE) > _PREDICT_CACHE_MAX:
+            _PREDICT_CACHE.popitem(last=False)
+    _metrics.safe_counter("gbdt_predict_cache_preloads_total").inc()
+    return True
 
 
 def predict_key_hash(key) -> str:
@@ -796,11 +855,13 @@ class Booster:
                         np.ascontiguousarray(self.missing_dec[:T_pad])))
             ent = (jnp.asarray(packed), jnp.asarray(thr),
                    jnp.asarray(self.base_score), is_cat, mdec)
+            _hbm.claim("packed_trees", _forest_args_nbytes(ent))
             # bounded LRU: each entry pins a device tree buffer, so a
             # learning-curve sweep over every t_end must not pin O(T^2)
             cache[T_pad] = ent
             while len(cache) > 4:
-                cache.popitem(last=False)
+                _k, old = cache.popitem(last=False)
+                _hbm.release("packed_trees", _forest_args_nbytes(old))
         else:
             cache.move_to_end(T_pad)
         return ent

@@ -9,11 +9,15 @@ documented retention, models/gbdt/api.py) shows up as a rising
 
 from __future__ import annotations
 
+import sys
+import threading
+import time
 from typing import Any, Dict, Optional
 
 from . import metrics as _metrics
+from .env_registry import env_float
 
-__all__ = ["device_memory_gauges"]
+__all__ = ["device_memory_gauges", "maybe_sample_device_memory"]
 
 # PJRT stat keys worth exporting (others vary by backend and stay in the
 # returned dict for callers that want them).
@@ -43,3 +47,39 @@ def device_memory_gauges() -> Dict[str, Optional[Dict[str, Any]]]:
                 _metrics.safe_gauge("device_memory_bytes",
                                     device=dev, stat=key).set(float(v))
     return stats
+
+
+# -- periodic sampling hook --------------------------------------------------
+# Before this, device_memory_bytes only moved when a caller remembered to
+# invoke device_memory_gauges() — it flatlined between manual calls. The
+# watchdog tick and the federation sweep both call the throttled hook
+# below, so any process running either loop gets a fresh sample every
+# MMLSPARK_TPU_DEVICE_MEMORY_INTERVAL_SECONDS for free.
+
+_INTERVAL_ENV = "MMLSPARK_TPU_DEVICE_MEMORY_INTERVAL_SECONDS"
+_sample_lock = threading.Lock()
+_last_sample = 0.0
+
+
+def maybe_sample_device_memory(now: Optional[float] = None) -> bool:
+    """Throttled ``device_memory_gauges()``: samples at most once per
+    interval knob, only when telemetry is on AND jax is already loaded
+    (a gateway/watchdog host must never import the framework just to
+    poll memory it does not hold). Returns True when a sample ran."""
+    if not _metrics.enabled() or "jax" not in sys.modules:
+        return False
+    interval = env_float(_INTERVAL_ENV, 30.0)
+    if interval <= 0:
+        return False
+    global _last_sample
+    if now is None:
+        now = time.monotonic()
+    with _sample_lock:
+        if now - _last_sample < interval:
+            return False
+        _last_sample = now
+    try:
+        device_memory_gauges()
+    except Exception:
+        return False
+    return True

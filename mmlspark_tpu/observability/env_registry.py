@@ -135,6 +135,22 @@ REGISTRY: Tuple[EnvVar, ...] = (
            doc="`1` enables the per-boost-round telemetry callback — "
                "forces the host training loop, so the fused "
                "single-dispatch paths stay the default"),
+    # -- roofline / device-memory ledgers ---------------------------------
+    EnvVar(name="MMLSPARK_TPU_PEAK_FLOPS", default="(per-device_kind table)",
+           doc="backend peak FLOP/s the roofline ledger computes "
+               "%-of-peak against; overrides the built-in per-"
+               "`device_kind` table (unknown backends degrade to "
+               "ratios-only)"),
+    EnvVar(name="MMLSPARK_TPU_PEAK_BYTES_PER_SECOND",
+           default="(per-device_kind table)",
+           doc="backend peak HBM bytes/s for the roofline ledger's "
+               "memory-bound axis; same override/degradation semantics "
+               "as `MMLSPARK_TPU_PEAK_FLOPS`"),
+    EnvVar(name="MMLSPARK_TPU_DEVICE_MEMORY_INTERVAL_SECONDS",
+           default="30",
+           doc="period of the background `device_memory_bytes` sampling "
+               "hooked into the watchdog tick and federation sweep "
+               "(0 disables; samples only when jax is already loaded)"),
     # -- training / histogram engine --------------------------------------
     EnvVar(name="MMLSPARK_TPU_HIST_ENGINE", default="auto",
            section="performance",

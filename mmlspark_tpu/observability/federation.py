@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from . import device as _device
 from . import metrics as _metrics
 
 __all__ = [
@@ -317,6 +318,9 @@ class MetricsFederator:
         while not stop.wait(self.interval):
             if not _metrics.enabled():
                 continue
+            # piggyback the periodic device-memory sample on the sweep
+            # (throttled + jax-guarded inside; a jax-free gateway skips)
+            _device.maybe_sample_device_memory()
             try:
                 self.scrape_once()
             except Exception:  # noqa: BLE001 — the sweep must never die
@@ -359,6 +363,10 @@ class MetricsFederator:
             for label in list(self._workers):
                 if label not in seen:
                     del self._workers[label]
+        try:
+            self.autoscale_hint()       # refresh the gauge every sweep
+        except Exception:  # noqa: BLE001 — advisory signal only
+            pass
 
     def _worker(self, label: str) -> _WorkerState:
         with self._lock:
@@ -396,6 +404,52 @@ class MetricsFederator:
                 continue
             out[label] = sum(float(v) for _labels, v in rows)
         return out
+
+    def autoscale_hint(self) -> Dict[str, Any]:
+        """Scale-pressure signal from the fleet's own backpressure
+        telemetry (ROADMAP item 1's observability half — the signal
+        only, no supervisor acts on it here).
+
+        The hint is the mean queue depth per live worker from the last
+        sweep: ``0`` means the fleet absorbs arrivals as they come,
+        sustained ``>= 1`` means every worker carries standing backlog —
+        add capacity. Per-worker mean queue wait (histogram ``sum /
+        count`` from the same scrape) rides along so an operator can
+        tell deep-but-fast queues from genuinely slow ones. Also sets
+        the ``cluster_autoscale_hint`` gauge."""
+        depths = self.gauge_values("serving_queue_depth")
+        waits: Dict[str, Optional[float]] = {}
+        with self._lock:
+            states = list(self._workers.items())
+        for label, st in states:
+            if label not in depths:
+                continue
+            mean = None
+            fam = st.families.get("serving_queue_wait_seconds")
+            if fam is not None and fam[0] == "histogram":
+                total = sum(float(h["sum"]) for _l, h in fam[1])
+                count = sum(float(h["count"]) for _l, h in fam[1])
+                if count > 0:
+                    mean = total / count
+            waits[label] = mean
+        live = len(depths)
+        total_depth = sum(depths.values())
+        hint = (total_depth / live) if live else 0.0
+        _metrics.safe_gauge("cluster_autoscale_hint").set(hint)
+        observed = [w for w in waits.values() if w is not None]
+        return {
+            "hint": hint,
+            "live_workers": live,
+            "total_queue_depth": total_depth,
+            "mean_queue_wait_seconds":
+                (sum(observed) / len(observed)) if observed else None,
+            "workers": {label: {"queue_depth": depths[label],
+                                "queue_wait_mean_seconds": waits.get(label)}
+                        for label in sorted(depths)},
+            "note": "mean queue depth per live worker; sustained >= 1 "
+                    "suggests adding capacity, 0 means arrivals are "
+                    "absorbed as they come (advisory only)",
+        }
 
     # -- export --------------------------------------------------------------
     def _scrape_health_families(self) -> Families:

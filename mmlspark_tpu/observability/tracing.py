@@ -226,8 +226,15 @@ def set_slow_threshold(seconds: float) -> float:
     return prev
 
 
-def maybe_mark_slow(metric: str, seconds: float, **labels: Any) -> bool:
+def maybe_mark_slow(metric: str, seconds: float,
+                    stages: Optional[Dict[str, float]] = None,
+                    **labels: Any) -> bool:
     """Record an exemplar if ``seconds`` crosses the slow threshold.
+
+    ``stages`` (optional) is a per-stage wall-time breakdown of the
+    same request (e.g. the serving plane's admission / forming_wait /
+    score / write decomposition); it rides the exemplar and the flight
+    event so a slow request tells you *which leg* was slow.
 
     Returns whether one was recorded. Near-zero cost on the fast path:
     one float compare when under threshold or disabled.
@@ -241,12 +248,19 @@ def maybe_mark_slow(metric: str, seconds: float, **labels: Any) -> bool:
         "span_id": ctx.span_id if ctx else None,
         "ts": time.time(), "labels": dict(labels),
     }
+    if stages:
+        ex["stages"] = {str(k): round(float(v), 6)
+                        for k, v in stages.items()}
     with _exemplar_lock:
         _exemplars.append(ex)
     _metrics.safe_counter("slow_requests_total", metric=metric).inc()
     from . import flight as _flight  # lazy: flight imports tracing
-    _flight.record("slow_request", metric=metric,
-                   seconds=ex["seconds"], **labels)
+    if stages:
+        _flight.record("slow_request", metric=metric,
+                       seconds=ex["seconds"], stages=ex["stages"], **labels)
+    else:
+        _flight.record("slow_request", metric=metric,
+                       seconds=ex["seconds"], **labels)
     return True
 
 

@@ -43,6 +43,7 @@ import traceback
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from . import device as _device
 from . import flight as _flight
 from . import metrics as _metrics
 from .env_registry import env_float as _env_float
@@ -301,6 +302,9 @@ def _run() -> None:
     while not _stop_evt.wait(get_interval_seconds()):
         if not _metrics.enabled():
             continue
+        # piggyback the periodic device-memory sample on the watchdog
+        # tick (throttled + jax-guarded inside maybe_sample_device_memory)
+        _device.maybe_sample_device_memory()
         now = time.monotonic()
         with _lock:
             hearts = list(_hearts.values())

@@ -242,6 +242,12 @@ class TestContinuousBatching:
                                3: (200, {"i": 3})}, results
             # 3 requests, exactly 2 device dispatches: [1] then [2, 3]
             assert batch_sizes == [1, 2], batch_sizes
+            # the counter increments on the scoring thread AFTER replies
+            # are posted to the loop — give it the scheduler tick it
+            # needs under parallel-suite load
+            deadline = time.monotonic() + 5
+            while q.batches_served < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
             assert q.batches_served == 2
         finally:
             q.stop()

@@ -1,0 +1,427 @@
+"""Roofline + HBM ledgers and the serving latency decomposition.
+
+The introspection-plane contract: measured per-executable wall time
+pairs with ``cost_analysis()`` cost into %-of-peak (degrading to
+ratios-only on an unknown backend, never fabricating a percentage),
+named HBM claims reconcile against the sampled device-memory gauges,
+``/debug/roofline`` answers on BOTH serving engines, every fully-scored
+request decomposes into four stages that sum to its observed wall time,
+and all of it is byte-identical no-op behind the telemetry kill switch.
+"""
+
+import json
+import math
+import os
+import sys
+import time
+import http.client
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from mmlspark_tpu.io.aserve import AsyncServingQuery, AsyncServingServer
+from mmlspark_tpu.io.serving import (SERVING_STAGES, serve, stage_breakdown)
+from mmlspark_tpu.observability import device, federation, flight, hbm
+from mmlspark_tpu.observability import metrics, roofline, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    prev = metrics.set_enabled(True)
+    metrics.reset()
+    flight.clear()
+    roofline.reset()
+    hbm.reset()
+    tracing.clear_exemplars()
+    yield
+    metrics.set_enabled(prev)
+    metrics.reset()
+    flight.clear()
+    roofline.reset()
+    hbm.reset()
+    tracing.clear_exemplars()
+
+
+def _request(host, port, path, body=None, timeout=30):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    if isinstance(body, str):
+        body = body.encode()
+    conn.request("POST" if body is not None else "GET", path, body=body)
+    r = conn.getresponse()
+    payload = r.read()
+    conn.close()
+    return r.status, payload
+
+
+def _echo_transform(ds):
+    return ds.with_column("reply", [
+        {"entity": {"i": (v or {}).get("i")}, "statusCode": 200}
+        for v in ds["value"]])
+
+
+def _wait_for(cond, timeout=5.0):
+    """The stage/exemplar observation lands in the handler's ``finally``,
+    which can trail the client's read by a scheduler tick."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# Roofline ledger math
+# ---------------------------------------------------------------------------
+
+
+class TestRooflineLedger:
+    def test_pct_math_against_table_peaks(self):
+        roofline.note_device_kind("TPU v4")
+        # 275 TFLOP/s, 1.228 TB/s peaks; 1 ms call over 27.5 GFLOP is
+        # exactly 10% of compute peak
+        roofline.register_executable("k1", kind="predict",
+                                     flops=27.5e9, bytes_accessed=1.228e7,
+                                     compile_seconds=0.4, label="p")
+        roofline.observe_call("k1", 1e-3)
+        payload = roofline.snapshot_payload()
+        assert payload["peaks"]["source"] == "table:TPU v4"
+        (e,) = payload["executables"]
+        assert e["calls"] == 1 and e["ewma_seconds"] == pytest.approx(1e-3)
+        assert e["flops_pct"] == pytest.approx(10.0)
+        assert e["bytes_pct"] == pytest.approx(1.0)
+        assert e["bound"] == "compute"
+        assert e["achieved_flops_per_second"] == pytest.approx(27.5e12)
+        # the exported gauge families carry the same numbers
+        key = e["key_label"]
+        assert metrics.counter("roofline_calls_total", key=key).value == 1.0
+        assert metrics.gauge("roofline_flops_pct", key=key).value == \
+            pytest.approx(10.0)
+
+    def test_memory_bound_classification(self):
+        roofline.note_device_kind("TPU v4")
+        roofline.register_executable("k2", flops=1e9, bytes_accessed=1.228e9)
+        roofline.observe_call("k2", 1.0)
+        (e,) = roofline.snapshot_payload()["executables"]
+        assert e["bytes_pct"] > e["flops_pct"]
+        assert e["bound"] == "memory"
+
+    def test_ewma_update(self):
+        roofline.register_executable("k3")
+        roofline.observe_call("k3", 1.0)
+        roofline.observe_call("k3", 2.0)
+        (e,) = roofline.snapshot_payload()["executables"]
+        # alpha=0.2: 0.2*2 + 0.8*1
+        assert e["ewma_seconds"] == pytest.approx(1.2)
+        assert e["calls"] == 2
+
+    def test_unknown_backend_degrades_to_ratios_only(self):
+        roofline.note_device_kind("Colossus MK9")   # not in the table
+        roofline.register_executable("k4", flops=1e9, bytes_accessed=1e6)
+        roofline.observe_call("k4", 1e-3)
+        payload = roofline.snapshot_payload()
+        assert payload["peaks"] == {"flops_per_second": None,
+                                    "bytes_per_second": None,
+                                    "source": "unknown"}
+        (e,) = payload["executables"]
+        assert e["achieved_flops_per_second"] == pytest.approx(1e12)
+        assert e["flops_pct"] is None and e["bytes_pct"] is None
+        assert e["bound"] is None
+        # no pct gauges fabricated
+        assert "roofline_flops_pct" not in metrics.get_registry().snapshot()
+
+    def test_env_override_beats_table(self, monkeypatch):
+        roofline.note_device_kind("TPU v4")
+        monkeypatch.setenv("MMLSPARK_TPU_PEAK_FLOPS", "1e12")
+        peaks = roofline.resolve_peaks()
+        assert peaks["source"] == "env"
+        assert peaks["flops_per_second"] == pytest.approx(1e12)
+        assert peaks["bytes_per_second"] is None   # only FLOPS overridden
+        roofline.register_executable("k5", flops=1e9)
+        roofline.observe_call("k5", 1e-3)
+        (e,) = roofline.snapshot_payload()["executables"]
+        assert e["flops_pct"] == pytest.approx(100.0)
+
+    def test_observe_before_register_creates_minimal_entry(self):
+        roofline.observe_call("orphan", 0.5)
+        (e,) = roofline.snapshot_payload()["executables"]
+        assert e["kind"] == "unknown" and e["calls"] == 1
+        assert e["flops"] is None
+        # late cost arrival (compile event fires after first call)
+        roofline.register_executable("orphan", kind="predict", flops=2e9)
+        (e,) = roofline.snapshot_payload()["executables"]
+        assert e["kind"] == "predict"
+        assert e["achieved_flops_per_second"] == pytest.approx(4e9)
+
+    def test_ledger_is_bounded_lru(self):
+        for i in range(roofline._MAX_ENTRIES + 10):
+            roofline.register_executable(f"key-{i}")
+        payload = roofline.snapshot_payload()
+        assert len(payload["executables"]) == roofline._MAX_ENTRIES
+        keys = {e["key"] for e in payload["executables"]}
+        assert "key-0" not in keys and f"key-{roofline._MAX_ENTRIES+9}" in keys
+
+
+# ---------------------------------------------------------------------------
+# HBM ledger
+# ---------------------------------------------------------------------------
+
+
+class TestHbmLedger:
+    def test_claim_release_floor_and_gauge(self):
+        hbm.claim("slots", 1000)
+        hbm.claim("slots", 500)
+        hbm.claim("cache", 200)
+        assert hbm.claims() == {"slots": 1500.0, "cache": 200.0}
+        assert hbm.total() == 1700.0
+        hbm.release("slots", 9999)          # double-release floors at 0
+        assert hbm.claims()["slots"] == 0.0
+        hbm.set_claim("cache", 42)
+        assert metrics.gauge("hbm_ledger_bytes", site="cache").value == 42.0
+
+    def test_reconcile_without_observation(self):
+        hbm.claim("slots", 100)
+        out = hbm.reconcile()
+        assert out == {"claimed_bytes": 100.0,
+                       "observed_bytes_in_use": None, "drift_bytes": None}
+        # no observation -> no drift gauge fabricated
+        assert "hbm_ledger_drift_bytes" not in metrics.get_registry().snapshot()
+
+    def test_reconcile_against_sampled_device_memory(self):
+        hbm.claim("slots", 100)
+        # simulate a device.py sample landing in the registry
+        metrics.gauge("device_memory_bytes", device="0",
+                      stat="bytes_in_use").set(1000)
+        metrics.gauge("device_memory_bytes", device="0",
+                      stat="bytes_limit").set(4000)   # other stats ignored
+        out = hbm.reconcile()
+        assert out["observed_bytes_in_use"] == 1000.0
+        assert out["drift_bytes"] == 900.0
+        assert metrics.gauge("hbm_ledger_drift_bytes").value == 900.0
+
+    def test_periodic_sampler_is_interval_gated(self, monkeypatch):
+        monkeypatch.setattr(device, "_last_sample", 0.0)
+        monkeypatch.setenv("MMLSPARK_TPU_DEVICE_MEMORY_INTERVAL_SECONDS",
+                           "30")
+        if "jax" not in sys.modules:
+            assert device.maybe_sample_device_memory(now=1000.0) is False
+            return
+        assert device.maybe_sample_device_memory(now=1000.0) is True
+        assert device.maybe_sample_device_memory(now=1010.0) is False
+        assert device.maybe_sample_device_memory(now=1031.0) is True
+        monkeypatch.setenv("MMLSPARK_TPU_DEVICE_MEMORY_INTERVAL_SECONDS",
+                           "0")                       # 0 disables
+        assert device.maybe_sample_device_memory(now=9999.0) is False
+
+
+# ---------------------------------------------------------------------------
+# Kill switch: byte-identical no-op
+# ---------------------------------------------------------------------------
+
+
+class TestDisabledPath:
+    def test_mutators_are_noops_when_disabled(self):
+        metrics.set_enabled(False)
+        before = json.dumps(metrics.get_registry().snapshot(),
+                            sort_keys=True)
+        roofline.register_executable("k", flops=1e9)
+        roofline.observe_call("k", 0.1)
+        hbm.claim("s", 100)
+        hbm.release("s", 50)
+        hbm.set_claim("t", 10)
+        after = json.dumps(metrics.get_registry().snapshot(),
+                           sort_keys=True)
+        assert before == after
+        assert roofline.snapshot_payload()["executables"] == []
+        assert hbm.claims() == {}
+        assert device.maybe_sample_device_memory(now=1e9) is False
+
+    def test_snapshot_still_renders_while_disabled(self):
+        roofline.register_executable("k", flops=1e9)
+        metrics.set_enabled(False)
+        payload = roofline.snapshot_payload()   # truthful, not an error
+        assert [e["key"] for e in payload["executables"]] == ["k"]
+
+
+# ---------------------------------------------------------------------------
+# /debug/roofline + /debug/autoscale on both engines
+# ---------------------------------------------------------------------------
+
+
+def _threaded_query():
+    return (serve().address("localhost", 0, "roof")
+            .batch(8, 5).transform(_echo_transform).start())
+
+
+def _async_query():
+    server = AsyncServingServer("localhost", 0, "roof")
+    return AsyncServingQuery(server, transform=_echo_transform).start()
+
+
+@pytest.mark.parametrize("factory", [_threaded_query, _async_query],
+                         ids=["threaded", "async"])
+class TestDebugRoutes:
+    def test_roofline_round_trip(self, factory):
+        roofline.note_device_kind("TPU v4")
+        roofline.register_executable("deadbeef" * 8, kind="predict",
+                                     flops=1e9, bytes_accessed=1e6,
+                                     label="gbdt_predict")
+        roofline.observe_call("deadbeef" * 8, 1e-3)
+        hbm.claim("aserve_slots", 4096)
+        q = factory()
+        try:
+            status, body = _request(q.server.host, q.server.port,
+                                    "/debug/roofline")
+            assert status == 200
+            payload = json.loads(body)
+            assert payload["peaks"]["source"] == "table:TPU v4"
+            (e,) = payload["executables"]
+            assert e["label"] == "gbdt_predict" and e["calls"] == 1
+            assert e["flops_pct"] is not None
+            assert payload["hbm"]["sites"]["aserve_slots"] == 4096.0
+            # also under /{api_name}/...
+            status, body2 = _request(q.server.host, q.server.port,
+                                     "/roof/debug/roofline")
+            assert status == 200
+            assert json.loads(body2)["executables"] == \
+                payload["executables"]
+        finally:
+            q.stop()
+
+    def test_autoscale_answers_without_federation(self, factory):
+        q = factory()
+        try:
+            status, body = _request(q.server.host, q.server.port,
+                                    "/debug/autoscale")
+            assert status == 200
+            payload = json.loads(body)
+            assert payload["federation"] is None
+            assert "gateway" in payload["note"]
+        finally:
+            q.stop()
+
+
+class TestAutoscaleHint:
+    def test_hint_from_injected_worker_scrapes(self):
+        fed = federation.MetricsFederator(targets=lambda: [], interval=60)
+        now = time.time()
+        for label, depth, (wsum, wcount) in (
+                ("a:1", 3.0, (1.0, 4.0)), ("b:2", 1.0, (0.0, 0.0))):
+            st = fed._worker(label)
+            st.last_success = now
+            st.families = {
+                "serving_queue_depth": ("gauge", [({}, depth)]),
+                "serving_queue_wait_seconds": ("histogram", [
+                    ({}, {"sum": wsum, "count": wcount, "buckets": {}})]),
+            }
+        out = fed.autoscale_hint()
+        assert out["live_workers"] == 2
+        assert out["total_queue_depth"] == 4.0
+        assert out["hint"] == pytest.approx(2.0)
+        assert out["workers"]["a:1"]["queue_wait_mean_seconds"] == \
+            pytest.approx(0.25)
+        assert out["workers"]["b:2"]["queue_wait_mean_seconds"] is None
+        assert metrics.gauge("cluster_autoscale_hint").value == \
+            pytest.approx(2.0)
+
+    def test_hint_zero_with_no_live_workers(self):
+        fed = federation.MetricsFederator(targets=lambda: [], interval=60)
+        out = fed.autoscale_hint()
+        assert out["hint"] == 0.0 and out["live_workers"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Per-request latency decomposition
+# ---------------------------------------------------------------------------
+
+
+class TestStageBreakdown:
+    def test_partition_is_exact(self):
+        stages = stage_breakdown(1.0, 1.1, 1.3, 1.9, 2.0)
+        assert set(stages) == set(SERVING_STAGES)
+        assert sum(stages.values()) == pytest.approx(1.0)
+        assert stages == {"admission": pytest.approx(0.1),
+                          "forming_wait": pytest.approx(0.2),
+                          "score": pytest.approx(0.6),
+                          "write": pytest.approx(0.1)}
+
+    def test_partial_timeline_never_decomposes(self):
+        # a shed/timed-out request leaves dispatched/scored at 0.0
+        assert stage_breakdown(1.0, 1.1, 0.0, 0.0, 2.0) is None
+        assert stage_breakdown(1.0, 1.1, 1.3, 0.0, 2.0) is None
+
+    def test_clock_skew_floors_at_zero(self):
+        stages = stage_breakdown(1.0, 0.9, 1.0, 1.5, 1.4)
+        assert stages["admission"] == 0.0 and stages["write"] == 0.0
+
+
+@pytest.mark.parametrize("factory", [_threaded_query, _async_query],
+                         ids=["threaded", "async"])
+class TestStageDecomposition:
+    def test_stages_sum_to_request_wall_time(self, factory):
+        q = factory()
+        try:
+            for i in range(6):
+                status, body = _request(q.server.host, q.server.port,
+                                        "/", json.dumps({"i": i}))
+                assert status == 200 and json.loads(body) == {"i": i}
+        finally:
+            q.stop()
+        def by_stage():
+            fam = (metrics.get_registry().snapshot()
+                   .get("serving_stage_seconds") or {})
+            return {s["labels"]["stage"]: s
+                    for s in fam.get("series") or []}
+        assert _wait_for(lambda: {k: v["count"]
+                                  for k, v in by_stage().items()}
+                         == {s: 6 for s in SERVING_STAGES}), by_stage()
+        by_stage = by_stage()
+        stage_sum = sum(v["sum"] for v in by_stage.values())
+        wall = metrics.histogram("serving_request_seconds",
+                                 api="roof").sum
+        assert metrics.histogram("serving_request_seconds",
+                                 api="roof").count == 6
+        # the acceptance bound: stages partition the request wall time
+        assert math.isclose(stage_sum, wall, rel_tol=0.10), \
+            f"stage sum {stage_sum} vs wall {wall}"
+
+    def test_slow_exemplars_carry_stage_breakdown(self, factory):
+        prev = tracing.set_slow_threshold(0.0)   # every request is "slow"
+        try:
+            q = factory()
+            try:
+                status, _ = _request(q.server.host, q.server.port,
+                                     "/", json.dumps({"i": 1}))
+                assert status == 200
+            finally:
+                q.stop()
+        finally:
+            tracing.set_slow_threshold(prev)
+        assert _wait_for(lambda: any(
+            e["metric"] == "serving_request_seconds"
+            for e in tracing.get_exemplars()))
+        exs = [e for e in tracing.get_exemplars()
+               if e["metric"] == "serving_request_seconds"]
+        assert exs, "no slow-request exemplar recorded"
+        stages = exs[-1].get("stages")
+        assert stages and set(stages) == set(SERVING_STAGES)
+        assert sum(stages.values()) <= exs[-1]["seconds"] * 1.10
+        assert any(e["kind"] == "slow_request" and "stages" in e
+                   for e in flight.events())
+
+    def test_disabled_records_no_stage_metrics(self, factory):
+        metrics.set_enabled(False)
+        try:
+            q = factory()
+            try:
+                status, body = _request(q.server.host, q.server.port,
+                                        "/", json.dumps({"i": 7}))
+                assert status == 200 and json.loads(body) == {"i": 7}
+            finally:
+                q.stop()
+        finally:
+            metrics.set_enabled(True)
+        assert "serving_stage_seconds" not in metrics.get_registry().snapshot()

@@ -166,6 +166,34 @@ def compare(prev: Dict, cur: Dict, threshold: float) -> List[str]:
     return out
 
 
+def roofline_lines(prev_rounds: List[Dict], cur: Dict) -> List[str]:
+    """Report-only ``*_roofline_pct`` trend lines (measured %-of-peak
+    from bench.py's roofline epilogue). NEVER part of the gate: percent
+    of hardware peak is a diagnosis axis, not a throughput contract —
+    the keys deliberately fail ``_RATE_RE`` so they cannot leak into
+    ``compare()``/``baseline()`` even by accident."""
+    keys = sorted(k for k in cur
+                  if k.endswith("_roofline_pct") and not _RATE_RE.match(k))
+    out = []
+    for key in keys:
+        try:
+            new = float(cur[key])
+        except (TypeError, ValueError):
+            continue
+        olds = []
+        for r in prev_rounds:
+            try:
+                olds.append(float(r[key]))
+            except (KeyError, TypeError, ValueError):
+                continue
+        if olds:
+            old = _low_median(olds)
+            out.append(f"{key}: {old:g}% -> {new:g}% (report-only)")
+        else:
+            out.append(f"{key}: {new:g}% (report-only, no baseline)")
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="bench_regression")
     p.add_argument("directory", nargs="?",
@@ -213,15 +241,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     label = f"median({','.join(prev_names)})" if len(prev_names) > 1 \
         else prev_names[0]
     regressions = compare(prev, cur, args.threshold)
+    trends = roofline_lines(prev_lines, cur)
     if regressions:
         print(f"bench_regression: r{n_cur:02d} regressed vs {label}:")
         for line in regressions:
+            print(f"  {line}")
+        for line in trends:
             print(f"  {line}")
         return 1
     keys = _comparable_keys(prev, cur)
     print(f"bench_regression: r{n_cur:02d} vs {label} OK "
           f"({len(keys)} shared throughput keys within "
           f"{args.threshold * 100:.0f}%)")
+    for line in trends:
+        print(f"  {line}")
     return 0
 
 

@@ -12,6 +12,7 @@
 #   CI_SKIP_ASYNC=1 tools/ci_check.sh      # skip the async-serving smoke
 #   CI_SKIP_MULTICHIP=1 tools/ci_check.sh  # skip the 8-device dry run
 #   CI_SKIP_BUNDLE=1 tools/ci_check.sh     # skip the AOT-bundle smoke
+#   CI_SKIP_ROOFLINE=1 tools/ci_check.sh   # skip the introspection smoke
 set -u -o pipefail
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -264,6 +265,103 @@ EOF
     fi
 fi
 
+# introspection smoke lane: boot a live serving_main worker, score one
+# request, and assert the performance-introspection plane closed the loop
+# — /debug/roofline names the fused predict executable with at least one
+# observed call (plus explicit peaks provenance: a table/env match on
+# TPU, "unknown" off-TPU), and the per-request stage histograms
+# (admission/forming_wait/score/write) are non-empty on /metrics.
+if [ "${CI_SKIP_ROOFLINE:-0}" != "1" ]; then
+    if (cd "$ROOT" && env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+            python - <<'EOF'
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+from mmlspark_tpu.models.gbdt.booster import train_booster
+from mmlspark_tpu.models.gbdt.growth import GrowConfig
+
+env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+with tempfile.TemporaryDirectory() as d:
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 6)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    booster = train_booster(X=X, y=y, num_iterations=3, objective="binary",
+                            cfg=GrowConfig(num_leaves=7, min_data_in_leaf=5))
+    model = os.path.join(d, "model.txt")
+    with open(model, "w") as f:
+        f.write(booster.model_string())
+
+    p = subprocess.Popen(
+        [sys.executable, "-m", "mmlspark_tpu.io.serving_main", "worker",
+         "--model", model, "--registry", os.path.join(d, "reg"),
+         "--host", "localhost", "--port", "0", "--max-batch", "8"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    try:
+        line = p.stdout.readline()
+        m = re.search(r"serving on \S+:(\d+)", line)
+        assert m, f"no ready-line: {line!r}"
+        port = int(m.group(1))
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                with urllib.request.urlopen(
+                        f"http://localhost:{port}/healthz", timeout=5) as r:
+                    hz = json.loads(r.read())
+                if hz.get("ready"):
+                    break
+            except OSError:
+                pass
+            assert time.monotonic() < deadline, "worker never became ready"
+            time.sleep(0.05)
+        body = json.dumps({"features": [0.1] * 6}).encode()
+        with urllib.request.urlopen(urllib.request.Request(
+                f"http://localhost:{port}/serving", data=body,
+                method="POST"), timeout=30) as r:
+            reply = json.loads(r.read())
+            assert r.status == 200 and "prediction" in reply, reply
+        with urllib.request.urlopen(
+                f"http://localhost:{port}/debug/roofline", timeout=5) as r:
+            roof = json.loads(r.read())
+        src = (roof.get("peaks") or {}).get("source")
+        assert src, f"no peaks provenance: {roof.get('peaks')}"
+        called = [e for e in roof.get("executables", [])
+                  if e.get("kind") == "predict" and (e.get("calls") or 0) >= 1]
+        assert called, f"no called predict executable: {roof}"
+        with urllib.request.urlopen(
+                f"http://localhost:{port}/metrics", timeout=5) as r:
+            metrics_text = r.read().decode()
+        assert 'serving_stage_seconds' in metrics_text, \
+            "stage histograms missing from /metrics"
+        stages = set(re.findall(
+            r'serving_stage_seconds_count\{[^}]*stage="([a-z_]+)"',
+            metrics_text))
+        assert {"admission", "forming_wait", "score",
+                "write"} <= stages, f"incomplete stage set: {stages}"
+    finally:
+        p.send_signal(signal.SIGTERM)
+        p.wait(timeout=30)
+print(f"roofline smoke: predict executable observed "
+      f"(peaks={src}, flops={'yes' if called[0].get('flops') else 'no'}), "
+      f"stage histograms complete")
+EOF
+    ); then
+        :
+    else
+        echo "ci_check: roofline smoke FAILED" >&2
+        rc=1
+    fi
+fi
+
 # dryrun_multichip lane: the cross-device-count tree-identity suite on a
 # virtual 8-device CPU mesh (xla_force_host_platform_device_count) — the
 # full histogram-engine matrix, including the tiers tier-1 deselects as
@@ -282,7 +380,7 @@ if [ "${CI_SKIP_MULTICHIP:-0}" != "1" ]; then
 fi
 
 if [ "$rc" -ne 0 ]; then
-    echo "ci_check: FAILED (graftlint findings, env-docs drift, chaos/async smoke, or multichip dry run)" >&2
+    echo "ci_check: FAILED (graftlint findings, env-docs drift, chaos/async/bundle/roofline smoke, or multichip dry run)" >&2
 else
     echo "ci_check: clean"
 fi

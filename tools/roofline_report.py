@@ -1,0 +1,172 @@
+#!/usr/bin/env python3
+"""Render the measured roofline ledger and serving stage-time table.
+
+Two callers share this module:
+
+- ``bench.py`` imports it (by file path) for the per-leg epilogue: after
+  the throughput line it prints the hot executables as %-of-roofline and
+  the serving leg as a stage-time table, and dumps the same payload as
+  JSON beside the metrics snapshot.
+- Operators run it standalone on a dumped snapshot::
+
+      python tools/roofline_report.py bench_metrics.cpu.json
+
+  accepting either the bench metrics-snapshot shape (``{"metrics": ...,
+  "roofline": ...}``) or a raw ``/debug/roofline`` body.
+
+Rendering is report-only everywhere — nothing here gates a bench or a
+regression verdict (that stays with ``tools/bench_regression.py``, which
+prints ``*_roofline_pct`` keys as trend lines only).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _fmt_rate(v: Optional[float], unit: str) -> str:
+    if v is None:
+        return "-"
+    for scale, prefix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if v >= scale:
+            return f"{v / scale:.2f} {prefix}{unit}"
+    return f"{v:.2f} {unit}"
+
+
+def _fmt_pct(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v:.2f}%"
+
+
+def _fmt_bytes(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    for scale, prefix in ((1 << 30, "GiB"), (1 << 20, "MiB"),
+                          (1 << 10, "KiB")):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f} {prefix}"
+    return f"{v:.0f} B"
+
+
+def _table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(header)]
+    def line(cells: List[str]) -> str:
+        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+    out = [line(header), line(["-" * w for w in widths])]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
+def render_roofline(payload: Dict[str, Any]) -> str:
+    """The ledger's executables, hottest (most-called) first, as a
+    %-of-peak table. Off-TPU the peaks resolve ``unknown`` and the table
+    degrades to achieved rates only — never a fabricated percentage."""
+    peaks = payload.get("peaks") or {}
+    lines = [f"roofline ledger (device_kind={payload.get('device_kind')}, "
+             f"peaks={peaks.get('source', 'unknown')})"]
+    exes = sorted(payload.get("executables") or [],
+                  key=lambda e: -(e.get("calls") or 0))
+    if not exes:
+        lines.append("  (no executables observed)")
+        return "\n".join(lines)
+    rows = []
+    for e in exes:
+        ewma = e.get("ewma_seconds")
+        rows.append([
+            str(e.get("label") or e.get("kind") or "?"),
+            str(e.get("key_label") or ""),
+            str(e.get("calls") or 0),
+            "-" if ewma is None else f"{ewma * 1e3:.3f} ms",
+            _fmt_rate(e.get("achieved_flops_per_second"), "FLOP/s"),
+            _fmt_pct(e.get("flops_pct")),
+            _fmt_rate(e.get("achieved_bytes_per_second"), "B/s"),
+            _fmt_pct(e.get("bytes_pct")),
+            str(e.get("bound") or "-"),
+        ])
+    lines.append(_table(rows, ["executable", "key", "calls", "ewma",
+                               "flops", "%peak", "bytes", "%peak",
+                               "bound"]))
+    hbm = payload.get("hbm") or {}
+    sites = hbm.get("sites") or {}
+    if sites:
+        lines.append("hbm ledger "
+                     f"(claimed={_fmt_bytes(hbm.get('claimed_bytes'))}, "
+                     f"observed={_fmt_bytes(hbm.get('observed_bytes_in_use'))}, "
+                     f"drift={_fmt_bytes(hbm.get('drift_bytes'))})")
+        lines.append(_table(
+            [[s, _fmt_bytes(b)] for s, b in sorted(sites.items())],
+            ["site", "bytes"]))
+    return "\n".join(lines)
+
+
+def stage_rows(snapshot: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Flatten ``serving_stage_seconds`` histogram series out of a
+    metrics-registry snapshot into per-(api, stage) mean/total rows."""
+    fam = (snapshot or {}).get("serving_stage_seconds") or {}
+    rows = []
+    for s in fam.get("series") or []:
+        labels = s.get("labels") or {}
+        count, total = s.get("count") or 0, s.get("sum") or 0.0
+        if count:
+            rows.append({"api": labels.get("api", ""),
+                         "stage": labels.get("stage", ""),
+                         "count": count, "sum_seconds": total,
+                         "mean_seconds": total / count})
+    return rows
+
+
+def render_stages(snapshot: Dict[str, Any]) -> str:
+    """The serving leg as a stage-time table: where a request's wall time
+    went (admission / forming_wait / score / write), per api."""
+    rows = stage_rows(snapshot)
+    if not rows:
+        return "serving stages: (no decomposed requests observed)"
+    per_api: Dict[str, float] = {}
+    for r in rows:
+        per_api[r["api"]] = per_api.get(r["api"], 0.0) + r["sum_seconds"]
+    order = {"admission": 0, "forming_wait": 1, "score": 2, "write": 3}
+    rows.sort(key=lambda r: (r["api"], order.get(r["stage"], 9)))
+    body = [[r["api"], r["stage"], str(r["count"]),
+             f"{r['mean_seconds'] * 1e3:.3f} ms",
+             f"{r['sum_seconds']:.3f} s",
+             f"{100.0 * r['sum_seconds'] / per_api[r['api']]:.1f}%"
+             if per_api[r["api"]] else "-"]
+            for r in rows]
+    return "serving stage decomposition\n" + _table(
+        body, ["api", "stage", "count", "mean", "total", "share"])
+
+
+def render_text(roofline: Optional[Dict[str, Any]],
+                metrics: Optional[Dict[str, Any]]) -> str:
+    parts = []
+    if roofline is not None:
+        parts.append(render_roofline(roofline))
+    if metrics is not None:
+        parts.append(render_stages(metrics))
+    return "\n\n".join(parts) if parts else "(nothing to report)"
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) != 2 or argv[1] in ("-h", "--help"):
+        print(__doc__ or "", file=sys.stderr)
+        print(f"usage: {argv[0]} <snapshot.json>", file=sys.stderr)
+        return 2
+    with open(argv[1]) as f:
+        doc = json.load(f)
+    # bench metrics-snapshot shape vs raw /debug/roofline body
+    if "executables" in doc or "peaks" in doc:
+        roofline, metrics = doc, None
+    else:
+        roofline = doc.get("roofline")
+        metrics = doc.get("metrics")
+    try:
+        print(render_text(roofline, metrics))
+    except BrokenPipeError:                 # | head closed the pipe
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))
