@@ -582,6 +582,28 @@ def _run_leg(on_tpu: bool) -> None:
 
     predict_rows_per_sec, pred = _guard(_predict_rate, (-1.0, None))
 
+    def _predict_rate_lane(pdt):
+        # quantized predict lane (int8 bin-id routing + quantized leaves,
+        # resolved through quantize.resolve_predict_dtype): same shape and
+        # warm-compile best-of-2 protocol as _predict_rate, so the ratio
+        # key below is apples-to-apples. On CPU fallback the ratio mostly
+        # reflects the cheaper host-side staging (uint8 quantize vs f32
+        # copy) — the MXU int8 2x-rate story needs the TPU leg.
+        booster.predict(X[:n_score], predict_dtype=pdt)    # compile
+        sdt = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            booster.predict(X[:n_score], predict_dtype=pdt)
+            sdt = min(sdt, time.perf_counter() - t0)
+        return round(n_score / sdt, 1)
+
+    predict_int8_rows_per_sec = _guard(
+        lambda: _predict_rate_lane("int8"), -1.0)
+    quantized_predict_vs_f32_x = round(
+        predict_int8_rows_per_sec / predict_rows_per_sec, 2) \
+        if predict_int8_rows_per_sec > 0 and predict_rows_per_sec > 0 \
+        else -1.0
+
     def _predict_streamed_rate():
         # streamed scoring with the double-buffered prefetch ON
         # (io/prefetch.py reads chunk i+1 while the device scores chunk
@@ -625,6 +647,8 @@ def _run_leg(on_tpu: bool) -> None:
         "ingest_sec": round(ingest_s, 3),
         "end_to_end_trees_per_sec": round(bench_iters / (dt + ingest_s), 3),
         "gbdt_predict_rows_per_sec": predict_rows_per_sec,
+        "gbdt_predict_rows_per_sec_int8": predict_int8_rows_per_sec,
+        "quantized_predict_vs_f32_x": quantized_predict_vs_f32_x,
         "gbdt_predict_streamed_rows_per_sec": predict_streamed_rows_per_sec,
         "leafwise_trees_per_sec": leafwise_tps,
         "leafwise_best_trees_per_sec": leafwise_best_tps,
@@ -870,6 +894,16 @@ def _serving_latency() -> dict:
         out["serving_model_in_loop_p50_ms"] = round(m["p50_ms"], 3)
         out["serving_model_in_loop_p99_ms"] = round(m["p99_ms"], 3)
         out["serving_model_in_loop_rps"] = round(m["concurrent_rps"], 1)
+    # int8 admission on the async rows path: requests quantize into uint8
+    # slots and score through the int8 predictor lane — the end-to-end
+    # quantized serving number (serving_main's booster configuration)
+    from tests.test_serving_latency import serving_async_model_latency_stats
+    qi = _guard(lambda: serving_async_model_latency_stats(
+        predict_dtype="int8"), None)
+    if qi and qi.get("predict_dtype") == "int8":
+        out["serving_concurrent_rps_async_int8"] = round(
+            qi["concurrent_rps"], 1)
+        out["serving_p50_ms_async_int8"] = round(qi["p50_ms"], 3)
     return out
 
 

@@ -24,7 +24,9 @@ def main(argv=None) -> int:
 
     b = sub.add_parser("build", help="AOT-build a serving bundle")
     b.add_argument("--model", required=True,
-                   help="saved pipeline dir or LightGBM .txt model")
+                   help="saved pipeline dir, LightGBM .txt model, or "
+                        "native .npz booster (.npz keeps the binner "
+                        "grid the int8 lane needs)")
     b.add_argument("--out", required=True, help="bundle directory to write")
     b.add_argument("--batch-sizes", default=None,
                    help="comma-separated batch sizes (default: the pow2 "
@@ -39,6 +41,11 @@ def main(argv=None) -> int:
     b.add_argument("--include-raw", action="store_true",
                    help="also bundle the untransformed predict_raw "
                         "executables")
+    b.add_argument("--predict-dtypes", default="f32",
+                   help="comma-separated predict lanes to bundle "
+                        "(f32,bf16,int8; default f32) — match the "
+                        "fleet's MMLSPARK_TPU_PREDICT_DTYPE so the "
+                        "quantized executables warm-start too")
     b.add_argument("--force", action="store_true",
                    help="replace an existing bundle directory")
 
@@ -62,10 +69,13 @@ def main(argv=None) -> int:
         batch_sizes = [int(x) for x in args.batch_sizes.split(",") if x]
     num_iterations = tuple(
         int(x) for x in args.num_iterations.split(",") if x)
+    predict_dtypes = tuple(
+        x.strip() for x in args.predict_dtypes.split(",") if x.strip())
     manifest = build_bundle(
         args.model, args.out, batch_sizes=batch_sizes,
         max_batch=args.max_batch, num_iterations=num_iterations,
-        include_raw=args.include_raw, force=args.force)
+        include_raw=args.include_raw, predict_dtypes=predict_dtypes,
+        force=args.force)
     console(f"bundle written: {args.out} "
             f"({len(manifest['entries'])} programs, "
             f"fingerprint {manifest['fingerprint']['backend']}/"

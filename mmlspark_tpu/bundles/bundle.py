@@ -165,6 +165,11 @@ def load_model_boosters(model_path: str) -> List[Any]:
     if model_path.endswith(".txt"):
         with open(model_path) as f:
             return [Booster.from_string(f.read())]
+    if model_path.endswith(".npz"):
+        # native persistence keeps the full binner grid (a LightGBM
+        # .txt roundtrip loses it), so this is the format that can
+        # bundle the int8 predict lane without degrading to f32
+        return [Booster.load(model_path)]
     from ..core.pipeline import load_stage
     return boosters_of(load_stage(model_path))
 
@@ -192,10 +197,18 @@ def build_bundle(model_path: str, out_dir: str,
                  max_batch: int = 32,
                  num_iterations: Tuple[int, ...] = (-1,),
                  include_raw: bool = False,
+                 predict_dtypes: Tuple[str, ...] = ("f32",),
                  force: bool = False) -> Dict[str, Any]:
     """AOT-lower and serialize every fused predict executable a serving
     deployment of ``model_path`` will dispatch to; write an atomic,
     versioned, checksummed bundle directory. Returns the manifest.
+
+    ``predict_dtypes`` adds quantized predict lanes to the enumeration
+    (``"bf16"``/``"int8"`` next to ``"f32"``): the lane rides the SAME
+    plan/key machinery, so a fleet pinned to
+    ``MMLSPARK_TPU_PREDICT_DTYPE=int8`` warm-starts its quantized
+    executables exactly like f32 ones. Lanes the model degrades
+    (``quantize.resolve_predict_dtype``) dedupe into their f32 plans.
 
     The bundle is built in a sibling temp directory and renamed into
     place, so a crashed build never leaves a half-written bundle where
@@ -252,7 +265,8 @@ def build_bundle(model_path: str, out_dir: str,
         # one executable — exporting twice would overwrite the same
         # {key_hash}.jaxexp file and waste a duplicate AOT compile
         for meta, plan in iter_predict_plans(booster, batch_sizes,
-                                             num_iterations, transforms):
+                                             num_iterations, transforms,
+                                             dtypes=tuple(predict_dtypes)):
             if plan.key in seen_keys:
                 continue
             seen_keys.add(plan.key)
@@ -455,6 +469,9 @@ def _load_entry(bundle_dir: str, entry: Dict[str, Any],
         batch_size = int(entry["batch_size"])
         num_iteration = int(entry["num_iteration"])
         transformed = bool(entry["transformed"])
+        # pre-dtype bundles carry no lane field: f32, the only lane
+        # their builds could enumerate
+        predict_dtype = str(entry.get("predict_dtype", "f32"))
         entry["file"], entry["sha256"]
     except (KeyError, TypeError, ValueError) as e:
         # a structurally bad entry (hand-edited bundle, torn build)
@@ -463,8 +480,14 @@ def _load_entry(bundle_dir: str, entry: Dict[str, Any],
     if not 0 <= bi < len(boosters):
         return skip("booster_index_out_of_range", booster_index=bi)
     booster = boosters[bi]
-    plan = booster.predict_plan(batch_size, num_iteration,
-                                transformed=transformed)
+    try:
+        plan = booster.predict_plan(batch_size, num_iteration,
+                                    transformed=transformed,
+                                    predict_dtype=predict_dtype)
+    except ValueError as e:
+        # an unknown lane name in a (newer-format) manifest degrades
+        # like any other per-entry defect
+        return skip("malformed_entry", error=f"{type(e).__name__}: {e}")
     key_hash = predict_key_hash(plan.key)
     if key_hash != entry.get("key_hash"):
         # the live model computes a different key than the build did —
@@ -495,7 +518,8 @@ def _load_entry(bundle_dir: str, entry: Dict[str, Any],
         compiled = jax.jit(exported.call).lower(*args).compile()
     except Exception as e:  # noqa: BLE001 — any skew degrades to JIT
         return skip("deserialize_failed", error=f"{type(e).__name__}: {e}")
-    if not preload_predict_program(plan.key, compiled):
+    if not preload_predict_program(plan.key, compiled,
+                                   dtype=plan.predict_dtype):
         return skip("already_cached")
     # HBM-ledger claim: the deserialized program's device footprint is
     # opaque pre-execution, so the ledger carries the artifact size — a
@@ -503,6 +527,6 @@ def _load_entry(bundle_dir: str, entry: Dict[str, Any],
     _hbm.claim("bundle_prewarm", float(len(blob)))
     _metrics.safe_counter("bundle_entries_loaded_total").inc()
     _flight.record("bundle", event="entry_loaded", key_hash=key_hash,
-                   batch_size=batch_size,
-                   n_pad=plan.n_pad, t_pad=plan.T_pad)
+                   batch_size=batch_size, n_pad=plan.n_pad,
+                   t_pad=plan.T_pad, predict_dtype=plan.predict_dtype)
     return True

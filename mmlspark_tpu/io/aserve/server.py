@@ -40,6 +40,8 @@ import uuid
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+import numpy as np
+
 from ...core.dataset import Dataset
 from ...observability import flight as _flight
 from ...observability import metrics as _metrics
@@ -60,14 +62,20 @@ logger = get_logger("mmlspark_tpu.io.aserve")
 class RowSpec:
     """Zero-copy admission config: how a request's JSON becomes one row
     of the slot table. ``extract`` is a key into the parsed body (or a
-    callable over it) yielding a length-``width`` feature sequence."""
+    callable over it) yielding a length-``width`` feature sequence.
+    ``dtype`` is the predict lane's STAGING dtype and ``quantizer`` its
+    admission transform (``quantize.row_quantizer``; None = plain
+    cast) — a quantized lane decodes requests straight into narrow
+    staged rows, so the per-dispatch h2d ships int8/bf16 bytes."""
 
-    __slots__ = ("width", "extract", "dtype")
+    __slots__ = ("width", "extract", "dtype", "quantizer")
 
-    def __init__(self, width: int, extract="features", dtype="float32"):
+    def __init__(self, width: int, extract="features", dtype="float32",
+                 quantizer=None):
         self.width = int(width)
         self.extract = extract
         self.dtype = dtype
+        self.quantizer = quantizer
 
     def features(self, value: Any):
         if callable(self.extract):
@@ -124,7 +132,8 @@ class AsyncServingServer:
         self.slot_table: Optional[SlotTable] = None
         if row_spec is not None:
             self.slot_table = SlotTable(self.slots, row_spec.width,
-                                        row_spec.dtype)
+                                        row_spec.dtype,
+                                        quantizer=row_spec.quantizer)
         self.host = host
         self.port = port
         self._lock = threading.Lock()
@@ -632,7 +641,8 @@ class AsyncServingQuery:
             _flight.record("placement", site="aserve.slots",
                            decision="staging",
                            slots=self.server.slots,
-                           width=self.server.row_spec.width)
+                           width=self.server.row_spec.width,
+                           dtype=str(np.dtype(self.server.row_spec.dtype)))
         self._thread.start()
         return self
 

@@ -50,14 +50,26 @@ def resolve_slots(max_batch: int) -> int:
 
 
 class SlotTable:
-    """Ping-pong pow2 staging for one serving query's feature rows."""
+    """Ping-pong pow2 staging for one serving query's feature rows.
 
-    def __init__(self, slots: int, width: int, dtype=np.float32):
+    ``dtype`` is the LANE's staging dtype (``quantize.staging_dtype``):
+    a narrow predict lane allocates narrow buffers, so the
+    ``aserve_slots`` HBM claim — and the one h2d per dispatch — shrinks
+    4x (int8) / 2x (bf16) with no further code. ``quantizer`` is the
+    admission transform from ``quantize.row_quantizer`` (None = plain
+    cast): raw float rows MUST pass through it on a narrow table, since
+    a bare cast of floats to bin-id ``uint8`` would truncate values
+    instead of binning them.
+    """
+
+    def __init__(self, slots: int, width: int, dtype=np.float32,
+                 quantizer=None):
         if slots < 1 or width < 1:
             raise ValueError(f"slot table needs slots>=1 and width>=1, "
                              f"got {slots}x{width}")
         self.slots = _pow2_ceil(slots)
         self.width = int(width)
+        self.quantizer = quantizer
         self._bufs = (np.zeros((self.slots, self.width), dtype),
                       np.zeros((self.slots, self.width), dtype))
         self._active = 0
@@ -82,6 +94,8 @@ class SlotTable:
         """Decode one request's features into ``forming[slot]`` — THE
         admission-time copy (list/JSON -> pinned row), after which the
         row is never touched again until the device upload."""
+        if self.quantizer is not None:
+            row = self.quantizer(row)
         row = np.asarray(row, dtype=self._bufs[0].dtype)
         if row.shape != (self.width,):
             raise ValueError(f"feature row has shape {row.shape}, "

@@ -155,6 +155,20 @@ def is_ready() -> bool:
     return _ready
 
 
+# the worker's RESOLVED predict lane ("f32"/"bf16"/"int8"), surfaced on
+# /varz so operators can confirm which lane a fleet actually runs (env
+# typos and capability degrades resolve to f32 silently otherwise —
+# only a flight event records the degrade). None until a worker pins it.
+_predict_dtype: Optional[str] = None
+
+
+def set_predict_dtype(dtype: Optional[str]) -> None:
+    """Record the worker's resolved predict lane for ``/varz``
+    (serving_main pins it once at startup, after resolution)."""
+    global _predict_dtype
+    _predict_dtype = dtype
+
+
 _device_probe: Optional[Dict[str, Any]] = None
 
 
@@ -217,6 +231,7 @@ def varz_payload(api_name: str, federation: Optional[Any] = None
         "config": {
             "api_name": api_name,
             "pid": os.getpid(),
+            "predict_dtype": _predict_dtype,
             "slow_request_seconds": _tracing.get_slow_threshold(),
             "flight_capacity": _flight.capacity(),
             "max_trace_events": _spans.get_max_trace_events(),

@@ -24,6 +24,13 @@ import threading
 import uuid
 
 
+def _load_booster(model_path: str, booster_cls):
+    if model_path.endswith(".npz"):
+        return booster_cls.load(model_path)
+    with open(model_path) as f:
+        return booster_cls.from_string(f.read())
+
+
 def _load_transform(model_path: str, input_col: str, output_col: str,
                     max_batch: int = 64):
     """``(transform, model)`` — the model object rides along so the
@@ -35,14 +42,23 @@ def _load_transform(model_path: str, input_col: str, output_col: str,
     from .http import to_jsonable
     from .serving import make_reply
 
-    if model_path.endswith(".txt"):       # LightGBM native model string
+    # LightGBM native model string, or this repo's .npz persistence —
+    # .npz keeps the binner grid a .txt roundtrip loses, so it is the
+    # format the int8 lane serves without degrading to f32
+    if model_path.endswith((".txt", ".npz")):
         from ..models.gbdt.booster import Booster
-        with open(model_path) as f:
-            booster = Booster.from_string(f.read())
+        from .serving import set_predict_dtype
+        booster = _load_booster(model_path, Booster)
+        # pin the predict lane ONCE at startup (env + capability degrades
+        # resolve here, not per request) and surface it on /varz —
+        # threaded/async engines pin identically, so a bundle built for
+        # the lane serves either engine warm
+        pdt = booster.resolved_predict_dtype()
+        set_predict_dtype(pdt)
 
         def transform(ds):
             rows = np.asarray([v[input_col] for v in ds["value"]], np.float32)
-            preds = booster.predict(rows)
+            preds = booster.predict(rows, predict_dtype=pdt)
             return ds.with_column("reply", [
                 make_reply({output_col: to_jsonable(p)}) for p in preds])
 
@@ -71,20 +87,33 @@ def _build_async_query(args):
     from .aserve.server import RowSpec
     from .http import to_jsonable
 
-    if args.model.endswith(".txt"):
+    if args.model.endswith((".txt", ".npz")):
+        from ..models.gbdt import quantize as _quantize
         from ..models.gbdt.booster import Booster
-        with open(args.model) as f:
-            booster = Booster.from_string(f.read())
+        from .serving import set_predict_dtype
+        booster = _load_booster(args.model, Booster)
         width = int(booster.binner_state.get("num_features") or 0)
         if width > 0:
+            # the quantized admission path: resolve the lane once, decode
+            # request rows straight into narrow staged slots (the slot
+            # table's quantizer), and score with the matching predictor
+            # lane — the staged dtype passes through _predict_device
+            # untouched, so the one h2d per dispatch ships narrow bytes
+            pdt = booster.resolved_predict_dtype()
+            set_predict_dtype(pdt)
+            quantizer = _quantize.row_quantizer(
+                pdt, _quantize.feature_bounds(booster.binner_state)
+                if pdt == "int8" else None)
             server = AsyncServingServer(
                 args.host, args.port, args.api_name,
                 max_queue_depth=args.max_queue_depth,
                 slots=args.max_batch,
-                row_spec=RowSpec(width, extract=args.input_col))
+                row_spec=RowSpec(width, extract=args.input_col,
+                                 dtype=_quantize.staging_dtype(pdt),
+                                 quantizer=quantizer))
 
             def scorer(X):
-                return booster.predict(X)
+                return booster.predict(X, predict_dtype=pdt)
 
             out_col = args.output_col
             return AsyncServingQuery(
@@ -105,7 +134,9 @@ def main(argv=None) -> int:
 
     w = sub.add_parser("worker", help="serve a model + register")
     w.add_argument("--model", required=True,
-                   help="saved pipeline dir or LightGBM .txt model")
+                   help="saved pipeline dir, LightGBM .txt model, or "
+                        "native .npz booster (the format that serves "
+                        "the int8 lane without degrading)")
     w.add_argument("--registry", required=True,
                    help="shared registry directory")
     w.add_argument("--engine", choices=["threaded", "async"], default=None,

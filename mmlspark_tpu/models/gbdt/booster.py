@@ -41,6 +41,7 @@ from ...parallel import mesh as meshlib
 from ...parallel import placement
 from ...parallel.compat import shard_map
 from ...parallel.placement import pspec as P
+from . import quantize as _quantize
 from .growth import (GrowConfig, Tree, bitset_words, grow_tree,
                      grow_tree_depthwise, predict_forest_raw,
                      predict_tree_binned, resolve_growth_backend)
@@ -185,33 +186,62 @@ def _pow2_ceil(v: int) -> int:
     return 1 << (max(1, int(v)) - 1).bit_length()
 
 
-def _pack_trees_host(trees: Tree, t_end: int) -> np.ndarray:
+def _pack_trees_host(trees: Tree, t_end: int,
+                     predict_dtype: str = "f32") -> np.ndarray:
     """Host-side mirror of :func:`pack_trees`: flatten the first ``t_end``
     trees into ONE int32 buffer (bools widened, float/uint bits riding
     bitcast) so the forest upload is a single host->device transfer and the
-    executable's tree argument is one flat array."""
-    parts = []
+    executable's tree argument is one flat array.
+
+    ``predict_dtype == "int8"`` shrinks the buffer: the ``leaf_value``
+    segment carries per-tree int8-quantized leaves packed four per word
+    (``quantize.quantize_leaves`` — the scale math stays in the funnel)
+    and the ``[t_end]`` f32 leaf scales ride bitcast at the buffer's
+    tail, beside the trees in the same single transfer."""
+    parts, tail = [], None
     for name, arr in zip(Tree._fields, trees):
         a = np.asarray(arr)[:t_end].astype(_TREE_FIELD_DTYPES[name],
                                            copy=False)
+        if name == "leaf_value" and predict_dtype == "int8":
+            q, scale = _quantize.quantize_leaves(a)
+            flatq = np.pad(q.reshape(-1), (0, (-q.size) % 4))
+            parts.append(np.ascontiguousarray(flatq).view(np.int32))
+            tail = np.ascontiguousarray(scale).view(np.int32)
+            continue
         if a.dtype == np.bool_:
             a = a.astype(np.int32)
         elif a.dtype != np.int32:
             a = np.ascontiguousarray(a).view(np.int32)
         parts.append(np.ascontiguousarray(a).reshape(-1))
+    if tail is not None:
+        parts.append(tail)
     return np.concatenate(parts)
 
 
-def _unpack_trees_device(flat: jnp.ndarray, T: int, M: int, BW: int) -> Tree:
+def _unpack_trees_device(flat: jnp.ndarray, T: int, M: int, BW: int,
+                         predict_dtype: str = "f32") -> Tree:
     """Device-side inverse of :func:`_pack_trees_host` (static slicing —
     traces into pure reshapes/bitcasts, no data movement). Field order,
     shapes and bitcast rules are shared with the host pack/unpack pair
     (``Tree._fields`` / :func:`_tree_field_shape` /
-    ``_TREE_FIELD_DTYPES``)."""
+    ``_TREE_FIELD_DTYPES``). The int8 lane unpacks the packed int8 leaf
+    segment and dequantizes against the tail scales through the quantize
+    funnel — the Tree handed to traversal carries f32 leaves either way
+    (the f32-epilogue contract)."""
     fields, off = {}, 0
     for name in Tree._fields:
         shape = _tree_field_shape(name, (T,), M, BW)
         size = int(np.prod(shape, dtype=np.int64))
+        if name == "leaf_value" and predict_dtype == "int8":
+            nwords = (size + 3) // 4
+            q = lax.bitcast_convert_type(flat[off:off + nwords],
+                                         jnp.int8).reshape(-1)[:size]
+            off += nwords
+            scale = lax.bitcast_convert_type(flat[flat.shape[0] - T:],
+                                             jnp.float32)
+            fields[name] = _quantize.dequantize_leaves_device(
+                q.reshape(shape), scale)
+            continue
         seg = flat[off:off + size]
         off += size
         dt = _TREE_FIELD_DTYPES[name]
@@ -285,21 +315,23 @@ class _ObservedProgram:
     scoring never depends on the observability path.
     """
 
-    __slots__ = ("_jitted", "_key", "_key_hash", "_compiled", "_lock")
+    __slots__ = ("_jitted", "_key", "_key_hash", "_compiled", "_lock",
+                 "_dtype")
 
-    def __init__(self, jitted, key):
+    def __init__(self, jitted, key, dtype=None):
         self._jitted = jitted
         self._key = key
         self._key_hash = predict_key_hash(key)
         self._compiled = None
         self._lock = threading.Lock()
+        self._dtype = dtype
 
     @classmethod
-    def from_compiled(cls, compiled, key):
+    def from_compiled(cls, compiled, key, dtype=None):
         """Wrap an ALREADY-COMPILED executable (the bundle-prewarm path)
         so prewarmed entries get the same call-site roofline timing as
         organically-compiled ones."""
-        prog = cls(None, key)
+        prog = cls(None, key, dtype=dtype)
         prog._compiled = compiled
         return prog
 
@@ -360,11 +392,12 @@ class _ObservedProgram:
             self._key_hash, kind="predict",
             flops=cost.get("flops"),
             bytes_accessed=cost.get("bytes_accessed"),
-            compile_seconds=dt, label="gbdt_predict")
+            compile_seconds=dt, label="gbdt_predict",
+            dtype=self._dtype)
         return fn
 
 
-def _predict_program(key, build):
+def _predict_program(key, build, dtype=None):
     """Get-or-build in the bounded process-wide predictor cache, counting
     hits/misses (``gbdt_predict_cache_{hits,misses}_total``)."""
     with _PREDICT_CACHE_LOCK:
@@ -374,7 +407,7 @@ def _predict_program(key, build):
     if fn is None:
         _metrics.safe_counter("gbdt_predict_cache_misses_total").inc()
         with _spans.span("gbdt_predict_build"):
-            fn = _ObservedProgram(build(), key)
+            fn = _ObservedProgram(build(), key, dtype=dtype)
         with _PREDICT_CACHE_LOCK:
             fn = _PREDICT_CACHE.setdefault(key, fn)
             _PREDICT_CACHE.move_to_end(key)
@@ -385,7 +418,7 @@ def _predict_program(key, build):
     return fn
 
 
-def preload_predict_program(key, fn) -> bool:
+def preload_predict_program(key, fn, dtype=None) -> bool:
     """Install an ALREADY-COMPILED program under ``key`` — the serving-
     bundle prewarm path (``mmlspark_tpu/bundles``): a worker restarting
     from an AOT bundle populates the predictor cache before its first
@@ -401,12 +434,12 @@ def preload_predict_program(key, fn) -> bool:
     # entries get the same call-site roofline timing as organic ones
     if not isinstance(fn, _ObservedProgram):
         cost = _cost_summary(fn)
-        prog = _ObservedProgram.from_compiled(fn, key)
+        prog = _ObservedProgram.from_compiled(fn, key, dtype=dtype)
         _roofline.register_executable(
             prog._key_hash, kind="predict",
             flops=cost.get("flops"),
             bytes_accessed=cost.get("bytes_accessed"),
-            label="gbdt_predict(prewarm)")
+            label="gbdt_predict(prewarm)", dtype=dtype)
         fn = prog
     with _PREDICT_CACHE_LOCK:
         if key in _PREDICT_CACHE:      # lost the race while wrapping
@@ -440,29 +473,36 @@ class PredictPlan(NamedTuple):
     T_pad: int
     num_features: int
     builder: Callable
+    predict_dtype: str = "f32"
 
 
 def iter_predict_plans(booster: "Booster", batch_sizes,
-                       num_iterations=(-1,), transforms=(True,)):
+                       num_iterations=(-1,), transforms=(True,),
+                       dtypes=("f32",)):
     """Yield ``(meta, plan)`` for every DISTINCT fused predict
     executable a serving deployment of ``booster`` dispatches over the
-    given batch sizes / iteration counts / transform variants. THE one
-    enumeration: the key-manifest export below and the bundle builder
-    (``mmlspark_tpu/bundles``) both iterate this, so what a bundle pins
-    and what a manifest reports can never drift. Batch sizes aliasing
-    into one pow2 bucket dedupe to one plan (the executable is
-    shared)."""
+    given batch sizes / iteration counts / transform / predict-dtype
+    variants. THE one enumeration: the key-manifest export below and
+    the bundle builder (``mmlspark_tpu/bundles``) both iterate this, so
+    what a bundle pins and what a manifest reports can never drift.
+    Batch sizes aliasing into one pow2 bucket dedupe to one plan (the
+    executable is shared), and a requested dtype the model degrades
+    (``quantize.resolve_predict_dtype``) dedupes into its f32 plan —
+    the meta records the EFFECTIVE dtype."""
     seen = set()
-    for transformed in transforms:
-        for it in num_iterations:
-            for b in batch_sizes:
-                plan = booster.predict_plan(int(b), int(it),
-                                            transformed=transformed)
-                if plan.key in seen:
-                    continue
-                seen.add(plan.key)
-                yield ({"batch_size": int(b), "num_iteration": int(it),
-                        "transformed": bool(transformed)}, plan)
+    for dt in dtypes:
+        for transformed in transforms:
+            for it in num_iterations:
+                for b in batch_sizes:
+                    plan = booster.predict_plan(int(b), int(it),
+                                                transformed=transformed,
+                                                predict_dtype=dt)
+                    if plan.key in seen:
+                        continue
+                    seen.add(plan.key)
+                    yield ({"batch_size": int(b), "num_iteration": int(it),
+                            "transformed": bool(transformed),
+                            "predict_dtype": plan.predict_dtype}, plan)
 
 
 def predict_key_manifest(booster: "Booster", batch_sizes,
@@ -496,7 +536,8 @@ def _freeze_kwargs(kwargs: dict):
 
 
 def _build_predict_program(T_pad: int, M: int, BW: int, depth_cap: int,
-                           K: int, cat_max_bin: int, transform):
+                           K: int, cat_max_bin: int, transform,
+                           predict_dtype: str = "f32"):
     """Build the fused device-resident scoring program.
 
     ``run(packed, thr, base, active, is_cat, mdec, X)`` evaluates all
@@ -506,10 +547,18 @@ def _build_predict_program(T_pad: int, M: int, BW: int, depth_cap: int,
     prediction function, see ``objectives.score_transform``) is set —
     applies the objective transform, all inside ONE jitted program.
     ``is_cat`` / ``mdec`` are passed as ``None`` when absent (the key
-    distinguishes those variants)."""
+    distinguishes those variants).
+
+    ``predict_dtype`` selects the traversal lane (ROADMAP item 3):
+    ``int8`` compares uint8 bin-id features against uint8 bin-id
+    thresholds (routing bit-exact vs f32 — see ``quantize.py``) over
+    int8-packed leaves; ``bf16`` compares bfloat16 features/thresholds.
+    Both keep the epilogue — leaf gather, per-class sum, base score,
+    transform — in f32."""
 
     def run(packed, thr, base, active, is_cat, mdec, X):
-        trees = _unpack_trees_device(packed, T_pad, M, BW)
+        trees = _unpack_trees_device(packed, T_pad, M, BW,
+                                     predict_dtype=predict_dtype)
         leaf = predict_forest_raw(trees, thr, X, depth_cap, is_cat=is_cat,
                                   cat_max_bin=cat_max_bin,
                                   missing_dec=mdec)            # [T_pad, n]
@@ -562,13 +611,17 @@ def _bin_program(x_shape, max_bin: int, mesh: Mesh, bin_dtype=jnp.int32):
 
 
 def _validate_bin_dtype(bin_dtype, max_bin: int):
-    """Bin-id storage dtype: int32 (default), int16 or uint8. Bin ids are
-    < max_bin, so narrow storage is lossless within range; it shrinks the
-    HBM-resident dataset 2x/4x — the lever that fits Criteo-scale binned
-    matrices on a v5e pod (docs/performance.md "scaling"). Kernels and
-    routing widen per block in VMEM, never in HBM."""
+    """Bin-id storage dtype: int32 (default), int16, uint8 or int8. Bin
+    ids are < max_bin, so narrow storage is lossless within range; it
+    shrinks the HBM-resident dataset 2x/4x — the lever that fits
+    Criteo-scale binned matrices on a v5e pod (docs/performance.md
+    "scaling"). int8 (ids < 128, i.e. max_bin <= 128) matches the
+    quantized predict lane's signed-byte staging for frameworks that
+    want one dtype end to end. Kernels and routing widen per block in
+    VMEM, never in HBM."""
     bd = jnp.dtype(bin_dtype)
-    limits = {"int32": 1 << 31, "int16": 1 << 15, "uint8": 256}
+    limits = {"int32": 1 << 31, "int16": 1 << 15, "uint8": 256,
+              "int8": 128}
     if bd.name not in limits:
         raise ValueError(
             f"bin_dtype must be one of {sorted(limits)}, got {bd.name}")
@@ -676,8 +729,10 @@ class LightGBMDataset:
                                 max_bin_by_feature).fit(X)
         tw.mark("binner_fit")
         # placement decision (observable): dataset rows are batch-dim
-        # sharded over the mesh's data axis when it has >1 shard
-        placement.plan_for("gbdt.ingest", mesh=mesh, rows=n)
+        # sharded over the mesh's data axis when it has >1 shard; the
+        # note carries the binned matrix's storage dtype so the flight
+        # ring shows how wide the HBM-resident dataset landed
+        placement.plan_for("gbdt.ingest", mesh=mesh, rows=n, dtype=bd.name)
         # Binning runs ON DEVICE, producing the column-major [F, n_local]
         # layout tree growth consumes (the host searchsorted pass measured
         # 1.6 s at the 1Mx28 bench shape vs ~ms of VPU compare-sums; raw and
@@ -837,18 +892,29 @@ class Booster:
         bucket = self.num_class * _pow2_ceil(t_end // self.num_class)
         return T_full if bucket >= T_full else bucket
 
-    def _device_forest_args(self, T_pad: int):
+    def _device_forest_args(self, T_pad: int, predict_dtype: str = "f32"):
         """Device-RESIDENT forest arguments for the first ``T_pad`` trees:
         (packed trees, thresholds, base score, categorical mask, missing
         decisions) — uploaded once per bucket, cached on the instance
         (dropped by ``__getstate__``), and passed as jit ARGUMENTS so the
-        compiled program itself stays model-independent."""
+        compiled program itself stays model-independent. Narrow predict
+        lanes cache their own entries: the int8 lane packs int8 leaves
+        and uint8 bin-id thresholds (quantize funnel), the bf16 lane
+        narrows thresholds — so the ``packed_trees`` HBM claim shrinks
+        with the lane."""
         cache = self.__dict__.setdefault("_dev_forest", OrderedDict())
-        ent = cache.get(T_pad)
+        ck = (T_pad, predict_dtype)
+        ent = cache.get(ck)
         if ent is None:
-            packed = _pack_trees_host(self.trees, T_pad)
+            packed = _pack_trees_host(self.trees, T_pad, predict_dtype)
             thr = np.ascontiguousarray(
                 np.asarray(self.thr_raw, np.float32)[:T_pad])
+            if predict_dtype == "int8":
+                thr = _quantize.quantize_thresholds(
+                    thr, np.asarray(self.trees.feat)[:T_pad],
+                    _quantize.feature_bounds(self.binner_state))
+            elif predict_dtype == "bf16":
+                thr = _quantize.cast_thresholds_bf16(thr)
             is_cat = self._is_cat()
             mdec = (None if self.missing_dec is None
                     else jnp.asarray(
@@ -858,12 +924,12 @@ class Booster:
             _hbm.claim("packed_trees", _forest_args_nbytes(ent))
             # bounded LRU: each entry pins a device tree buffer, so a
             # learning-curve sweep over every t_end must not pin O(T^2)
-            cache[T_pad] = ent
+            cache[ck] = ent
             while len(cache) > 4:
                 _k, old = cache.popitem(last=False)
                 _hbm.release("packed_trees", _forest_args_nbytes(old))
         else:
-            cache.move_to_end(T_pad)
+            cache.move_to_end(ck)
         return ent
 
     def _device_active(self, T_pad: int, t_end: int):
@@ -881,9 +947,21 @@ class Booster:
             cache.move_to_end(key)
         return a
 
+    def resolved_predict_dtype(self, requested: Optional[str] = None) -> str:
+        """The effective predict lane for THIS model: delegates to the
+        quantize funnel's resolver with this booster's capability flags
+        (imported missing-value semantics, binner grid width). What a
+        serving worker pins once at startup and surfaces on ``/varz`` —
+        the same resolution :meth:`predict_plan` performs per call, so
+        the pinned lane and the cache key can never disagree."""
+        return _quantize.resolve_predict_dtype(
+            requested, has_mdec=self.missing_dec is not None,
+            max_bin=int(self.binner_state.get("max_bin") or 0))
+
     def predict_plan(self, n: int, num_iteration: int = -1,
                      transformed: bool = True,
-                     num_features: Optional[int] = None) -> "PredictPlan":
+                     num_features: Optional[int] = None,
+                     predict_dtype: Optional[str] = None) -> "PredictPlan":
         """The fused predict executable a batch of ``n`` rows dispatches
         to: its process-wide cache key plus everything needed to build
         (or AOT-export) the program WITHOUT running it.
@@ -912,6 +990,13 @@ class Booster:
         F_bin = int(self.binner_state["upper_bounds"].shape[0])
         if num_features is None:
             num_features = F_bin
+        # the dtype lane is resolved HERE, before the cache key exists
+        # (the PR 4 rule, lint-anchored): env/explicit resolution and
+        # capability degrades live in the quantize funnel, so a key can
+        # never contain an unresolved or unsupported dtype
+        predict_dtype = _quantize.resolve_predict_dtype(
+            predict_dtype, has_mdec=self.missing_dec is not None,
+            max_bin=cat_max_bin)
         spec_key = transform = None
         if transformed:
             spec_key = (self.objective, self.num_class,
@@ -924,13 +1009,16 @@ class Booster:
                       (self.binner_state.get("categorical_features") or ()))
         has_mdec = self.missing_dec is not None
         key = (T_pad, M, BW, n_pad, num_features, self.num_class,
-               self.depth_cap, cat_max_bin, has_cat, has_mdec, spec_key)
+               self.depth_cap, cat_max_bin, has_cat, has_mdec,
+               predict_dtype, spec_key)
         depth_cap, K = self.depth_cap, self.num_class
         return PredictPlan(
             key=key, t_end=t_end, n_pad=n_pad, T_pad=T_pad,
             num_features=num_features,
             builder=lambda: _build_predict_program(
-                T_pad, M, BW, depth_cap, K, cat_max_bin, transform))
+                T_pad, M, BW, depth_cap, K, cat_max_bin, transform,
+                predict_dtype),
+            predict_dtype=predict_dtype)
 
     def predict_plan_args(self, plan: "PredictPlan"):
         """The exact argument tuple ``plan``'s program is called with —
@@ -938,14 +1026,16 @@ class Booster:
         feature batch. What the bundle builder traces/AOT-lowers against
         (and the prewarm path compiles deserialized exports against)."""
         packed, thr, base, is_cat, mdec = self._device_forest_args(
-            plan.T_pad)
+            plan.T_pad, plan.predict_dtype)
         active = self._device_active(plan.T_pad, plan.t_end)
-        x_sds = jax.ShapeDtypeStruct((plan.n_pad, plan.num_features),
-                                     jnp.float32)
+        x_sds = jax.ShapeDtypeStruct(
+            (plan.n_pad, plan.num_features),
+            jnp.dtype(_quantize.staging_dtype(plan.predict_dtype)))
         return (packed, thr, base, active, is_cat, mdec, x_sds)
 
     def _predict_device(self, X: np.ndarray, num_iteration: int,
-                        transformed: bool) -> np.ndarray:
+                        transformed: bool,
+                        predict_dtype: Optional[str] = None) -> np.ndarray:
         """Shared device-resident scoring driver for predict/predict_raw.
 
         Steady state (device args warm) a call is exactly ONE host->device
@@ -953,37 +1043,58 @@ class Booster:
         device->host transfer (the ``[n, K]`` result, via
         :func:`_from_device`): tree-sum, base-score add and the objective
         transform are fused into the cached executable.
+
+        Narrow lanes stage the batch in the lane's dtype before the
+        upload (quartering/halving the h2d bytes); input ALREADY in the
+        staged dtype — async-serving slot-table rows quantized at
+        admission — passes through untouched.
         """
         _compile_cache.ensure()
         # placement decision (deduped flight event): the fused predictor
         # replicates — its executable cache is keyed on exact batch shapes
         placement.plan_for("gbdt.predict", replicate=True)
-        X = np.asarray(X, dtype=np.float32)
+        X = np.asarray(X)
         n = X.shape[0]
         plan = self.predict_plan(n, num_iteration, transformed,
-                                 num_features=X.shape[1])
+                                 num_features=X.shape[1],
+                                 predict_dtype=predict_dtype)
+        if X.dtype != _quantize.staging_dtype(plan.predict_dtype):
+            if plan.predict_dtype == "int8":
+                X = _quantize.quantize_features(
+                    X, _quantize.feature_bounds(self.binner_state))
+            elif plan.predict_dtype == "bf16":
+                X = _quantize.cast_features_bf16(X)
+            else:
+                X = np.asarray(X, dtype=np.float32)
         packed, thr, base, is_cat, mdec = self._device_forest_args(
-            plan.T_pad)
+            plan.T_pad, plan.predict_dtype)
         active = self._device_active(plan.T_pad, plan.t_end)
-        fn = _predict_program(plan.key, plan.builder)
+        fn = _predict_program(plan.key, plan.builder,
+                              dtype=plan.predict_dtype)
         n_pad = plan.n_pad
         Xp = np.pad(X, ((0, n_pad - n), (0, 0))) if n_pad != n else X
         out = fn(packed, thr, base, active, is_cat, mdec, _to_device(Xp))
         return _from_device(out)[:n]
 
-    def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+    def predict_raw(self, X: np.ndarray, num_iteration: int = -1,
+                    predict_dtype: Optional[str] = None) -> np.ndarray:
         """Raw margin scores: [n, num_class] (num_class=1 for
         binary/regression). Device-resident end to end: the per-class
         tree-sum and base-score add run inside the compiled forest program
         (see :meth:`_predict_device`), downloading only ``[n, K]``."""
-        return self._predict_device(X, num_iteration, transformed=False)
+        return self._predict_device(X, num_iteration, transformed=False,
+                                    predict_dtype=predict_dtype)
 
-    def predict(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+    def predict(self, X: np.ndarray, num_iteration: int = -1,
+                predict_dtype: Optional[str] = None) -> np.ndarray:
         """Transformed prediction (probability for binary/multiclass).
         The sigmoid/softmax/exp transform is fused into the same compiled
         program as the forest evaluation — no raw-score download and
-        re-upload between the two."""
-        return self._predict_device(X, num_iteration, transformed=True)
+        re-upload between the two. ``predict_dtype`` selects the scoring
+        lane (``f32``/``bf16``/``int8``; None reads
+        ``MMLSPARK_TPU_PREDICT_DTYPE``) — see ``quantize.py``."""
+        return self._predict_device(X, num_iteration, transformed=True,
+                                    predict_dtype=predict_dtype)
 
     def predict_streamed(self, source, *, chunk_rows: int = 262_144,
                          out_dir=None, num_iteration: int = -1,

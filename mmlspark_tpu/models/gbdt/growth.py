@@ -1232,9 +1232,15 @@ def predict_forest_raw(trees, thr_raw, features: jnp.ndarray,
                 use_default = (((mt == 1) & is_zero) | ((mt == 2) & x_nan))
                 go_left = jnp.where(use_default, dl, ~(xv > t))
             if is_cat is not None:
+                # int8 predict lane: features arrive as integer bin ids
+                # (quantize.quantize_features); category routing widens to
+                # f32 — bin id == category id under the binner's identity
+                # bins, exact for ids < 256
+                xc = (x.astype(jnp.float32)
+                      if jnp.issubdtype(x.dtype, jnp.integer) else x)
                 go_left = jnp.where(
                     is_cat[f],
-                    cat_member(tree_slice.cat_bitset[node], x, max_bin_idx,
+                    cat_member(tree_slice.cat_bitset[node], xc, max_bin_idx,
                                strict),
                     go_left)
             nxt = jnp.where(go_left, tree_slice.left[node], tree_slice.right[node])

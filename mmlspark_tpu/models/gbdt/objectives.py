@@ -289,11 +289,18 @@ def score_transform(objective: str, num_class: int = 1, **kwargs):
     has always returned. Split out so the device-resident inference
     program can fuse the transform into the compiled forest evaluator
     instead of re-uploading raw scores for a second host round-trip.
+
+    The transform is pinned to f32 regardless of the predict lane's
+    dtype (the quantized predictor's f32-epilogue contract, ROADMAP
+    item 3): sigmoid/softmax in reduced precision would trade output
+    fidelity for nothing — the epilogue is a vanishing share of the
+    program's bytes.
     """
     if num_class > 1:
-        return lambda raw: jax.nn.softmax(raw, axis=-1)
+        return lambda raw: jax.nn.softmax(
+            raw.astype(jnp.float32), axis=-1)
     transform = get_objective(objective, num_class, **kwargs).transform
-    return lambda raw: transform(raw[:, 0])
+    return lambda raw: transform(raw[:, 0].astype(jnp.float32))
 
 
 # -- eval metrics for early stopping (reference: TrainUtils.scala:220-315) ------

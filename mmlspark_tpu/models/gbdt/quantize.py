@@ -1,0 +1,227 @@
+"""THE quantization funnel — every quantize/dequantize in the predict lane.
+
+ROADMAP item 3: quantization used to stop at training (``quantized_grad``
+int8 histogram stats); this module extends it through the serving path as
+ONE place where scale math lives. The fused predictor, the async slot
+table, and the ingest path all call through here — graftlint's
+``quantize-funnel`` rule rejects inline ``* scale`` / bin-boundary
+reimplementations anywhere else, so the three layers can never disagree
+about what an int8 row means. (Training's int8 gradient quantization in
+``growth.py`` is a separate, pre-existing funnel with different semantics
+— per-round dynamic grad/hess scales — and stays where it is.)
+
+The int8 lane's "feature scales" are the model's OWN bin boundaries:
+a row quantizes to its per-feature bin ids (``#{upper_bounds < x}``,
+NaN -> bin 0 — byte-identical to the training-time binning convention),
+and a split threshold — always some feature's bin upper bound —
+quantizes to its bin id under the SAME comparison. ``x > thr`` on raw
+floats and ``q(x) > q(thr)`` on bin ids therefore route IDENTICALLY:
+int8 traversal is bit-exact against f32, and the only accuracy delta of
+the int8 lane is the per-tree int8 leaf quantization (symmetric,
+amax/127). Bin ids live in ``[0, max_bin)`` so the staged dtype is
+``uint8`` (the lane keyword stays ``int8`` = 8-bit integer staging).
+
+The bf16 lane simply narrows thresholds and the feature batch to
+bfloat16 (leaves stay f32) — half the h2d bytes, rounding-level routing
+deltas, no binner required.
+
+Resolution contract (the PR 4 rule): :func:`resolve_predict_dtype` is
+called by ``Booster.predict_plan`` BEFORE the ``_PREDICT_CACHE`` key is
+assembled — lint-anchored in ``tools/graftlint/checks/cachekey.py`` —
+so a cache key never contains an unresolved "whatever the env said"
+dtype, and capability degrades (no binner, imported missing-value
+semantics) are decided in exactly one place.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+from ...observability import flight as _flight
+
+__all__ = [
+    "PREDICT_DTYPES", "PREDICT_DTYPE_ENV", "resolve_predict_dtype",
+    "staging_dtype", "feature_bounds", "quantize_features",
+    "quantize_thresholds", "quantize_leaves", "dequantize_leaves_device",
+    "cast_features_bf16", "cast_thresholds_bf16", "row_quantizer",
+]
+
+PREDICT_DTYPES = ("f32", "bf16", "int8")
+PREDICT_DTYPE_ENV = "MMLSPARK_TPU_PREDICT_DTYPE"
+
+# numpy staging dtype per lane — what the slot table allocates and the
+# predict hot path uploads
+_STAGING = {"f32": np.dtype(np.float32),
+            "bf16": np.dtype(ml_dtypes.bfloat16),
+            "int8": np.dtype(np.uint8)}
+
+# one degrade flight event per distinct (requested, effective, reason):
+# resolve runs on every predict call, the ring must not fill with repeats
+_SEEN_DEGRADES: set = set()
+_SEEN_LOCK = threading.Lock()
+
+
+def _degrade(requested: str, effective: str, reason: str) -> str:
+    key = (requested, effective, reason)
+    with _SEEN_LOCK:
+        fresh = key not in _SEEN_DEGRADES
+        if fresh:
+            _SEEN_DEGRADES.add(key)
+    if fresh:
+        _flight.record("predict_dtype", requested=requested,
+                       effective=effective, reason=reason)
+    return effective
+
+
+def resolve_predict_dtype(requested: Optional[str] = None, *,
+                          has_mdec: bool = False,
+                          max_bin: int = 0) -> str:
+    """Resolve the predict lane's dtype to a concrete member of
+    :data:`PREDICT_DTYPES` — THE one resolution point, called before the
+    predictor cache key exists.
+
+    ``requested=None`` reads ``MMLSPARK_TPU_PREDICT_DTYPE`` (default
+    ``f32``); an unknown env value degrades to ``f32`` with a flight
+    event (an operator hint must not kill scoring), an unknown explicit
+    argument raises (caller bug). Capability degrades — both to ``f32``,
+    each with a flight event:
+
+    * ``has_mdec`` (imported stock-LightGBM missing-value semantics):
+      the NumericalDecision branch needs real NaN/zero tests, so any
+      narrow lane degrades.
+    * ``int8`` needs the model's binner (``0 < max_bin <= 256``) — the
+      bin boundaries ARE the quantization grid; imported models without
+      one (or wide-binned models) have no int8 code for a feature.
+    """
+    if requested is None:
+        env = os.environ.get(PREDICT_DTYPE_ENV, "") or "f32"
+        if env not in PREDICT_DTYPES:
+            return _degrade(env, "f32", "unknown_env_value")
+        requested = env
+    elif requested not in PREDICT_DTYPES:
+        raise ValueError(
+            f"predict_dtype must be one of {PREDICT_DTYPES}, "
+            f"got {requested!r}")
+    if requested == "f32":
+        return "f32"
+    if has_mdec:
+        return _degrade(requested, "f32", "imported_missing_semantics")
+    if requested == "int8" and not (0 < int(max_bin) <= 256):
+        return _degrade(requested, "f32", "no_binner_grid")
+    return requested
+
+
+def staging_dtype(predict_dtype: str) -> np.dtype:
+    """The numpy dtype a ``predict_dtype`` lane stages feature rows in
+    (slot-table buffers, the predict h2d upload)."""
+    return _STAGING[predict_dtype]
+
+
+def feature_bounds(binner_state: dict) -> np.ndarray:
+    """The model's quantization grid: ``[F, max_bin-1]`` f32 per-feature
+    bin upper bounds (inf-padded), straight from the binner state."""
+    return np.asarray(binner_state["upper_bounds"], np.float32)
+
+
+def quantize_features(X: np.ndarray, upper_bounds: np.ndarray) -> np.ndarray:
+    """Raw f32 rows -> uint8 bin ids under the model's bin boundaries.
+
+    ``q = #{j : upper_bounds[f, j] < x}`` per feature — the same
+    "NaN -> bin 0, beyond-last-bound -> catch-all" convention the
+    training-time binner used, so quantized traversal routes exactly as
+    training binned. Vectorized as one searchsorted per feature (bounds
+    are sorted, inf padding never counts for finite x).
+    """
+    X = np.asarray(X, np.float32)
+    ub = np.asarray(upper_bounds, np.float32)
+    out = np.empty(X.shape, np.uint8)
+    for f in range(X.shape[1]):
+        col = X[:, f]
+        q = np.searchsorted(ub[f], col, side="left")
+        np.minimum(q, 255, out=q)
+        out[:, f] = np.where(np.isnan(col), 0, q)
+    return out
+
+
+def quantize_thresholds(thr: np.ndarray, feat: np.ndarray,
+                        upper_bounds: np.ndarray) -> np.ndarray:
+    """Split thresholds -> uint8 bin ids under each node's feature grid.
+
+    A learned threshold is always some bin's upper bound, and the count
+    ``#{j : upper_bounds[feat, j] < thr}`` uses the SAME strict compare
+    as :func:`quantize_features` — so ``x > thr  <=>  q(x) > q(thr)``
+    holds for every finite x, tied boundaries included. Leaf/padding
+    nodes carry arbitrary thresholds; their ids are never routing-live.
+    """
+    thr = np.asarray(thr, np.float32)
+    feat = np.asarray(feat)
+    ub = np.asarray(upper_bounds, np.float32)
+    q = np.zeros(thr.shape, np.int64)
+    for f in range(ub.shape[0]):
+        sel = feat == f
+        if sel.any():
+            q[sel] = np.searchsorted(ub[f], thr[sel], side="left")
+    # features out of the binner's range (defensive) keep id 0
+    return np.minimum(q, 255).astype(np.uint8)
+
+
+def quantize_leaves(leaf_value: np.ndarray,
+                    num_class: int = 1) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-tree symmetric int8 leaf quantization.
+
+    Returns ``(q, scale)``: ``q`` int8 ``[T, M]`` with
+    ``leaf ~= q * scale[t]``, ``scale`` f32 ``[T]`` = per-tree
+    ``amax(|leaf|) / 127`` (tiny-floored so all-zero trees stay exact).
+    Per-tree, not global: late trees in a boosted ensemble carry leaves
+    orders of magnitude smaller than tree 0's, and a global scale would
+    flush them to zero.
+    """
+    lv = np.asarray(leaf_value, np.float32)
+    amax = np.abs(lv).max(axis=1)
+    scale = np.maximum(amax / 127.0, 1e-30).astype(np.float32)
+    q = np.clip(np.rint(lv / scale[:, None]), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def dequantize_leaves_device(qleaf, scale):
+    """Device-side int8 leaf dequantization (the f32 epilogue's entry
+    point): ``[T, M]`` f32 = ``q * scale[t]``."""
+    return qleaf.astype(jnp.float32) * scale[:, None]
+
+
+def cast_features_bf16(X: np.ndarray) -> np.ndarray:
+    """Raw rows -> host bfloat16 (``ml_dtypes`` — already a jax
+    dependency), halving the h2d bytes of the feature batch."""
+    return np.asarray(X).astype(ml_dtypes.bfloat16)
+
+
+def cast_thresholds_bf16(thr: np.ndarray) -> np.ndarray:
+    """Thresholds -> host bfloat16 (uploaded once per tree bucket)."""
+    return np.asarray(thr, np.float32).astype(ml_dtypes.bfloat16)
+
+
+def row_quantizer(predict_dtype: str, upper_bounds: Optional[np.ndarray]):
+    """The slot-table admission transform for one bound model: a
+    callable mapping an f32 feature row (or row batch) to the lane's
+    staged dtype, or ``None`` for the f32 lane (plain cast suffices).
+    Created HERE so admission code holds an opaque callable and never
+    touches scale math."""
+    if predict_dtype == "int8":
+        ub = np.asarray(upper_bounds, np.float32)
+
+        def quantize_row(row):
+            r = np.asarray(row, np.float32)
+            q = quantize_features(r.reshape(1, -1) if r.ndim == 1 else r,
+                                  ub)
+            return q[0] if r.ndim == 1 else q
+
+        return quantize_row
+    if predict_dtype == "bf16":
+        return cast_features_bf16
+    return None

@@ -206,6 +206,21 @@ REGISTRY: Tuple[EnvVar, ...] = (
            doc="`0` disables the binned-device-dataset fit cache (the "
                "cache pins up to two [F, n] int32 matrices in device "
                "memory; `clear_binned_dataset_cache()` releases them)"),
+    EnvVar(name="MMLSPARK_TPU_PREDICT_DTYPE", default="f32",
+           section="performance",
+           doc="fused-predict lane: `f32` / `bf16` (thresholds + features "
+               "cast, f32 leaves) / `int8` (bin-id routing + quantized "
+               "leaves); resolved once in `quantize.resolve_predict_dtype` "
+               "before any predictor cache key — unknown values degrade "
+               "to `f32` with a flight event; per-call "
+               "`predict(..., predict_dtype=...)` overrides"),
+    EnvVar(name="MMLSPARK_TPU_INGEST_HOST_QUANT", default="(off)",
+           section="performance",
+           doc="`1` bins streaming-ingest chunks on the host (same "
+               "searchsorted grid as the device binner — bit-identical "
+               "matrices) and ships uint8 instead of f32, 4x fewer h2d "
+               "bytes; default off because host binning costs CPU per "
+               "chunk"),
     # -- streaming / serving ----------------------------------------------
     EnvVar(name="MMLSPARK_TPU_DISABLE_PREFETCH", default="(off)",
            section="performance",

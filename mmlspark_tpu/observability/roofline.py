@@ -112,11 +112,15 @@ def register_executable(key_hash: str, kind: str = "predict",
                         flops: Optional[float] = None,
                         bytes_accessed: Optional[float] = None,
                         compile_seconds: Optional[float] = None,
-                        label: Optional[str] = None) -> None:
+                        label: Optional[str] = None,
+                        dtype: Optional[str] = None) -> None:
     """Add or refresh a ledger entry for a compiled executable.
 
     ``flops`` / ``bytes_accessed`` come from ``cost_analysis()`` (None
     when the backend exposes none — the entry still tracks wall time).
+    ``dtype`` labels the executable's compute lane (the quantized
+    predict lanes register as ``int8``/``bf16``, so the ledger shows the
+    reduced ``bytes_accessed`` next to the lane that earned it).
     No-op while telemetry is disabled.
     """
     if not _metrics.enabled():
@@ -125,7 +129,7 @@ def register_executable(key_hash: str, kind: str = "predict",
     with _lock:
         entry = _entries.get(key_hash)
         if entry is None:
-            entry = {"kind": kind, "label": label,
+            entry = {"kind": kind, "label": label, "dtype": None,
                      "flops": None, "bytes_accessed": None,
                      "compile_seconds": None,
                      "calls": 0, "ewma_seconds": None}
@@ -137,6 +141,8 @@ def register_executable(key_hash: str, kind: str = "predict",
             entry["kind"] = kind
         if label is not None:
             entry["label"] = label
+        if dtype is not None:
+            entry["dtype"] = dtype
         if flops is not None:
             entry["flops"] = float(flops)
         if bytes_accessed is not None:
@@ -157,7 +163,7 @@ def observe_call(key_hash: str, seconds: float) -> None:
     with _lock:
         entry = _entries.get(key_hash)
         if entry is None:
-            entry = {"kind": "unknown", "label": None,
+            entry = {"kind": "unknown", "label": None, "dtype": None,
                      "flops": None, "bytes_accessed": None,
                      "compile_seconds": None,
                      "calls": 0, "ewma_seconds": None}
@@ -202,6 +208,7 @@ def _render_entry(key_hash: str, entry: Dict[str, Any],
         bound = "compute" if flops_pct >= bytes_pct else "memory"
     return {"key": key_hash, "key_label": _key_label(key_hash),
             "kind": entry["kind"], "label": entry["label"],
+            "dtype": entry.get("dtype"),
             "flops": flops, "bytes_accessed": byts,
             "compile_seconds": entry["compile_seconds"],
             "calls": entry["calls"], "ewma_seconds": ewma,

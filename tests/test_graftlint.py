@@ -569,6 +569,9 @@ _BOOSTER_PIN_OK = """\
     def resolve_growth_backend(cfg):
         return cfg
 
+    def resolve_predict_dtype(d):
+        return d or "f32"
+
     def _cached_program(key, build):
         return build()
 
@@ -576,6 +579,11 @@ _BOOSTER_PIN_OK = """\
         cfg = resolve_growth_backend(cfg)
         cache_key = (cfg,)
         return _cached_program(cache_key, lambda: cfg)
+
+    def predict_plan(self, n, predict_dtype=None):
+        predict_dtype = resolve_predict_dtype(predict_dtype)
+        key = (n, predict_dtype)
+        return key
 """
 
 _API_PIN_OK = """\
@@ -643,6 +651,108 @@ class TestResolveBeforeCacheKey:
         got = hits(active, "resolve-before-cache-key",
                    "mmlspark_tpu/models/gbdt/api.py")
         assert len(got) == 1 and "_grow_config" in got[0].message
+
+    def test_predict_plan_pin_inversion(self, tmp_path):
+        inverted = _BOOSTER_PIN_OK.replace(
+            "        predict_dtype = resolve_predict_dtype(predict_dtype)\n"
+            "        key = (n, predict_dtype)",
+            "        key = (n, predict_dtype)\n"
+            "        predict_dtype = resolve_predict_dtype(predict_dtype)")
+        assert inverted != _BOOSTER_PIN_OK
+        active, _sup = run_rule(tmp_path, "resolve-before-cache-key", {
+            "mmlspark_tpu/models/gbdt/booster.py": inverted,
+            "mmlspark_tpu/models/gbdt/api.py": _API_PIN_OK})
+        got = hits(active, "resolve-before-cache-key",
+                   "mmlspark_tpu/models/gbdt/booster.py")
+        assert any("predict_plan's key assembly" in f.message
+                   for f in got), active
+
+    def test_predict_plan_pin_missing_resolver(self, tmp_path):
+        unresolved = _BOOSTER_PIN_OK.replace(
+            "        predict_dtype = resolve_predict_dtype(predict_dtype)\n",
+            "")
+        assert unresolved != _BOOSTER_PIN_OK
+        active, _sup = run_rule(tmp_path, "resolve-before-cache-key", {
+            "mmlspark_tpu/models/gbdt/booster.py": unresolved,
+            "mmlspark_tpu/models/gbdt/api.py": _API_PIN_OK})
+        got = hits(active, "resolve-before-cache-key",
+                   "mmlspark_tpu/models/gbdt/booster.py")
+        assert any("resolve_predict_dtype call missing" in f.message
+                   for f in got), active
+
+
+# --------------------------------------------------------------------------
+# quantize-funnel
+# --------------------------------------------------------------------------
+
+_QUANTIZE_FUNNEL_OK = """\
+    import numpy as np
+
+    def resolve_predict_dtype(d):
+        return d or "f32"
+
+    def quantize_features(X, ub):
+        return np.searchsorted(ub[0], X[:, 0], side="left")
+
+    def quantize_leaves(lv):
+        scale = np.abs(lv).max() / 127.0
+        return np.clip(np.rint(lv / scale), -127, 127), scale
+"""
+
+
+class TestQuantizeFunnel:
+    def test_stray_quantization_sites(self, tmp_path):
+        active, suppressed = run_rule(tmp_path, "quantize-funnel", {
+            "mmlspark_tpu/models/gbdt/quantize.py": _QUANTIZE_FUNNEL_OK,
+            "mmlspark_tpu/io/aserve/slots.py": """\
+                import numpy as np
+
+                def admit(row, ub, lv):
+                    q = np.searchsorted(ub[0], row, side="left")
+                    scale = np.abs(lv).max() / 127.0
+                    qq = np.clip(np.rint(lv / scale), -127, 127)
+                    r = np.searchsorted(ub[0], row, side="left")  # graftlint: disable=quantize-funnel (test)
+                    return q, qq, r
+            """})
+        got = hits(active, "quantize-funnel",
+                   "mmlspark_tpu/io/aserve/slots.py")
+        assert [f.line for f in got] == [4, 5, 6], active
+        assert "searchsorted" in got[0].message
+        assert "scale" in got[1].message
+        assert [f.line for f in suppressed] == [7]
+
+    def test_non_grid_uses_and_training_funnel_clean(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "quantize-funnel", {
+            "mmlspark_tpu/models/gbdt/quantize.py": _QUANTIZE_FUNNEL_OK,
+            # shard-offset lookup (side="right") and the no-side weighted
+            # median are NOT bin-grid quantization
+            "mmlspark_tpu/models/gbdt/ingest.py": """\
+                import numpy as np
+
+                def shard_of(offsets, idx):
+                    return np.searchsorted(offsets, idx, side="right") - 1
+            """,
+            "mmlspark_tpu/models/gbdt/objectives.py": """\
+                import numpy as np
+
+                def weighted_median(ys, cw, target):
+                    return ys[np.searchsorted(cw, target)]
+            """,
+            # growth.py owns TRAINING gradient quantization — allowlisted
+            "mmlspark_tpu/models/gbdt/growth.py": """\
+                def quantized_grad(g, q_max):
+                    return g / 127.0
+            """})
+        assert not hits(active, "quantize-funnel"), active
+
+    def test_rots_when_funnel_vanishes(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "quantize-funnel", {
+            "mmlspark_tpu/models/gbdt/quantize.py": """\
+                def resolve_predict_dtype(d):
+                    return d
+            """})
+        got = hits(active, "quantize-funnel", "<graftlint>")
+        assert len(got) == 1 and "lint-rot" in got[0].message
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,365 @@
+"""Quantized predict lane: int8/bf16 end to end against the f32 truth.
+
+The int8 lane's safety argument has three legs, each pinned here:
+
+* **Routing is bit-exact.** Features and thresholds quantize onto the
+  model's OWN binning grid (``searchsorted`` left on the binner's upper
+  bounds — the strict-compare convention device binning uses), so
+  ``x > thr`` and ``q(x) > q(thr)`` agree exactly; the only accuracy
+  delta comes from per-tree symmetric leaf quantization (amax/127).
+  The cross-dtype equivalence tests pin that delta.
+* **Resolution happens once, before any cache key.** Unknown env values
+  degrade loudly to f32, imported models without a binner grid degrade
+  with a reason, and the predictor cache key carries the resolved lane
+  — a pickled booster under the same env hits the same executable.
+* **The serving path stages narrow bytes.** Slot-table admission
+  quantizes request rows into uint8 staging buffers (4x fewer bytes
+  per h2d), bucket views stay zero-copy, and quantized executables
+  ride the same AOT bundle machinery as f32 (warm start = zero
+  compiles).
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.gbdt import quantize
+from mmlspark_tpu.models.gbdt.booster import (Booster, LightGBMDataset,
+                                              train_booster)
+from mmlspark_tpu.models.gbdt.growth import GrowConfig
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(7)
+
+
+@pytest.fixture(scope="module")
+def binary(rng):
+    X = rng.normal(size=(600, 8)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    b = train_booster(X, y, objective="binary", num_iterations=10,
+                      cfg=GrowConfig(num_leaves=15), max_bin=63)
+    return b, X, y
+
+
+@pytest.fixture(scope="module")
+def multiclass(rng):
+    X = rng.normal(size=(600, 6)).astype(np.float32)
+    y = (np.digitize(X[:, 0], [-0.5, 0.5])).astype(np.float32)
+    b = train_booster(X, y, objective="multiclass", num_class=3,
+                      num_iterations=6, cfg=GrowConfig(num_leaves=15),
+                      max_bin=63)
+    return b, X, y
+
+
+@pytest.fixture(scope="module")
+def regression(rng):
+    X = rng.normal(size=(600, 8)).astype(np.float32)
+    y = (X[:, 0] * 2 + np.sin(X[:, 1])).astype(np.float32)
+    b = train_booster(X, y, objective="regression", num_iterations=10,
+                      cfg=GrowConfig(num_leaves=15), max_bin=63)
+    return b, X, y
+
+
+# ---------------------------------------------------------------------------
+# the resolver funnel
+# ---------------------------------------------------------------------------
+
+
+class TestResolvePredictDtype:
+    def test_default_is_f32(self, monkeypatch):
+        monkeypatch.delenv(quantize.PREDICT_DTYPE_ENV, raising=False)
+        assert quantize.resolve_predict_dtype(None, max_bin=63) == "f32"
+
+    def test_env_pins_the_lane(self, monkeypatch):
+        monkeypatch.setenv(quantize.PREDICT_DTYPE_ENV, "int8")
+        assert quantize.resolve_predict_dtype(None, max_bin=63) == "int8"
+
+    def test_explicit_overrides_env(self, monkeypatch):
+        monkeypatch.setenv(quantize.PREDICT_DTYPE_ENV, "int8")
+        assert quantize.resolve_predict_dtype("bf16", max_bin=63) == "bf16"
+
+    def test_unknown_env_degrades_unknown_explicit_raises(self, monkeypatch):
+        monkeypatch.setenv(quantize.PREDICT_DTYPE_ENV, "fp4")
+        assert quantize.resolve_predict_dtype(None, max_bin=63) == "f32"
+        with pytest.raises(ValueError):
+            quantize.resolve_predict_dtype("fp4", max_bin=63)
+
+    def test_capability_degrades(self):
+        # imported missing-semantics models and grid-less models cannot
+        # take the int8 lane — degrade, never mis-route
+        assert quantize.resolve_predict_dtype(
+            "int8", has_mdec=True, max_bin=63) == "f32"
+        assert quantize.resolve_predict_dtype(
+            "int8", has_mdec=False, max_bin=0) == "f32"
+        assert quantize.resolve_predict_dtype(
+            "int8", has_mdec=False, max_bin=1000) == "f32"
+
+    def test_booster_resolved_predict_dtype(self, binary):
+        b, _X, _y = binary
+        assert b.resolved_predict_dtype("int8") == "int8"
+        # a .txt roundtrip loses the binner grid -> int8 degrades
+        b2 = Booster.from_string(b.to_lightgbm_string())
+        assert b2.resolved_predict_dtype("int8") == "f32"
+
+
+class TestGridQuantization:
+    def test_feature_quantization_matches_training_binning(self, binary):
+        # the whole routing-exactness argument: q(x) computed on the host
+        # equals the bin ids training used (strict-compare, NaN -> 0)
+        b, X, _y = binary
+        ub = quantize.feature_bounds(b.binner_state)
+        Xn = X.copy()
+        Xn[::7, 0] = np.nan
+        q = quantize.quantize_features(Xn, ub)
+        assert q.dtype == np.uint8
+        for f in range(X.shape[1]):
+            expect = np.searchsorted(ub[f], Xn[:, f], side="left")
+            expect[~np.isfinite(Xn[:, f])] = 0
+            np.testing.assert_array_equal(q[:, f], expect)
+
+    def test_threshold_feature_order_is_preserved(self, binary):
+        # x > thr  <=>  q(x) > q(thr) for every (feature, threshold) the
+        # model actually splits on — routing is bit-exact by construction
+        b, X, _y = binary
+        ub = quantize.feature_bounds(b.binner_state)
+        trees = b.trees
+        internal = ~trees.is_leaf
+        feats = np.asarray(trees.feat)[internal].astype(np.int64)
+        thrs = np.asarray(b.thr_raw)[internal].astype(np.float32)
+        qthr = quantize.quantize_thresholds(
+            np.asarray(b.thr_raw, np.float32),
+            np.asarray(trees.feat), ub)[internal]
+        qX = quantize.quantize_features(X, ub)
+        for f, t, qt in zip(feats[:64], thrs[:64], qthr[:64]):
+            col, qcol = X[:, f], qX[:, f].astype(np.int32)
+            np.testing.assert_array_equal(col > t, qcol > qt)
+
+
+# ---------------------------------------------------------------------------
+# cross-dtype equivalence (the accuracy-delta policy of performance.md)
+# ---------------------------------------------------------------------------
+
+
+class TestCrossDtypeEquivalence:
+    def _deltas(self, booster, X, lane):
+        ref = np.asarray(booster.predict(X))
+        out = np.asarray(booster.predict(X, predict_dtype=lane))
+        assert out.shape == ref.shape
+        d = np.abs(out - ref)
+        return float(d.max()), float(d.mean())
+
+    @pytest.mark.parametrize("fixture", ["binary", "multiclass",
+                                         "regression"])
+    def test_int8_pinned_delta(self, fixture, request):
+        b, X, _y = request.getfixturevalue(fixture)
+        dmax, dmean = self._deltas(b, X, "int8")
+        # leaf quantization only: scale-relative rounding, never routing
+        if fixture == "regression":
+            scale = float(np.abs(np.asarray(b.predict(X))).max()) or 1.0
+            assert dmax / scale < 0.02 and dmean / scale < 0.004, \
+                (dmax, dmean, scale)
+        else:
+            assert dmax < 0.01, dmax
+            assert dmean < 0.002, dmean
+
+    @pytest.mark.parametrize("fixture", ["binary", "multiclass",
+                                         "regression"])
+    def test_bf16_pinned_mean_delta(self, fixture, request):
+        # bf16 casts thresholds AND features: rows landing exactly on a
+        # rounded threshold can flip subtree — the max delta is allowed
+        # to spike on those rows, the MEAN is what the lane pins
+        b, X, _y = request.getfixturevalue(fixture)
+        _dmax, dmean = self._deltas(b, X, "bf16")
+        if fixture == "regression":
+            scale = float(np.abs(np.asarray(b.predict(X))).max()) or 1.0
+            assert dmean / scale < 0.01, (dmean, scale)
+        else:
+            assert dmean < 0.005, dmean
+
+    def test_prequantized_input_passthrough_is_exact(self, binary):
+        # rows already staged in the lane's dtype (the slot-table path)
+        # skip host quantization entirely — same executable, same scores
+        b, X, _y = binary
+        ub = quantize.feature_bounds(b.binner_state)
+        q = quantize.quantize_features(X, ub)
+        via_raw = np.asarray(b.predict(X, predict_dtype="int8"))
+        via_staged = np.asarray(b.predict(q, predict_dtype="int8"))
+        np.testing.assert_allclose(via_staged, via_raw, rtol=0, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# cache key / pickle discipline
+# ---------------------------------------------------------------------------
+
+
+class TestPredictPlanKey:
+    def test_key_carries_the_resolved_lane(self, binary):
+        b, _X, _y = binary
+        k_f32 = b.predict_plan(8).key
+        k_int8 = b.predict_plan(8, predict_dtype="int8").key
+        assert k_f32 != k_int8
+        assert "f32" in k_f32 and "int8" in k_int8
+
+    def test_degraded_lane_dedupes_into_f32_key(self, binary):
+        b, _X, _y = binary
+        b2 = Booster.from_string(b.to_lightgbm_string())  # grid-less
+        assert b2.predict_plan(8, predict_dtype="int8").key == \
+            b2.predict_plan(8).key
+
+    def test_pickled_booster_hits_same_quantized_executable(self, binary):
+        from mmlspark_tpu.models.gbdt import booster as bmod
+        b, X, _y = binary
+        p1 = np.asarray(b.predict(X[:16], predict_dtype="int8"))
+        key = b.predict_plan(16, predict_dtype="int8").key
+        assert key in bmod._PREDICT_CACHE
+        n_keys = len(bmod._PREDICT_CACHE)
+        b2 = pickle.loads(pickle.dumps(b))
+        p2 = np.asarray(b2.predict(X[:16], predict_dtype="int8"))
+        assert len(bmod._PREDICT_CACHE) == n_keys, \
+            "pickle roundtrip recompiled the quantized lane"
+        np.testing.assert_allclose(p2, p1, rtol=0, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# int8 slot-table admission (serving)
+# ---------------------------------------------------------------------------
+
+
+class TestSlotTableAdmission:
+    def test_int8_round_trip_zero_copy_and_live_scores(self, binary):
+        from mmlspark_tpu.io.aserve.slots import SlotTable
+        b, X, _y = binary
+        ub = quantize.feature_bounds(b.binner_state)
+        quantizer = quantize.row_quantizer("int8", ub)
+        F = X.shape[1]
+        table = SlotTable(slots=8, width=F, dtype=np.uint8,
+                          quantizer=quantizer)
+        try:
+            self._round_trip(b, X, table, ub)
+        finally:
+            table.release_claim()
+
+    def _round_trip(self, b, X, table, ub):
+        from mmlspark_tpu.io.aserve.slots import SlotTable
+        F = X.shape[1]
+        n_live = 5
+        for i in range(n_live):
+            table.write(i, X[i])
+        buf = table.flip()
+        # staging really is narrow: uint8 slots, 4x fewer h2d bytes
+        assert buf.dtype == np.uint8 and buf.nbytes == 8 * F
+        view, bucket = SlotTable.bucket_view(buf, n_live)
+        assert bucket == 8
+        assert np.shares_memory(view, buf), "bucket view copied"
+        np.testing.assert_array_equal(
+            view[:n_live], quantize.quantize_features(X[:n_live], ub))
+        # staged rows score through the int8 lane's pass-through; compare
+        # LIVE rows only — bucket padding repeats row 0, not X[5:8]
+        preds = np.asarray(b.predict(view, predict_dtype="int8"))[:n_live]
+        ref = np.asarray(b.predict(X[:n_live]))
+        assert float(np.abs(preds - ref).max()) < 0.01
+
+    def test_hbm_claim_shrinks_4x(self):
+        from mmlspark_tpu.io.aserve.slots import SlotTable
+        wide = SlotTable(slots=16, width=32)
+        narrow = SlotTable(slots=16, width=32, dtype=np.uint8)
+        try:
+            wide_bytes = sum(buf.nbytes for buf in wide._bufs)
+            narrow_bytes = sum(buf.nbytes for buf in narrow._bufs)
+            assert wide_bytes == 4 * narrow_bytes
+        finally:
+            wide.release_claim()
+            narrow.release_claim()
+
+    def test_row_quantizer_lanes(self, binary):
+        b, X, _y = binary
+        ub = quantize.feature_bounds(b.binner_state)
+        assert quantize.row_quantizer("f32", None) is None
+        qz = quantize.row_quantizer("int8", ub)
+        np.testing.assert_array_equal(
+            qz(X[0]), quantize.quantize_features(X[:1], ub)[0])
+        bz = quantize.row_quantizer("bf16", None)
+        assert bz(X[0]).dtype == quantize.staging_dtype("bf16")
+
+
+# ---------------------------------------------------------------------------
+# ingest: int8 device matrices + host-quant streaming
+# ---------------------------------------------------------------------------
+
+
+class TestQuantizedIngest:
+    def test_int8_bin_dtype_device_matrix(self, rng):
+        X = rng.normal(size=(256, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        ds = LightGBMDataset.construct(X, y, max_bin=63, bin_dtype="int8")
+        assert str(ds.Xbt_d.dtype) == "int8"
+        with pytest.raises(ValueError):
+            LightGBMDataset.construct(X, y, max_bin=255, bin_dtype="int8")
+
+    def test_host_quant_streaming_parity(self, rng, tmp_path,
+                                         monkeypatch):
+        # MMLSPARK_TPU_INGEST_HOST_QUANT=1 bins chunks on the host and
+        # ships uint8 — the device matrix must be bit-identical to the
+        # default path's device-binned one
+        from mmlspark_tpu.models.gbdt.ingest import write_shards
+        X = rng.normal(size=(512, 5)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        xdir, ydir = str(tmp_path / "x"), str(tmp_path / "y")
+        write_shards(list(np.array_split(X, 4)), xdir)
+        write_shards(list(np.array_split(y, 4)), ydir)
+        monkeypatch.delenv("MMLSPARK_TPU_INGEST_HOST_QUANT", raising=False)
+        ds0 = LightGBMDataset.construct(path=xdir, label_path=ydir,
+                                        max_bin=63, chunk_rows=128)
+        monkeypatch.setenv("MMLSPARK_TPU_INGEST_HOST_QUANT", "1")
+        ds1 = LightGBMDataset.construct(path=xdir, label_path=ydir,
+                                        max_bin=63, chunk_rows=128)
+        assert str(ds1.Xbt_d.dtype) == str(ds0.Xbt_d.dtype)
+        assert bool((np.asarray(ds0.Xbt_d) ==
+                     np.asarray(ds1.Xbt_d)).all())
+
+
+# ---------------------------------------------------------------------------
+# bundles: quantized executables warm-start like f32 ones
+# ---------------------------------------------------------------------------
+
+
+class TestQuantizedBundle:
+    def test_int8_bundle_prewarm_zero_compile(self, binary, tmp_path):
+        from mmlspark_tpu.bundles.bundle import build_bundle, prewarm
+        from mmlspark_tpu.models.gbdt import booster as bmod
+        from mmlspark_tpu.observability import flight
+        b, X, _y = binary
+        model = str(tmp_path / "m.npz")
+        b.save(model)
+        out = str(tmp_path / "m.bundle")
+        man = build_bundle(model, out, batch_sizes=[8],
+                           predict_dtypes=("f32", "int8"))
+        assert sorted(e["predict_dtype"] for e in man["entries"]) == \
+            ["f32", "int8"]
+
+        bmod._PREDICT_CACHE.clear()
+        b2 = Booster.load(model)
+        res = prewarm(model, out, boosters=[b2])
+        assert res["entries_loaded"] == 2, res
+
+        def compiles():
+            return len([e for e in flight.events()
+                        if e.get("event") == "compile"])
+        n0 = compiles()
+        p_int8 = np.asarray(b2.predict(X[:8], predict_dtype="int8"))
+        p_f32 = np.asarray(b2.predict(X[:8]))
+        assert compiles() == n0, "prewarmed lane compiled anyway"
+        assert float(np.abs(p_int8 - p_f32).max()) < 0.01
+
+    def test_degraded_lane_dedupes_in_plan_enumeration(self, binary):
+        from mmlspark_tpu.models.gbdt.booster import iter_predict_plans
+        b, _X, _y = binary
+        txt = Booster.from_string(b.to_lightgbm_string())  # grid-less
+        metas = [meta for meta, _plan in iter_predict_plans(
+            txt, [8], dtypes=("f32", "int8"))]
+        assert all(m["predict_dtype"] == "f32" for m in metas)
+        assert len(metas) == 1, "degraded int8 plan did not dedupe"

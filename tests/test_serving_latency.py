@@ -148,6 +148,68 @@ def serving_model_latency_stats(n_seq=100, n_conc=4, conc_each=25):
         q.stop()
 
 
+def serving_async_model_latency_stats(predict_dtype=None, n_seq=100,
+                                      n_conc=4, conc_each=25):
+    """Async-engine model-in-loop latency on the zero-copy rows path —
+    requests decode straight into the slot table (quantized to the
+    lane's staging dtype when ``predict_dtype`` resolves to int8/bf16)
+    and the booster scores slot views with the matching predictor lane.
+    This is the serving configuration ``serving_main`` builds for a
+    booster model, so the bench's int8-admission rps comes from the
+    same code path production runs."""
+    from mmlspark_tpu.io.aserve import AsyncServingQuery, AsyncServingServer
+    from mmlspark_tpu.io.aserve.server import RowSpec
+    from mmlspark_tpu.models.gbdt import quantize
+    from mmlspark_tpu.models.gbdt.booster import train_booster
+    from mmlspark_tpu.models.gbdt.growth import GrowConfig
+
+    rng = np.random.default_rng(0)
+    F, max_batch = 8, 64
+    Xtr = rng.normal(size=(2000, F)).astype(np.float32)
+    ytr = (Xtr[:, 0] + Xtr[:, 1] > 0).astype(np.float32)
+    booster = train_booster(Xtr, ytr, objective="binary", num_iterations=10,
+                            cfg=GrowConfig(num_leaves=15), max_bin=63)
+    pdt = booster.resolved_predict_dtype(predict_dtype)
+    quantizer = quantize.row_quantizer(
+        pdt, quantize.feature_bounds(booster.binner_state)
+        if pdt == "int8" else None)
+    server = AsyncServingServer(
+        "localhost", 0, "bench_rows", slots=max_batch,
+        row_spec=RowSpec(F, extract="features",
+                         dtype=quantize.staging_dtype(pdt),
+                         quantizer=quantizer))
+    q = AsyncServingQuery(
+        server, scorer=lambda X: booster.predict(X, predict_dtype=pdt),
+        reply_fn=lambda req, p: {"y": float(p)}).start()
+    host, port = q.server.host, q.server.port
+    path = "/bench_rows"
+    payload = (b'{"features": ['
+               + b", ".join(b"0.5" for _ in range(F)) + b']}')
+    try:
+        _measure(host, port, path, 20, payload=payload)      # warm/compile
+        seq = _measure(host, port, path, n_seq, payload=payload)
+        results = []
+
+        def worker():
+            results.append(_measure(host, port, path, conc_each,
+                                    payload=payload))
+        threads = [threading.Thread(target=worker) for _ in range(n_conc)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return {
+            "p50_ms": float(np.percentile(seq, 50)),
+            "p99_ms": float(np.percentile(seq, 99)),
+            "concurrent_rps": float(n_conc * conc_each / wall),
+            "predict_dtype": pdt,
+        }
+    finally:
+        q.stop()
+
+
 def flaky(retries: int = 3):
     """Retry decorator for timing-sensitive tests (reference: the Flaky /
     TimeLimitedFlaky traits, core/test/base/TestBase.scala:43-72 — whole-test

@@ -12,6 +12,7 @@
 #   CI_SKIP_ASYNC=1 tools/ci_check.sh      # skip the async-serving smoke
 #   CI_SKIP_MULTICHIP=1 tools/ci_check.sh  # skip the 8-device dry run
 #   CI_SKIP_BUNDLE=1 tools/ci_check.sh     # skip the AOT-bundle smoke
+#   CI_SKIP_QUANT=1 tools/ci_check.sh      # skip the int8 quantized smoke
 #   CI_SKIP_ROOFLINE=1 tools/ci_check.sh   # skip the introspection smoke
 set -u -o pipefail
 
@@ -261,6 +262,107 @@ EOF
         :
     else
         echo "ci_check: bundle smoke FAILED" >&2
+        rc=1
+    fi
+fi
+
+# quantized smoke lane: the int8 end-to-end story in two processes —
+# offline build of a bundle carrying the int8 predict lane (from the
+# .npz native model, the format that keeps the binner grid), then a
+# worker pinned to MMLSPARK_TPU_PREDICT_DTYPE=int8 warm-starts from it
+# on the async rows path: /varz shows the pinned lane, the first
+# /predict answers, and the flight ring holds ZERO compile events.
+if [ "${CI_SKIP_QUANT:-0}" != "1" ]; then
+    if (cd "$ROOT" && env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+            python - <<'EOF'
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+from mmlspark_tpu.models.gbdt.booster import train_booster
+from mmlspark_tpu.models.gbdt.growth import GrowConfig
+
+env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+           MMLSPARK_TPU_PREDICT_DTYPE="int8")
+with tempfile.TemporaryDirectory() as d:
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 6)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    booster = train_booster(X=X, y=y, num_iterations=3, objective="binary",
+                            cfg=GrowConfig(num_leaves=7, min_data_in_leaf=5))
+    model = os.path.join(d, "model.npz")
+    booster.save(model)
+
+    # process 1: offline bundle build carrying the int8 lane
+    bundle = os.path.join(d, "model.bundle")
+    subprocess.run([sys.executable, "-m", "mmlspark_tpu.bundles", "build",
+                    "--model", model, "--out", bundle, "--max-batch", "8",
+                    "--predict-dtypes", "f32,int8"],
+                   env=env, check=True, timeout=300)
+    manifest = json.load(open(os.path.join(bundle, "MANIFEST.json")))
+    lanes = {e.get("predict_dtype") for e in manifest["entries"]}
+    assert "int8" in lanes, f"int8 lane missing from bundle: {lanes}"
+
+    # process 2: warm-start an int8-pinned async worker from the bundle
+    p = subprocess.Popen(
+        [sys.executable, "-m", "mmlspark_tpu.io.serving_main", "worker",
+         "--model", model, "--registry", os.path.join(d, "reg"),
+         "--host", "localhost", "--port", "0", "--max-batch", "8",
+         "--engine", "async", "--bundle", bundle],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    try:
+        line = p.stdout.readline()
+        m = re.search(r"serving on \S+:(\d+)", line)
+        assert m, f"no ready-line: {line!r}"
+        port = int(m.group(1))
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                with urllib.request.urlopen(
+                        f"http://localhost:{port}/healthz", timeout=5) as r:
+                    hz = json.loads(r.read())
+                if hz.get("ready"):
+                    break
+            except OSError:
+                pass
+            assert time.monotonic() < deadline, "worker never became ready"
+            time.sleep(0.05)
+        with urllib.request.urlopen(
+                f"http://localhost:{port}/varz", timeout=5) as r:
+            varz = json.loads(r.read())
+        pinned = (varz.get("config") or {}).get("predict_dtype")
+        assert pinned == "int8", f"/varz predict_dtype: {pinned!r}"
+        body = json.dumps({"features": [0.1] * 6}).encode()
+        with urllib.request.urlopen(urllib.request.Request(
+                f"http://localhost:{port}/serving", data=body,
+                method="POST"), timeout=10) as r:
+            reply = json.loads(r.read())
+            assert r.status == 200 and "prediction" in reply, reply
+        with urllib.request.urlopen(
+                f"http://localhost:{port}/debug/flight", timeout=5) as r:
+            ring = json.loads(r.read())
+        compiles = [e for e in ring["events"] if e.get("kind") == "compile"]
+        assert compiles == [], f"int8 warm start compiled: {compiles}"
+    finally:
+        p.send_signal(signal.SIGTERM)
+        p.wait(timeout=30)
+print("quantized smoke: int8 bundle built, int8-pinned worker "
+      "warm-started (predict_dtype on /varz), first predict with zero "
+      "compile events")
+EOF
+    ); then
+        :
+    else
+        echo "ci_check: quantized smoke FAILED" >&2
         rc=1
     fi
 fi

@@ -9,5 +9,6 @@ from . import cachekey       # noqa: F401
 from . import resources      # noqa: F401
 from . import locks          # noqa: F401
 from . import envvars        # noqa: F401
+from . import quantize       # noqa: F401
 from . import failpoints    # noqa: F401
 from . import asyncrules    # noqa: F401

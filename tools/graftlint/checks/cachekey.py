@@ -147,6 +147,46 @@ class ResolveBeforeCacheKey(Checker):
                 f"before the first cache-key construction "
                 f"(line {cache_ln})")
 
+        # same pin, second resolver: predict_plan is THE predictor-key
+        # site (booster hot path + bundle builder both call it), and the
+        # dtype lane must be resolved through the quantize funnel before
+        # the key tuple is assembled. Note the key here is a plain
+        # ``key = (...)`` assignment — _is_cache_key_construction only
+        # matches ``*cache_key*`` names / _CACHE subscripts, so the pin
+        # carries its own predicate.
+        pp = next((n for n in ast.walk(booster.tree)
+                   if isinstance(n, ast.FunctionDef)
+                   and n.name == "predict_plan"), None)
+        if pp is None:
+            raise CheckerRotError("predict_plan vanished from booster.py")
+
+        def is_dtype_resolver(n: ast.AST) -> bool:
+            return (isinstance(n, ast.Call)
+                    and call_name(n)[1] == "resolve_predict_dtype")
+
+        def is_key_assign(n: ast.AST) -> bool:
+            return (isinstance(n, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "key"
+                            for t in n.targets))
+
+        pp_key_ln = first_lineno(pp, is_key_assign)
+        pp_resolver_ln = first_lineno(pp, is_dtype_resolver)
+        if pp_key_ln is None:
+            raise CheckerRotError(
+                "predict_plan no longer assembles a key tuple — "
+                "anchored pin matches nothing")
+        if pp_resolver_ln is None:
+            yield self.finding(
+                booster, pp.lineno,
+                "predict_plan no longer resolves the predict dtype "
+                "(resolve_predict_dtype call missing) — an env-dependent "
+                "lane outside the key aliases quantized and f32 programs")
+        elif pp_resolver_ln >= pp_key_ln:
+            yield self.finding(
+                booster, pp_resolver_ln,
+                f"resolve_predict_dtype (line {pp_resolver_ln}) must run "
+                f"before predict_plan's key assembly (line {pp_key_ln})")
+
         gc = next((n for n in ast.walk(api.tree)
                    if isinstance(n, ast.FunctionDef)
                    and n.name == "_grow_config"), None)

@@ -1,0 +1,114 @@
+"""Quantize-funnel rule: predict-lane scale math only in quantize.py.
+
+The int8 predict lane is numerically safe for exactly one reason: every
+piece of its quantization arithmetic — the bin-grid ``searchsorted``
+that maps raw features onto the model's own binning grid, and the
+symmetric ``amax/127`` leaf-value scales — lives in ONE module
+(``models/gbdt/quantize.py``), where host and device encodings are
+pinned byte-identical to training. A second quantization site in the
+predict/serving/ingest path can drift off-by-one from the binner's
+strict-compare convention (``side="left"``) and silently route rows
+down the wrong subtree — wrong numerics with no crash.
+
+Matched idioms, over the predict-lane scope (``models/gbdt``, ``io``,
+``bundles``):
+
+* ``searchsorted(..., side="left")`` — the bin-grid convention every
+  quantization site in the repo spells explicitly. Non-grid uses
+  (shard-offset lookup ``side="right"`` in ingest, the weighted-median
+  ``searchsorted`` in objectives) don't match by construction.
+* division by the int8 symmetric-scale constant ``127`` and
+  ``clip(..., -127, 127)`` — leaf/scale math.
+
+``growth.py`` is allowlisted: its ``quantized_grad`` is the
+pre-existing TRAINING gradient-quantization funnel (int16 hist
+accumulators), a separate contract this rule must not fold in.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (Checker, CheckerRotError, Finding, Module, Repo,
+                    call_name, register)
+
+_QUANTIZE = "mmlspark_tpu/models/gbdt/quantize.py"
+
+#: predict/serving/ingest path the int8 lane flows through
+_SCOPE = ("mmlspark_tpu/models/gbdt", "mmlspark_tpu/io",
+          "mmlspark_tpu/bundles")
+
+#: sanctioned quantization sites: the funnel itself, and the training
+#: gradient-quantization funnel (a separate, pre-existing contract)
+_ALLOW = (_QUANTIZE, "mmlspark_tpu/models/gbdt/growth.py")
+
+
+def _is_grid_searchsorted(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    _qual, name = call_name(node)
+    if name != "searchsorted":
+        return False
+    return any(kw.arg == "side" and isinstance(kw.value, ast.Constant)
+               and kw.value.value == "left" for kw in node.keywords)
+
+
+def _is_scale_127(node: ast.AST) -> bool:
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div) and \
+            isinstance(node.right, ast.Constant) and \
+            node.right.value in (127, 127.0):
+        return True
+    if isinstance(node, ast.Call):
+        _qual, name = call_name(node)
+        if name == "clip":
+            consts = [a.value for a in ast.walk(node)
+                      if isinstance(a, ast.Constant)]
+            return 127 in consts or -127 in consts
+    return False
+
+
+class QuantizeFunnel(Checker):
+    rule = "quantize-funnel"
+    description = "predict-lane quantization math (bin-grid " \
+                  "searchsorted, int8 leaf scales) only in " \
+                  "models/gbdt/quantize.py"
+
+    def check(self, repo: Repo) -> Iterator[Finding]:
+        for mod in repo.under(*_SCOPE):
+            if mod.rel in _ALLOW:
+                continue
+            owner = mod.owner_map()
+            for node in ast.walk(mod.tree):
+                if _is_grid_searchsorted(node):
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"bin-grid searchsorted in {owner.get(node)}() — "
+                        "route through quantize.quantize_features / "
+                        "quantize_thresholds (a second grid site can "
+                        "drift off the binner's strict-compare "
+                        "convention and mis-route rows)")
+                elif _is_scale_127(node):
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"int8 scale math (127) in {owner.get(node)}() — "
+                        "route through quantize.quantize_leaves / "
+                        "dequantize_leaves_device (the symmetric-scale "
+                        "convention lives in one place)")
+        self._check_anchor(repo)
+
+    def _check_anchor(self, repo: Repo) -> None:
+        mod = repo.module(_QUANTIZE)
+        if mod is None:
+            raise CheckerRotError(f"{_QUANTIZE} is gone — the funnel "
+                                  "this rule guards was renamed away")
+        names = {n.name for n in ast.walk(mod.tree)
+                 if isinstance(n, ast.FunctionDef)}
+        for required in ("resolve_predict_dtype", "quantize_features",
+                         "quantize_leaves"):
+            if required not in names:
+                raise CheckerRotError(
+                    f"{required}() vanished from {_QUANTIZE}")
+
+
+register(QuantizeFunnel())
