@@ -104,6 +104,13 @@ def _dump_metrics_snapshot(leg: str, wall_start: float = 0.0) -> None:
             "roofline": roofline_payload(),
             "metrics": _obs_metrics.get_registry().snapshot(),
         }
+        # SLO verdicts + sampled tail timelines (tools/tail_report.py
+        # re-renders the attribution offline); both empty when no
+        # objective was configured for the bench run
+        from mmlspark_tpu.observability import slo as _obs_slo
+        from mmlspark_tpu.observability import tailsampler as _obs_tail
+        payload["slo"] = _obs_slo.snapshot_payload()
+        payload["tail"] = _obs_tail.snapshot_payload()
         with open(path, "w") as f:
             json.dump(payload, f, indent=2)
     except Exception as e:  # noqa: BLE001 — telemetry must not fail a bench
@@ -875,10 +882,20 @@ def _serving_latency() -> dict:
     s = best["threaded"]
     if s is None:
         return {}
+    # SLO-compliance keys per serving leg: measured p99 against the
+    # serving north-star objective (p99 < 25 ms — the p99-at-SLO
+    # yardstick of the Gemma-on-TPU serving comparison). margin_x > 1
+    # means the leg sits inside the objective, with that much headroom;
+    # the _x/_ms suffixes keep these outside bench_regression's rate
+    # gate (report-only), like every other secondary.
+    slo_target_ms = 25.0
     out = {"serving_p50_ms": round(s["p50_ms"], 3),
            "serving_p99_ms": round(s["p99_ms"], 3),
            "serving_concurrent_rps": round(s["concurrent_rps"], 1),
-           "serving_vs_1ms_claim": round(1.0 / max(s["p50_ms"], 1e-9), 2)}
+           "serving_vs_1ms_claim": round(1.0 / max(s["p50_ms"], 1e-9), 2),
+           "serving_slo_p99_target_ms": slo_target_ms,
+           "serving_slo_margin_x": round(
+               slo_target_ms / max(s["p99_ms"], 1e-9), 2)}
     a = best["async"]
     if a is not None:
         out["serving_p50_ms_async"] = round(a["p50_ms"], 3)
@@ -886,6 +903,8 @@ def _serving_latency() -> dict:
         out["serving_concurrent_rps_async"] = round(a["concurrent_rps"], 1)
         out["serving_async_vs_threaded_x"] = round(
             a["concurrent_rps"] / max(s["concurrent_rps"], 1e-9), 2)
+        out["serving_slo_margin_x_async"] = round(
+            slo_target_ms / max(a["p99_ms"], 1e-9), 2)
     # model-in-loop: compiled GBDT scoring each micro-batch. On TPU through
     # the tunnel this carries the ~67 ms round-trip floor per batch — the
     # honest accelerator-inclusive number (docs/performance.md caveat).

@@ -26,10 +26,10 @@ package rebuilds the request plane for the 100k+ RPS north star
   SIGTERM drain, and the ``serving.handle`` / ``serving.batch``
   failpoints — the gateway and the existing tests transfer unchanged.
 
-Engine selection: ``MMLSPARK_TPU_SERVING_ENGINE=threaded|async`` (the
-threaded stack stays the default until a bench round retires it),
-overridable per query via ``serve().engine(...)`` and per worker via
-``serving_main --engine``.
+Engine selection: ``MMLSPARK_TPU_SERVING_ENGINE=async|threaded`` — the
+async engine is the default (ROADMAP item 1: the threaded stack is
+deprecated and selecting it warns), overridable per query via
+``serve().engine(...)`` and per worker via ``serving_main --engine``.
 """
 
 from __future__ import annotations
@@ -38,29 +38,56 @@ import os
 from typing import Optional
 
 from ...observability import flight as _flight
+from ...observability import metrics as _metrics
+from ...observability.logging import get_logger
+
+logger = get_logger("mmlspark_tpu.io.aserve")
 
 ENGINE_ENV = "MMLSPARK_TPU_SERVING_ENGINE"
 ENGINES = ("threaded", "async")
+#: the engine every unconfigured process gets (flipped from "threaded"
+#: as ROADMAP item 1's first step; the threaded stack is deprecated)
+DEFAULT_ENGINE = "async"
+
+
+def _note_threaded_deprecated(source: str) -> None:
+    """Structured deprecation breadcrumbs for an explicit ``threaded``
+    selection: a warning through the log funnel plus the
+    ``serving_engine_deprecated_total`` counter, so a fleet rollout can
+    count how many workers still pin the legacy engine."""
+    _metrics.safe_counter("serving_engine_deprecated_total",
+                          engine="threaded", source=source).inc()
+    logger.warning("serving engine 'threaded' is deprecated; the async "
+                   "engine (continuous batching) is the default and the "
+                   "threaded stack will be retired — drop the explicit "
+                   "selection or migrate", engine="threaded",
+                   source=source, default=DEFAULT_ENGINE)
 
 
 def resolve_engine(requested: Optional[str] = None) -> str:
     """Resolve the serving engine before any server is built.
 
     An explicit ``requested`` value must be valid (a typo'd flag fails
-    loudly); the env-knob path degrades to ``threaded`` with a flight
+    loudly); the env-knob path degrades to the default with a flight
     event instead — an operator hint must not kill a worker at boot
-    (the ``resolve_hist_blocks`` idiom).
+    (the ``resolve_hist_blocks`` idiom). Either path selecting the
+    deprecated ``threaded`` engine leaves a structured warning.
     """
     if requested is not None:
         if requested not in ENGINES:
             raise ValueError(f"unknown serving engine {requested!r} "
                              f"(known: {list(ENGINES)})")
+        if requested == "threaded":
+            _note_threaded_deprecated("explicit")
         return requested
-    env = (os.environ.get(ENGINE_ENV, "") or "threaded").strip().lower()
+    env = (os.environ.get(ENGINE_ENV, "") or DEFAULT_ENGINE)
+    env = env.strip().lower()
     if env not in ENGINES:
-        _flight.record("serving_engine", decision="fallback_threaded",
+        _flight.record("serving_engine", decision="fallback_async",
                        requested=env)
-        return "threaded"
+        return DEFAULT_ENGINE
+    if env == "threaded":
+        _note_threaded_deprecated("env")
     return env
 
 
@@ -68,4 +95,4 @@ from .server import AsyncServingQuery, AsyncServingServer  # noqa: E402
 from .slots import SlotTable  # noqa: E402
 
 __all__ = ["AsyncServingQuery", "AsyncServingServer", "SlotTable",
-           "resolve_engine", "ENGINE_ENV", "ENGINES"]
+           "resolve_engine", "ENGINE_ENV", "ENGINES", "DEFAULT_ENGINE"]

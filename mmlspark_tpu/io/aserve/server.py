@@ -45,6 +45,7 @@ import numpy as np
 from ...core.dataset import Dataset
 from ...observability import flight as _flight
 from ...observability import metrics as _metrics
+from ...observability import slo as _slo
 from ...observability import spans as _spans
 from ...observability import tracing as _tracing
 from ...observability import watchdog as _watchdog
@@ -584,6 +585,9 @@ class AsyncServingServer:
                     t0_mono, req.enqueued_at, req.dispatched_at,
                     req.scored_at, time.monotonic())
                 observe_request_stages(api, stages)
+            _slo.observe_request(
+                api, dt, status, stages=stages,
+                trace_id=None if ctx is None else ctx.trace_id)
             _tracing.maybe_mark_slow("serving_request_seconds", dt,
                                      stages=stages, api=api)
             if token is not None:

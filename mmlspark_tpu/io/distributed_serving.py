@@ -42,6 +42,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..core.dataset import Dataset
 from ..observability import flight as _flight
 from ..observability import metrics as _metrics
+from ..observability import slo as _slo
 from ..observability import spans as _spans
 from ..observability import tracing as _tracing
 from ..observability.federation import MetricsFederator
@@ -268,6 +269,13 @@ class GatewayServer:
                     _metrics.safe_counter("gateway_responses_total",
                                           api=outer.api_name,
                                           code=str(status)).inc()
+                    # the gateway's own hop in the SLO plane: its sample
+                    # carries the same trace_id the worker hop deposits,
+                    # so /debug/tail reads stitch edge -> worker
+                    _slo.observe_request(
+                        outer.api_name, dt, status,
+                        trace_id=None if ctx is None else ctx.trace_id,
+                        hop="gateway")
                     _tracing.maybe_mark_slow("gateway_request_seconds",
                                              dt, api=outer.api_name)
                     if token is not None:

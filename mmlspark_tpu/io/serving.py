@@ -50,7 +50,9 @@ from ..observability import flight as _flight
 from ..observability import hbm as _hbm
 from ..observability import metrics as _metrics
 from ..observability import roofline as _roofline
+from ..observability import slo as _slo
 from ..observability import spans as _spans
+from ..observability import tailsampler as _tailsampler
 from ..observability import tracing as _tracing
 from ..observability import watchdog as _watchdog
 from ..observability.logging import get_logger
@@ -78,6 +80,11 @@ ROOFLINE_PATH = "/debug/roofline"
 #: fleet scale-pressure signal derived from federated queue telemetry
 #: (gateway; answers with a "no federation" note elsewhere)
 AUTOSCALE_PATH = "/debug/autoscale"
+#: declared objectives + multi-window error-budget burn (both engines;
+#: the gateway adds the federated per-worker burn view)
+SLO_PATH = "/debug/slo"
+#: bounded reservoir of objective-breaching request stage timelines
+TAIL_PATH = "/debug/tail"
 
 #: (route name, path) table shared by the serving server and the gateway
 DEBUG_ROUTES = (
@@ -88,6 +95,8 @@ DEBUG_ROUTES = (
     ("cluster", CLUSTER_PATH),
     ("roofline", ROOFLINE_PATH),
     ("autoscale", AUTOSCALE_PATH),
+    ("slo", SLO_PATH),
+    ("tail", TAIL_PATH),
 )
 
 
@@ -273,6 +282,12 @@ def debug_body(route: str, api_name: str,
                          "note": "no federation in this process (the "
                                  "autoscale signal lives on the "
                                  "distributed-serving gateway)"})
+    elif route == "slo":
+        payload = _slo.snapshot_payload()
+        if federation is not None:
+            payload["cluster"] = federation.slo_overview()
+    elif route == "tail":
+        payload = _tailsampler.snapshot_payload()
     else:
         payload = _flight.snapshot()
     return (json.dumps(payload, default=repr).encode("utf-8"),
@@ -604,6 +619,9 @@ class ServingServer:
                             t0_mono, req.enqueued_at, req.dispatched_at,
                             req.scored_at, time.monotonic())
                         observe_request_stages(outer.api_name, stages)
+                    _slo.observe_request(
+                        outer.api_name, dt, status, stages=stages,
+                        trace_id=None if ctx is None else ctx.trace_id)
                     _tracing.maybe_mark_slow("serving_request_seconds",
                                              dt, stages=stages,
                                              api=outer.api_name)

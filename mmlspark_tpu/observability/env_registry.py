@@ -135,6 +135,19 @@ REGISTRY: Tuple[EnvVar, ...] = (
            doc="`1` enables the per-boost-round telemetry callback — "
                "forces the host training loop, so the fused "
                "single-dispatch paths stay the default"),
+    # -- SLO plane / tail attribution --------------------------------------
+    EnvVar(name="MMLSPARK_TPU_SLO", default="(off)",
+           doc="per-endpoint serving objectives, `;`-separated "
+               "`endpoint:p99<25ms,err<0.1%` entries (`p<P><<T>ms|s` = "
+               "latency clause, `err<C%` = 5xx ceiling); drives the "
+               "`slo_burn_rate`/`slo_budget_remaining` gauges, "
+               "`/debug/slo`, and the tail sampler on both engines; a "
+               "malformed spec degrades to unconfigured with a flight "
+               "event (runtime: `slo.configure`)"),
+    EnvVar(name="MMLSPARK_TPU_TAIL_SAMPLES", default="128",
+           doc="tail-sampler reservoir capacity: how many objective-"
+               "breaching request timelines `/debug/tail` retains "
+               "(oldest evicted and counted in `dropped_total`)"),
     # -- roofline / device-memory ledgers ---------------------------------
     EnvVar(name="MMLSPARK_TPU_PEAK_FLOPS", default="(per-device_kind table)",
            doc="backend peak FLOP/s the roofline ledger computes "
@@ -226,14 +239,16 @@ REGISTRY: Tuple[EnvVar, ...] = (
            section="performance",
            doc="`1`/`true`/`yes` degrades every streaming adopter to the "
                "plain sequential loop (no background reader thread)"),
-    EnvVar(name="MMLSPARK_TPU_SERVING_ENGINE", default="threaded",
+    EnvVar(name="MMLSPARK_TPU_SERVING_ENGINE", default="async",
            section="performance",
            doc="serving engine behind `serve()` / `serving_main`: "
-               "`threaded` (ThreadingHTTPServer + get_batch windows) or "
                "`async` (io/aserve event loop, continuous batching, "
-               "zero-copy slot admission); `serve().engine(...)` and "
-               "`serving_main --engine` override; an unknown env value "
-               "degrades to `threaded` with a flight event"),
+               "zero-copy slot admission) or `threaded` (deprecated: "
+               "ThreadingHTTPServer + get_batch windows — selecting it "
+               "logs a structured warning and bumps "
+               "`serving_engine_deprecated_total`); `serve().engine(...)` "
+               "and `serving_main --engine` override; an unknown env "
+               "value degrades to `async` with a flight event"),
     EnvVar(name="MMLSPARK_TPU_BUNDLE_DIR", default="(off)",
            section="performance",
            doc="AOT serving-bundle directory `serving_main` workers "

@@ -405,18 +405,63 @@ class MetricsFederator:
             out[label] = sum(float(v) for _labels, v in rows)
         return out
 
+    def gauge_max_values(self, family: str,
+                         max_age: Optional[float] = None
+                         ) -> Dict[str, float]:
+        """Per-worker MAX across one gauge family's series from each
+        fresh scrape. The burn-rate fold reads ``slo_burn_rate`` this
+        way: a worker exports one series per (api, window) and summing
+        them (``gauge_values``' queue-depth semantics) would double a
+        breach just for having two windows."""
+        if max_age is None:
+            max_age = 3.0 * self.interval
+        now = time.time()
+        out: Dict[str, float] = {}
+        with self._lock:
+            states = list(self._workers.items())
+        for label, st in states:
+            if st.error is not None or not st.last_success:
+                continue
+            if now - st.last_success > max_age:
+                continue
+            fam = st.families.get(family)
+            if fam is None:
+                continue
+            kind, rows = fam
+            if kind == "histogram" or not rows:
+                continue
+            out[label] = max(float(v) for _labels, v in rows)
+        return out
+
+    def slo_overview(self) -> Dict[str, Any]:
+        """Federated SLO view for the gateway's ``/debug/slo``: each
+        worker's worst burn rate from its last scrape (any api, either
+        window) and the fleet maximum."""
+        burns = self.gauge_max_values("slo_burn_rate")
+        return {
+            "workers": {label: {"burn_rate_max": burns[label]}
+                        for label in sorted(burns)},
+            "max_burn_rate": max(burns.values()) if burns else None,
+            "note": "per-worker max slo_burn_rate from the federation "
+                    "sweep; absent workers export no SLO gauges (no "
+                    "objective configured or no scrape yet)",
+        }
+
     def autoscale_hint(self) -> Dict[str, Any]:
         """Scale-pressure signal from the fleet's own backpressure
         telemetry (ROADMAP item 1's observability half — the signal
         only, no supervisor acts on it here).
 
-        The hint is the mean queue depth per live worker from the last
-        sweep: ``0`` means the fleet absorbs arrivals as they come,
-        sustained ``>= 1`` means every worker carries standing backlog —
-        add capacity. Per-worker mean queue wait (histogram ``sum /
-        count`` from the same scrape) rides along so an operator can
-        tell deep-but-fast queues from genuinely slow ones. Also sets
-        the ``cluster_autoscale_hint`` gauge."""
+        Two feeds fold into one hint: the mean queue depth per live
+        worker (``0`` = arrivals absorbed as they come, sustained
+        ``>= 1`` = standing backlog on every worker) and the fleet's
+        worst SLO burn rate when it exceeds ``1.0`` — a fleet spending
+        error budget faster than it accrues is failing users even with
+        shallow queues, so user-visible pain raises the hint too. The
+        hint is the max of the two. Per-worker mean queue wait
+        (histogram ``sum / count`` from the same scrape) rides along so
+        an operator can tell deep-but-fast queues from genuinely slow
+        ones. Also sets the ``cluster_autoscale_hint`` gauge."""
         depths = self.gauge_values("serving_queue_depth")
         waits: Dict[str, Optional[float]] = {}
         with self._lock:
@@ -434,21 +479,33 @@ class MetricsFederator:
             waits[label] = mean
         live = len(depths)
         total_depth = sum(depths.values())
-        hint = (total_depth / live) if live else 0.0
+        queue_hint = (total_depth / live) if live else 0.0
+        burns = self.gauge_max_values("slo_burn_rate")
+        burn_max = max(burns.values()) if burns else None
+        # burn <= 1.0 is inside budget — only user-visible pain adds
+        # pressure beyond what the backlog already shows
+        slo_pressure = burn_max if (burn_max or 0.0) > 1.0 else 0.0
+        hint = max(queue_hint, slo_pressure)
         _metrics.safe_gauge("cluster_autoscale_hint").set(hint)
         observed = [w for w in waits.values() if w is not None]
+        workers = {label: {"queue_depth": depths[label],
+                           "queue_wait_mean_seconds": waits.get(label)}
+                   for label in sorted(depths)}
+        for label, burn in burns.items():
+            workers.setdefault(label, {})["slo_burn_rate_max"] = burn
         return {
             "hint": hint,
+            "queue_hint": queue_hint,
+            "slo_burn_rate_max": burn_max,
             "live_workers": live,
             "total_queue_depth": total_depth,
             "mean_queue_wait_seconds":
                 (sum(observed) / len(observed)) if observed else None,
-            "workers": {label: {"queue_depth": depths[label],
-                                "queue_wait_mean_seconds": waits.get(label)}
-                        for label in sorted(depths)},
-            "note": "mean queue depth per live worker; sustained >= 1 "
-                    "suggests adding capacity, 0 means arrivals are "
-                    "absorbed as they come (advisory only)",
+            "workers": workers,
+            "note": "max(mean queue depth per live worker, fleet-worst "
+                    "slo_burn_rate when > 1); sustained >= 1 suggests "
+                    "adding capacity, 0 means arrivals are absorbed "
+                    "within objectives (advisory only)",
         }
 
     # -- export --------------------------------------------------------------

@@ -27,7 +27,7 @@ from mmlspark_tpu.io import aserve
 from mmlspark_tpu.io.aserve import (AsyncServingQuery, AsyncServingServer,
                                     SlotTable, resolve_engine)
 from mmlspark_tpu.io.aserve.server import RowSpec
-from mmlspark_tpu.io.serving import ServingQuery, serve
+from mmlspark_tpu.io.serving import DEBUG_ROUTES, ServingQuery, serve
 from mmlspark_tpu.observability import flight, metrics
 from mmlspark_tpu.robustness import failpoints, policy
 
@@ -82,7 +82,9 @@ class TestEngineSelection:
     def test_explicit_and_default(self):
         assert resolve_engine("async") == "async"
         assert resolve_engine("threaded") == "threaded"
-        assert resolve_engine(None) == "threaded"
+        # the async engine is the default (ROADMAP item 1 first step)
+        assert resolve_engine(None) == "async"
+        assert aserve.DEFAULT_ENGINE == "async"
         with pytest.raises(ValueError):
             resolve_engine("uvloop")
 
@@ -96,12 +98,33 @@ class TestEngineSelection:
         finally:
             q.stop()
 
-    def test_bad_env_degrades_threaded_with_flight_event(self, monkeypatch):
+    def test_bad_env_degrades_async_with_flight_event(self, monkeypatch):
         monkeypatch.setenv(aserve.ENGINE_ENV, "turbo")
-        assert resolve_engine(None) == "threaded"
+        assert resolve_engine(None) == "async"
         assert any(e["kind"] == "serving_engine"
-                   and e["decision"] == "fallback_threaded"
+                   and e["decision"] == "fallback_async"
                    for e in flight.events())
+
+    def test_threaded_selection_is_deprecated(self, monkeypatch):
+        """Explicit threaded selection (arg or env) still works but
+        leaves a deprecation counter per selection path."""
+        def count(source):
+            return metrics.counter("serving_engine_deprecated_total",
+                                   engine="threaded",
+                                   source=source).value
+
+        before = count("explicit")
+        assert resolve_engine("threaded") == "threaded"
+        assert count("explicit") == before + 1
+        monkeypatch.setenv(aserve.ENGINE_ENV, "threaded")
+        before_env = count("env")
+        assert resolve_engine(None) == "threaded"
+        assert count("env") == before_env + 1
+        # the default path stays silent
+        monkeypatch.delenv(aserve.ENGINE_ENV, raising=False)
+        silent = count("explicit") + count("env")
+        assert resolve_engine(None) == "async"
+        assert count("explicit") + count("env") == silent
 
     def test_builder_engine_beats_env(self, monkeypatch):
         monkeypatch.setenv(aserve.ENGINE_ENV, "async")
@@ -584,6 +607,43 @@ class TestDebugRoutes:
             assert status == 200
         finally:
             q.stop()
+
+    def test_engine_metric_family_and_route_parity(self):
+        """Drift guard (PR 13 found a double-count bug exactly this
+        way): identical traffic through both engines must surface the
+        identical set of metric FAMILY names on /metrics, and every
+        DEBUG_ROUTES path must answer 200 on both."""
+        import re as _re
+
+        def drive(engine):
+            q = (serve().address("localhost", 0, "par").batch(8, 5)
+                 .engine(engine).transform(_echo_transform).start())
+            host, port = q.server.host, q.server.port
+            try:
+                # families accumulated from traffic only — boot-time
+                # one-offs (engine deprecation counters) are not part
+                # of the request-plane contract
+                metrics.reset()
+                status, _, _ = _request(host, port, "/par", b'{"i": 1}')
+                assert status == 200
+                routes = {}
+                for name, path in DEBUG_ROUTES:
+                    status, _, _ = _request(host, port, path)
+                    routes[name] = status
+                status, body, _ = _request(host, port, "/metrics")
+                assert status == 200
+                fams = set(_re.findall(r"^# TYPE ([a-z_]+) ",
+                                       body.decode(), _re.M))
+            finally:
+                q.stop()
+            return fams, routes
+
+        t_fams, t_routes = drive("threaded")
+        a_fams, a_routes = drive("async")
+        ok = {name: 200 for name, _ in DEBUG_ROUTES}
+        assert t_routes == ok and a_routes == ok, (t_routes, a_routes)
+        assert t_fams == a_fams, \
+            f"family drift between engines: {sorted(t_fams ^ a_fams)}"
 
     def test_disabled_metrics_reclaims_the_path(self):
         q = _echo_query("off")

@@ -10,6 +10,7 @@ registry coordinating across processes.
 import http.client
 import json
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -178,6 +179,13 @@ def test_distributed_real_model_concurrent():
         for t in threads:
             t.join()
         assert not errs, errs
+        # the async engine resolves replies from the scoring thread a
+        # beat before bumping requests_served, so the last client can
+        # return before the counter converges — poll, then pin exactly
+        deadline = time.monotonic() + 5.0
+        while (sum(q.requests_served for q in d.workers) < 40
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
         assert sum(q.requests_served for q in d.workers) == 40
     finally:
         d.stop()

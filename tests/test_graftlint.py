@@ -1057,6 +1057,69 @@ def test_failpoint_site_grammar_rot(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# debug-route-registry
+# --------------------------------------------------------------------------
+
+#: the anchor the rule parses: string-constant indirection plus inline
+#: literals, exactly serving.py's table shape
+_DEBUG_ROUTES_OK = """\
+    METRICS_PATH = "/debug/metrics"
+    SLO_PATH = "/debug/slo"
+
+    DEBUG_ROUTES = (
+        ("metrics", METRICS_PATH),
+        ("slo", SLO_PATH),
+        ("flight", "/debug/flight"),
+    )
+"""
+
+
+class TestDebugRouteRegistry:
+    def test_undeclared_route_literal_flagged(self, tmp_path):
+        active, suppressed = run_rule(tmp_path, "debug-route-registry", {
+            "mmlspark_tpu/io/serving.py": _DEBUG_ROUTES_OK,
+            "mmlspark_tpu/io/aserve/server.py": """\
+                def handle(path):
+                    if path == "/debug/flight":      # declared: fine
+                        return b"{}"
+                    if path == "/debug/slo/":        # trailing /: declared
+                        return b"{}"
+                    if path == "/debug/rogue":       # not in the table
+                        return b"{}"
+                    if path == "/debug/rogue2":  # graftlint: disable=debug-route-registry (test)
+                        return b"{}"
+                    return None
+            """})
+        got = hits(active, "debug-route-registry",
+                   "mmlspark_tpu/io/aserve/server.py")
+        assert [f.line for f in got] == [6], active
+        assert "DEBUG_ROUTES" in got[0].message
+        assert [f.line for f in suppressed] == [8]
+
+    def test_outside_io_and_docstrings_clean(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "debug-route-registry", {
+            "mmlspark_tpu/io/serving.py": _DEBUG_ROUTES_OK,
+            # tools/monitoring prose may name any route; only io/ is the
+            # serving plane the funnel contract binds
+            "mmlspark_tpu/observability/federation.py": """\
+                SCRAPE = "/debug/undeclared_elsewhere"
+            """,
+            "mmlspark_tpu/io/distributed_serving.py": """\
+                def scrape(worker):
+                    return worker + "/debug/metrics"
+            """})
+        assert not hits(active, "debug-route-registry"), active
+
+    def test_rots_when_table_vanishes(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "debug-route-registry", {
+            "mmlspark_tpu/io/serving.py": """\
+                ROUTES = {"metrics": "/debug/metrics"}
+            """})
+        got = hits(active, "debug-route-registry", "<graftlint>")
+        assert len(got) == 1 and "lint-rot" in got[0].message, active
+
+
+# --------------------------------------------------------------------------
 # infrastructure
 # --------------------------------------------------------------------------
 

@@ -1,0 +1,341 @@
+"""SLO plane: objectives grammar, burn-rate windows, tail attribution.
+
+The acceptance scenario end-to-end: a deliberately tight objective +
+synthetic overload on BOTH serving engines must trip the fast-window
+burn rate past 1.0, surface the breach on ``/debug/slo``, deposit
+stage timelines on ``/debug/tail`` whose sums reconcile (±5%) with the
+request's end-to-end latency, and let ``tools/tail_report.py`` name
+the dominant stage. Plus the contracts around it: the grammar rejects
+malformed specs loudly (env path degrades with a flight event), an
+unconfigured process stays a no-op, and the gateway's federation sweep
+folds the fleet-worst burn into ``cluster_autoscale_hint``.
+"""
+
+import http.client
+import json
+import os
+import sys
+import time
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from mmlspark_tpu.io.serving import DEBUG_ROUTES, debug_body, serve
+from mmlspark_tpu.observability import flight, metrics
+from mmlspark_tpu.observability import slo, tailsampler
+from mmlspark_tpu.observability.federation import (MetricsFederator,
+                                                   parse_prometheus_text)
+from tools import tail_report
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(slo.SLO_ENV, raising=False)
+    prev = metrics.set_enabled(True)
+    metrics.reset()
+    flight.clear()
+    slo.reset()
+    tailsampler.reset()
+    yield
+    slo.reset()
+    tailsampler.reset()
+    metrics.set_enabled(prev)
+    metrics.reset()
+    flight.clear()
+
+
+# ---------------------------------------------------------------------------
+# Grammar
+# ---------------------------------------------------------------------------
+
+
+class TestGrammar:
+    def test_full_spec(self):
+        objs = slo.parse_spec("predict:p99<25ms,err<0.1%;embed:p95<5ms")
+        assert set(objs) == {"predict", "embed"}
+        p = objs["predict"]
+        assert p.percentile == 99.0
+        assert p.threshold_seconds == pytest.approx(0.025)
+        assert p.error_ceiling == pytest.approx(0.001)
+        assert p.latency_budget == pytest.approx(0.01)
+        e = objs["embed"]
+        assert e.threshold_seconds == pytest.approx(0.005)
+        assert e.error_ceiling is None
+
+    def test_seconds_unit_and_error_only(self):
+        objs = slo.parse_spec("train:p50<2s; audit:err<5%")
+        assert objs["train"].threshold_seconds == pytest.approx(2.0)
+        assert objs["audit"].percentile is None
+        assert objs["audit"].error_ceiling == pytest.approx(0.05)
+
+    @pytest.mark.parametrize("bad", [
+        "predict",                       # no clauses / no colon
+        "predict:",                      # empty clause list
+        "predict:p99<25parsecs",         # unknown unit
+        "predict:q99<25ms",              # unknown clause
+        "predict:p99<25ms,p50<1ms",      # two latency clauses
+        "predict:err<0.1%,err<2%",       # two error clauses
+        "predict:p0<25ms",               # percentile out of range
+        "predict:err<200%",              # ceiling out of range
+        "a:p99<1ms;a:p99<2ms",           # duplicate endpoint
+    ])
+    def test_malformed_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            slo.parse_spec(bad)
+
+    def test_env_adoption_and_degrade(self, monkeypatch):
+        monkeypatch.setenv(slo.SLO_ENV, "predict:p99<25ms")
+        slo.reset()
+        assert slo.configured()
+        assert "predict" in slo.objectives()
+        # malformed env degrades to unconfigured with a flight event —
+        # an operator typo must not kill a worker at boot
+        monkeypatch.setenv(slo.SLO_ENV, "predict:zzz")
+        slo.reset()
+        flight.clear()
+        assert not slo.configured()
+        assert any(e["kind"] == "slo_config"
+                   and e["decision"] == "rejected"
+                   for e in flight.events())
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate windows
+# ---------------------------------------------------------------------------
+
+
+class TestBurnRate:
+    def test_unconfigured_is_a_noop(self):
+        """No SLO -> observe_request leaves zero trace: no gauges, no
+        counters, no reservoir entries (byte-identical contract)."""
+        assert not slo.configured()
+        slo.observe_request("predict", 9.0, 500,
+                            stages={"score": 9.0}, trace_id="t")
+        snap = metrics.get_registry().snapshot()
+        assert not any(k.startswith(("slo_", "tail_")) for k in snap)
+        assert tailsampler.snapshot_payload()["samples"] == []
+        assert slo.snapshot_payload()["configured"] is False
+
+    def test_latency_burn_and_budget(self):
+        slo.configure("predict:p99<10ms")
+        # 100 requests, 10 over threshold: bad fraction 0.1 against a
+        # 1% budget -> burn 10x on both windows
+        for i in range(100):
+            slow = i < 10
+            slo.observe_request("predict", 0.5 if slow else 0.001, 200)
+        slo.refresh()
+        payload = slo.snapshot_payload()
+        for window in ("fast5m", "slow1h"):
+            v = payload["endpoints"]["predict"]["windows"][window]
+            assert v["requests"] == 100
+            assert v["slow"] == 10
+            assert v["burn_rate"] == pytest.approx(10.0)
+            assert v["budget_remaining"] == 0.0
+            assert metrics.gauge("slo_burn_rate", api="predict",
+                                 window=window).value \
+                == pytest.approx(10.0)
+        assert payload["endpoints"]["predict"]["breaching"] is True
+        assert metrics.counter("slo_breach_total", api="predict",
+                               signal="latency").value == 10.0
+
+    def test_error_burn(self):
+        slo.configure("predict:err<10%")
+        for i in range(20):
+            slo.observe_request("predict", 0.001, 503 if i < 2 else 200)
+        v = slo.snapshot_payload()["endpoints"]["predict"]["windows"]
+        # 2/20 errors on a 10% ceiling: burning exactly at budget
+        assert v["fast5m"]["error_burn"] == pytest.approx(1.0)
+        assert v["fast5m"]["burn_rate"] == pytest.approx(1.0)
+        assert v["fast5m"]["budget_remaining"] == pytest.approx(0.0)
+
+    def test_within_objective_no_breach(self):
+        slo.configure("predict:p99<10ms,err<50%")
+        for _ in range(50):
+            slo.observe_request("predict", 0.001, 200)
+        payload = slo.snapshot_payload()
+        v = payload["endpoints"]["predict"]["windows"]["fast5m"]
+        assert v["burn_rate"] == 0.0
+        assert v["budget_remaining"] == 1.0
+        assert payload["endpoints"]["predict"]["breaching"] is False
+        assert tailsampler.snapshot_payload()["samples"] == []
+
+    def test_unlisted_endpoint_ignored(self):
+        slo.configure("predict:p99<1ms")
+        slo.observe_request("other_api", 9.0, 200)
+        assert "other_api" not in slo.snapshot_payload()["endpoints"]
+        assert tailsampler.snapshot_payload()["samples"] == []
+
+
+# ---------------------------------------------------------------------------
+# Tail sampler
+# ---------------------------------------------------------------------------
+
+
+class TestTailSampler:
+    def test_reservoir_bounds_and_eviction(self, monkeypatch):
+        monkeypatch.setenv(tailsampler.TAIL_SAMPLES_ENV, "4")
+        tailsampler.reset()
+        for i in range(7):
+            tailsampler.sample("api", 0.1 + i, 200,
+                               stages={"score": 0.1 + i},
+                               trace_id=f"t{i}")
+        p = tailsampler.snapshot_payload()
+        assert p["capacity"] == 4
+        assert len(p["samples"]) == 4
+        assert p["sampled_total"] == 7
+        assert p["dropped_total"] == 3
+        # most recent survive
+        assert [s["trace_id"] for s in p["samples"]] \
+            == ["t3", "t4", "t5", "t6"]
+
+    def test_attribution_names_dominant_stage(self):
+        for _ in range(3):
+            tailsampler.sample("api", 0.05, 200, stages={
+                "admission": 0.001, "forming_wait": 0.036,
+                "score": 0.012, "write": 0.001})
+        attr = tailsampler.snapshot_payload()["attribution"]
+        assert attr["dominant_stage"] == "forming_wait"
+        assert attr["stage_share_pct"]["forming_wait"] \
+            == pytest.approx(72.0)
+
+    def test_breach_feeds_sampler_with_trace(self):
+        slo.configure("predict:p99<1ms")
+        slo.observe_request("predict", 0.2, 200,
+                            stages={"score": 0.19, "write": 0.01},
+                            trace_id="abc123")
+        s = tailsampler.snapshot_payload()["samples"]
+        assert len(s) == 1
+        assert s[0]["trace_id"] == "abc123"
+        assert s[0]["breach"] == "latency"
+        assert s[0]["dominant_stage"] == "score"
+        assert metrics.counter("tail_samples_total", api="predict",
+                               breach="latency").value == 1.0
+
+
+# ---------------------------------------------------------------------------
+# End-to-end on both engines
+# ---------------------------------------------------------------------------
+
+
+def _request(host, port, path, body=None, timeout=30):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    conn.request("POST" if body is not None else "GET", path, body=body)
+    r = conn.getresponse()
+    payload = r.read()
+    conn.close()
+    return r.status, payload
+
+
+@pytest.mark.parametrize("engine", ["threaded", "async"])
+def test_overload_breach_end_to_end(engine):
+    """Synthetic overload vs a tight objective on a live engine: burn
+    trips past 1.0 within the fast window, /debug/slo reports the
+    breach, /debug/tail holds timelines whose stage sums reconcile
+    (±5%) with the end-to-end latency, and tail_report names the
+    dominant stage (the sleeping transform makes it `score`)."""
+    def slow_echo(ds):
+        time.sleep(0.03)                     # every request breaches
+        return ds.with_column("reply", [
+            {"entity": {"i": (v or {}).get("i")}, "statusCode": 200}
+            for v in ds["value"]])
+
+    slo.configure("slo_e2e:p99<5ms")
+    q = (serve().address("localhost", 0, "slo_e2e").batch(4, 2)
+         .engine(engine).transform(slow_echo).start())
+    host, port = q.server.host, q.server.port
+    try:
+        for i in range(6):
+            status, _ = _request(host, port, "/slo_e2e",
+                                 json.dumps({"i": i}).encode())
+            assert status == 200
+        status, body = _request(host, port, "/debug/slo")
+        assert status == 200
+        page = json.loads(body)
+        ep = page["endpoints"]["slo_e2e"]
+        assert ep["breaching"] is True
+        assert ep["windows"]["fast5m"]["burn_rate"] > 1.0
+        # the gauge tripped too (snapshot re-exports)
+        assert metrics.gauge("slo_burn_rate", api="slo_e2e",
+                             window="fast5m").value > 1.0
+        status, body = _request(host, port, "/debug/tail")
+        assert status == 200
+        tail = json.loads(body)
+        timed = [s for s in tail["samples"] if s["stages"]]
+        assert timed, tail
+        for s in timed:
+            # stage decomposition partitions the request wall time
+            assert s["stage_sum_seconds"] \
+                == pytest.approx(s["seconds"], rel=0.05)
+            assert s["trace_id"]
+        assert tail["attribution"]["dominant_stage"] == "score"
+        rendered = tail_report.render_text(tail)
+        assert "tail is" in rendered and "score" in rendered
+        assert "roofline" in rendered        # the remediation hint
+    finally:
+        q.stop()
+
+
+def test_slo_and_tail_ride_the_debug_funnel():
+    """Both routes are in DEBUG_ROUTES and debug_body renders them —
+    the single-funnel contract that keeps engines from drifting."""
+    paths = dict(DEBUG_ROUTES)
+    assert paths["slo"] == "/debug/slo"
+    assert paths["tail"] == "/debug/tail"
+    body, ctype = debug_body("slo", "api")
+    assert ctype == "application/json"
+    assert json.loads(body)["configured"] is False
+    body, _ = debug_body("tail", "api")
+    assert json.loads(body)["samples"] == []
+
+
+# ---------------------------------------------------------------------------
+# Federation fold
+# ---------------------------------------------------------------------------
+
+
+class TestFederationFold:
+    def _fed_with(self, exposition):
+        fed = MetricsFederator(lambda: [], interval=1.0)
+        st = fed._worker("w1")
+        st.families = parse_prometheus_text(exposition)
+        st.last_success = time.time()
+        return fed
+
+    def test_burn_raises_autoscale_hint(self):
+        fed = self._fed_with(
+            "# TYPE serving_queue_depth gauge\n"
+            'serving_queue_depth{api="a"} 0\n'
+            "# TYPE slo_burn_rate gauge\n"
+            'slo_burn_rate{api="a",window="fast5m"} 40\n'
+            'slo_burn_rate{api="a",window="slow1h"} 2\n')
+        hint = fed.autoscale_hint()
+        # max across series, NOT their sum (42 would double-count the
+        # same breach across windows)
+        assert hint["slo_burn_rate_max"] == 40.0
+        assert hint["hint"] == 40.0 and hint["queue_hint"] == 0.0
+        assert hint["workers"]["w1"]["slo_burn_rate_max"] == 40.0
+        assert metrics.gauge("cluster_autoscale_hint").value == 40.0
+        over = fed.slo_overview()
+        assert over["max_burn_rate"] == 40.0
+        assert over["workers"]["w1"]["burn_rate_max"] == 40.0
+
+    def test_burn_within_budget_adds_no_pressure(self):
+        fed = self._fed_with(
+            "# TYPE serving_queue_depth gauge\n"
+            'serving_queue_depth{api="a"} 2\n'
+            "# TYPE slo_burn_rate gauge\n"
+            'slo_burn_rate{api="a",window="fast5m"} 0.5\n')
+        hint = fed.autoscale_hint()
+        assert hint["slo_burn_rate_max"] == 0.5
+        assert hint["hint"] == 2.0           # queue depth only
+
+    def test_gateway_debug_slo_carries_cluster_view(self):
+        fed = self._fed_with(
+            "# TYPE slo_burn_rate gauge\n"
+            'slo_burn_rate{api="a",window="fast5m"} 3\n')
+        body, _ = debug_body("slo", "gw", federation=fed)
+        page = json.loads(body)
+        assert page["cluster"]["max_burn_rate"] == 3.0

@@ -238,6 +238,63 @@ class TestBenchRegression:
                           threshold=0.2) == []
 
 
+class TestRooflineTrend:
+    """tools/roofline_report.py in multi-round mode renders the measured
+    ``*_roofline_pct`` keys as a trend table across BENCH_r*.json driver
+    wrappers (report-only — bench_regression's gate ignores these keys)."""
+
+    def _write_round(self, d, n, line):
+        (d / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+            "n": n, "rc": 0,
+            "tail": "noise line\n" + json.dumps(line) + "\n"}))
+
+    def _run(self, *paths):
+        return subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "roofline_report.py"),
+             *[str(p) for p in paths]],
+            capture_output=True, text=True, timeout=60)
+
+    def test_trend_across_rounds(self, tmp_path):
+        self._write_round(tmp_path, 1, {
+            "metric": "m", "value": 1.0,
+            "gbdt_predict_roofline_pct": 40.2,
+            "serving_score_roofline_pct": 12.5})
+        # CPU leg: peaks unknown, keys absent by design -> "-" cells
+        self._write_round(tmp_path, 2, {"metric": "m_CPU", "value": 0.1})
+        self._write_round(tmp_path, 3, {
+            "metric": "m", "value": 1.1,
+            "gbdt_predict_roofline_pct": 46.5})
+        r = self._run(tmp_path / "BENCH_r01.json",
+                      tmp_path / "BENCH_r02.json",
+                      tmp_path / "BENCH_r03.json")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "roofline %-of-peak trend" in r.stdout
+        row = next(ln for ln in r.stdout.splitlines()
+                   if ln.startswith("gbdt_predict_roofline_pct"))
+        assert "40.2%" in row and "46.5%" in row and "-" in row
+        assert "+6.30pp" in row
+        # serving key present in one round only: no trend arithmetic
+        row = next(ln for ln in r.stdout.splitlines()
+                   if ln.startswith("serving_score_roofline_pct"))
+        assert row.rstrip().endswith("-")
+
+    def test_rounds_without_keys_render_honest_message(self, tmp_path):
+        self._write_round(tmp_path, 1, {"metric": "cpu", "value": 1.0})
+        self._write_round(tmp_path, 2, {"metric": "cpu", "value": 1.0})
+        r = self._run(tmp_path / "BENCH_r01.json",
+                      tmp_path / "BENCH_r02.json")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "no *_roofline_pct keys" in r.stdout
+
+    def test_single_wrapper_falls_back_to_one_column(self, tmp_path):
+        self._write_round(tmp_path, 1, {
+            "metric": "m", "value": 1.0,
+            "gbdt_predict_roofline_pct": 33.0})
+        r = self._run(tmp_path / "BENCH_r01.json")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "33%" in r.stdout
+
+
 def test_docker_tree_well_formed():
     for rel in ("docker/minimal/Dockerfile", "docker/serving/Dockerfile"):
         text = open(os.path.join(TOOLS, rel)).read()

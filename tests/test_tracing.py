@@ -393,22 +393,41 @@ class TestServingEdge:
         families = set(metrics.get_registry().snapshot())
         assert families <= {"serving_queue_depth"}, families
 
-    def test_unknown_reply_counted(self, serving_query):
-        server = serving_query.server
-        assert not server.reply("no_such_request", {"y": 0})
-        assert metrics.counter("serving_reply_unknown_total",
-                               api="traced").value == 1.0
-        assert any(e["kind"] == "reply_unknown"
-                   and e["request_id"] == "no_such_request"
-                   for e in flight.events())
+    def test_unknown_reply_counted(self):
+        # reply-by-id is the threaded stack's out-of-band API (the async
+        # engine counts unknown ids on its scorer path — test_aserve), so
+        # this test pins the engine instead of riding the default
+        from mmlspark_tpu.io.serving import serve
+
+        q = (serve().address("localhost", 0, "traced")
+             .batch(max_batch=8, max_latency_ms=5).engine("threaded")
+             .transform(_echo_transform).start())
+        try:
+            assert not q.server.reply("no_such_request", {"y": 0})
+            assert metrics.counter("serving_reply_unknown_total",
+                                   api="traced").value == 1.0
+            assert any(e["kind"] == "reply_unknown"
+                       and e["request_id"] == "no_such_request"
+                       for e in flight.events())
+        finally:
+            q.stop()
 
     def test_slow_request_exemplar_from_live_request(self, serving_query):
         tracing.set_slow_threshold(0.0)      # every request is "slow"
         host, port = serving_query.server.host, serving_query.server.port
         _post(host, port, "/traced", {"x": 1.0},
               {"traceparent": TRACEPARENT})
-        exs = [e for e in tracing.get_exemplars()
-               if e["metric"] == "serving_request_seconds"]
+
+        # polled: the reply reaches the client a beat before the
+        # handler's finally records the exemplar
+        def exemplars():
+            return [e for e in tracing.get_exemplars()
+                    if e["metric"] == "serving_request_seconds"]
+
+        deadline = time.monotonic() + 5
+        while not exemplars() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        exs = exemplars()
         assert exs and exs[-1]["trace_id"] == TRACE_ID
 
 

@@ -14,6 +14,7 @@
 #   CI_SKIP_BUNDLE=1 tools/ci_check.sh     # skip the AOT-bundle smoke
 #   CI_SKIP_QUANT=1 tools/ci_check.sh      # skip the int8 quantized smoke
 #   CI_SKIP_ROOFLINE=1 tools/ci_check.sh   # skip the introspection smoke
+#   CI_SKIP_SLO=1 tools/ci_check.sh        # skip the SLO-breach smoke
 set -u -o pipefail
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -64,9 +65,13 @@ def pattern(seed):
 
 assert pattern(11) == pattern(11), "seeded chaos did not replay"
 
-# live smoke: one injected 503 at admission, then clean recovery
+# live smoke: one injected 503 at admission, then clean recovery.
+# Pinned to the deprecated threaded engine on purpose — the async lane
+# below covers the default engine, and the threaded stack keeps chaos
+# coverage until it is retired.
 failpoints.configure("serving.handle:error_503@1", seed=11)
 q = (serve().address("localhost", 0, "ci_chaos").batch(8, 5)
+     .engine("threaded")
      .transform(lambda ds: ds.with_column("reply", [
          {"entity": {"i": v["i"]}, "statusCode": 200}
          for v in ds["value"]])).start())
@@ -464,6 +469,107 @@ EOF
     fi
 fi
 
+# SLO smoke lane: boot a live serving_main worker with a deliberately
+# tight objective (every request breaches p99<0.01ms), drive traffic past
+# it, and assert the SLO plane closed the loop — the slo_burn_rate gauge
+# trips past 1.0, /debug/slo reports the breach, and /debug/tail holds at
+# least one sampled stage timeline naming the dominant stage.
+if [ "${CI_SKIP_SLO:-0}" != "1" ]; then
+    if (cd "$ROOT" && env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+            MMLSPARK_TPU_SLO="serving:p99<0.01ms,err<1%" \
+            python - <<'EOF'
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+from mmlspark_tpu.models.gbdt.booster import train_booster
+from mmlspark_tpu.models.gbdt.growth import GrowConfig
+
+env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+           MMLSPARK_TPU_SLO="serving:p99<0.01ms,err<1%")
+with tempfile.TemporaryDirectory() as d:
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 6)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    booster = train_booster(X=X, y=y, num_iterations=3, objective="binary",
+                            cfg=GrowConfig(num_leaves=7, min_data_in_leaf=5))
+    model = os.path.join(d, "model.txt")
+    with open(model, "w") as f:
+        f.write(booster.model_string())
+
+    p = subprocess.Popen(
+        [sys.executable, "-m", "mmlspark_tpu.io.serving_main", "worker",
+         "--model", model, "--registry", os.path.join(d, "reg"),
+         "--host", "localhost", "--port", "0", "--max-batch", "8"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    try:
+        line = p.stdout.readline()
+        m = re.search(r"serving on \S+:(\d+)", line)
+        assert m, f"no ready-line: {line!r}"
+        port = int(m.group(1))
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                with urllib.request.urlopen(
+                        f"http://localhost:{port}/healthz", timeout=5) as r:
+                    hz = json.loads(r.read())
+                if hz.get("ready"):
+                    break
+            except OSError:
+                pass
+            assert time.monotonic() < deadline, "worker never became ready"
+            time.sleep(0.05)
+        body = json.dumps({"features": [0.1] * 6}).encode()
+        for _ in range(10):
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"http://localhost:{port}/serving", data=body,
+                    method="POST"), timeout=30) as r:
+                assert r.status == 200, r.status
+        with urllib.request.urlopen(
+                f"http://localhost:{port}/debug/slo", timeout=5) as r:
+            slo = json.loads(r.read())
+        ep = (slo.get("endpoints") or {}).get("serving")
+        assert ep, f"no 'serving' endpoint in /debug/slo: {slo}"
+        fast = ep["windows"]["fast5m"]
+        assert ep["breaching"] and fast["burn_rate"] > 1.0, ep
+        with urllib.request.urlopen(
+                f"http://localhost:{port}/metrics", timeout=5) as r:
+            metrics_text = r.read().decode()
+        burns = [float(v) for v in re.findall(
+            r'slo_burn_rate\{[^}]*window="fast5m"[^}]*\} (\S+)',
+            metrics_text)]
+        assert burns and max(burns) > 1.0, \
+            f"slo_burn_rate gauge never tripped: {burns}"
+        with urllib.request.urlopen(
+                f"http://localhost:{port}/debug/tail", timeout=5) as r:
+            tail = json.loads(r.read())
+        timed = [s for s in tail.get("samples", []) if s.get("stages")]
+        assert timed, f"no sampled stage timelines: {tail}"
+        dom = tail["attribution"]["dominant_stage"]
+        assert dom in ("admission", "forming_wait", "score", "write"), dom
+    finally:
+        p.send_signal(signal.SIGTERM)
+        p.wait(timeout=30)
+print(f"SLO smoke: burn_rate={max(burns):.1f} (>1), "
+      f"{len(timed)} sampled timeline(s), dominant stage {dom}")
+EOF
+    ); then
+        :
+    else
+        echo "ci_check: SLO smoke FAILED" >&2
+        rc=1
+    fi
+fi
+
 # dryrun_multichip lane: the cross-device-count tree-identity suite on a
 # virtual 8-device CPU mesh (xla_force_host_platform_device_count) — the
 # full histogram-engine matrix, including the tiers tier-1 deselects as
@@ -482,7 +588,7 @@ if [ "${CI_SKIP_MULTICHIP:-0}" != "1" ]; then
 fi
 
 if [ "$rc" -ne 0 ]; then
-    echo "ci_check: FAILED (graftlint findings, env-docs drift, chaos/async/bundle/roofline smoke, or multichip dry run)" >&2
+    echo "ci_check: FAILED (graftlint findings, env-docs drift, chaos/async/bundle/roofline/SLO smoke, or multichip dry run)" >&2
 else
     echo "ci_check: clean"
 fi

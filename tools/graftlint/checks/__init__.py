@@ -12,3 +12,4 @@ from . import envvars        # noqa: F401
 from . import quantize       # noqa: F401
 from . import failpoints    # noqa: F401
 from . import asyncrules    # noqa: F401
+from . import debugroutes   # noqa: F401

@@ -1,0 +1,102 @@
+"""Debug-route registry rule.
+
+Both serving engines and the gateway answer their ``/debug/*`` surface
+from the shared ``DEBUG_ROUTES`` table in ``mmlspark_tpu/io/serving.py``
+(``debug_route`` matches, ``debug_body`` renders) — the funnel that
+keeps route sets and exposition formats from drifting between engines.
+A handler matching an ad-hoc ``"/debug/..."`` literal instead would
+exist on one engine only and escape the metric-parity and
+route-coverage tests.
+
+The rule (``debug-route-registry``) flags any ``/debug/...`` string
+literal inside ``mmlspark_tpu/io/`` whose path is not declared in the
+``DEBUG_ROUTES`` table. Declared literals may appear anywhere (the
+table's own constants, docstrings, tests riding the table); an
+undeclared one is a route the funnel doesn't know about.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional, Set
+
+from ..core import Checker, CheckerRotError, Finding, Repo, register
+
+_SERVING_REL = "mmlspark_tpu/io/serving.py"
+_ROUTE_RE = re.compile(r"^/debug/[a-z0-9_/-]+$")
+_MIN_DECLARED = 2
+
+
+def _declared_paths(repo: Repo) -> Set[str]:
+    """Every path in serving.py's ``DEBUG_ROUTES`` tuple, resolving the
+    ``FOO_PATH`` module-constant indirection the table uses."""
+    mod = repo.module(_SERVING_REL)
+    if mod is None:
+        raise CheckerRotError(
+            f"{_SERVING_REL} is gone — the shared debug-route table "
+            "must exist")
+    consts = {}
+    table: Optional[ast.Tuple] = None
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        target = node.targets[0].id
+        if isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            consts[target] = node.value.value
+        elif target == "DEBUG_ROUTES" and isinstance(node.value,
+                                                     ast.Tuple):
+            table = node.value
+    if table is None:
+        raise CheckerRotError(
+            f"no DEBUG_ROUTES tuple found in {_SERVING_REL} — table "
+            "renamed or restructured?")
+    paths: Set[str] = set()
+    for elt in table.elts:
+        if not (isinstance(elt, ast.Tuple) and len(elt.elts) == 2):
+            continue
+        p = elt.elts[1]
+        if isinstance(p, ast.Constant) and isinstance(p.value, str):
+            paths.add(p.value)
+        elif isinstance(p, ast.Name) and p.id in consts:
+            paths.add(consts[p.id])
+    if len(paths) < _MIN_DECLARED:
+        raise CheckerRotError(
+            f"only {len(paths)} route paths parsed from DEBUG_ROUTES "
+            f"in {_SERVING_REL} (expected >= {_MIN_DECLARED}) — table "
+            "format changed?")
+    return paths
+
+
+class DebugRouteRegistry(Checker):
+    rule = "debug-route-registry"
+    description = "every /debug/* literal under io/ is declared in " \
+                  "serving.py's shared DEBUG_ROUTES table"
+
+    def check(self, repo: Repo) -> Iterator[Finding]:
+        declared = _declared_paths(repo)
+        findings: List[Finding] = []
+        for mod in repo.package():
+            if not mod.rel.replace("\\", "/").startswith(
+                    "mmlspark_tpu/io/"):
+                continue
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)):
+                    continue
+                value = node.value.rstrip("/") or node.value
+                if not _ROUTE_RE.match(value):
+                    continue
+                if value in declared:
+                    continue
+                findings.append(self.finding(
+                    mod, node.lineno,
+                    f"{node.value!r} is not in {_SERVING_REL}'s "
+                    "DEBUG_ROUTES table — register the route there so "
+                    "both engines (and debug_body) serve it"))
+        return iter(findings)
+
+
+register(DebugRouteRegistry())

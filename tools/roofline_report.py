@@ -13,6 +13,15 @@ Two callers share this module:
 
   accepting either the bench metrics-snapshot shape (``{"metrics": ...,
   "roofline": ...}``) or a raw ``/debug/roofline`` body.
+- Given multiple bench-round files it renders the measured
+  ``*_roofline_pct`` keys as a trend table across rounds instead
+  (ROADMAP item 4's trend-lines half)::
+
+      python tools/roofline_report.py BENCH_r*.json
+
+  each file being a driver wrapper whose ``tail`` holds the run's
+  stdout with the bench JSON line last (bench_regression's
+  last-line-wins convention; bare JSON-line files are accepted too).
 
 Rendering is report-only everywhere — nothing here gates a bench or a
 regression verdict (that stays with ``tools/bench_regression.py``, which
@@ -22,6 +31,7 @@ prints ``*_roofline_pct`` keys as trend lines only).
 from __future__ import annotations
 
 import json
+import os
 import sys
 from typing import Any, Dict, List, Optional
 
@@ -148,19 +158,103 @@ def render_text(roofline: Optional[Dict[str, Any]],
     return "\n\n".join(parts) if parts else "(nothing to report)"
 
 
+def bench_round_line(path: str) -> Optional[Dict[str, Any]]:
+    """A bench round's metrics dict from a ``BENCH_r*.json`` driver
+    wrapper (last JSON-object line of its ``tail``) or a bare
+    JSON-line file — bench_regression's parsing convention."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = f.read()
+    except OSError as e:
+        print(f"roofline_report: cannot read {path}: {e}",
+              file=sys.stderr)
+        return None
+    text = raw
+    try:
+        obj = json.loads(raw)
+        if isinstance(obj, dict) and isinstance(obj.get("tail"), str):
+            text = obj["tail"]
+        elif isinstance(obj, dict):
+            return obj
+    except json.JSONDecodeError:
+        pass                                # line-oriented file
+    found = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(doc, dict):
+            found = doc                     # last-line-wins
+    return found
+
+
+def render_trend(rounds: List[tuple]) -> str:
+    """``*_roofline_pct`` keys across bench rounds as one row per key,
+    one column per round — the measured %-of-peak trend. Rounds without
+    the keys (CPU legs: peaks unknown, keys absent by design) render
+    ``-`` so the round axis stays honest."""
+    keys = sorted({k for _label, line in rounds
+                   for k in (line or {})
+                   if k.endswith("_roofline_pct")})
+    header = ["key"] + [label for label, _line in rounds] + ["trend"]
+    if not keys:
+        return ("roofline trend: no *_roofline_pct keys in "
+                f"{len(rounds)} round(s) — measured %-of-peak is only "
+                "emitted when backend peaks are known (TPU legs)")
+    rows = []
+    for key in keys:
+        vals = [(line or {}).get(key) for _label, line in rounds]
+        cells = ["-" if not isinstance(v, (int, float)) else f"{v:g}%"
+                 for v in vals]
+        present = [v for v in vals if isinstance(v, (int, float))]
+        trend = ("-" if len(present) < 2 else
+                 f"{present[-1] - present[0]:+.2f}pp")
+        rows.append([key] + cells + [trend])
+    return ("roofline %-of-peak trend (report-only)\n"
+            + _table(rows, header))
+
+
+def _round_label(path: str) -> str:
+    name = os.path.basename(path)
+    return name[:-5] if name.endswith(".json") else name
+
+
 def main(argv: List[str]) -> int:
-    if len(argv) != 2 or argv[1] in ("-h", "--help"):
+    if len(argv) < 2 or argv[1] in ("-h", "--help"):
         print(__doc__ or "", file=sys.stderr)
-        print(f"usage: {argv[0]} <snapshot.json>", file=sys.stderr)
+        print(f"usage: {argv[0]} <snapshot.json>\n"
+              f"       {argv[0]} <BENCH_r*.json ...>   (trend mode)",
+              file=sys.stderr)
         return 2
+    if len(argv) > 2:
+        # multi-round trend mode
+        rounds = [(_round_label(p), bench_round_line(p))
+                  for p in argv[1:]]
+        try:
+            print(render_trend(rounds))
+        except BrokenPipeError:
+            pass
+        return 0
     with open(argv[1]) as f:
         doc = json.load(f)
-    # bench metrics-snapshot shape vs raw /debug/roofline body
+    # bench metrics-snapshot shape vs raw /debug/roofline body vs a
+    # single bench-round wrapper (one-column trend)
     if "executables" in doc or "peaks" in doc:
         roofline, metrics = doc, None
-    else:
+    elif "roofline" in doc or "metrics" in doc:
         roofline = doc.get("roofline")
         metrics = doc.get("metrics")
+    else:
+        try:
+            print(render_trend([(_round_label(argv[1]),
+                                 bench_round_line(argv[1]))]))
+        except BrokenPipeError:
+            pass
+        return 0
     try:
         print(render_text(roofline, metrics))
     except BrokenPipeError:                 # | head closed the pipe

@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""Render a p99-attribution breakdown from the tail sampler's reservoir.
+
+Input is a dumped ``/debug/tail`` body (or a ``/debug/slo`` + tail
+composite / bench snapshot carrying a ``"tail"`` key)::
+
+    curl -s localhost:8900/debug/tail > tail.json
+    python tools/tail_report.py tail.json
+
+The report aggregates the sampled breaching requests' stage timelines
+(``admission -> forming_wait -> score -> write``) into per-stage shares,
+names the dominant stage, and prints the matching remediation hint —
+"tail is 72% forming_wait -> raise slots / add worker" vs "tail is
+score -> scoring-bound, see /debug/roofline for compute- vs
+memory-bound". Rendering is report-only: nothing here gates anything.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+#: what to do about each dominant stage — the runbook the attribution
+#: breakdown points into
+REMEDIATION = {
+    "forming_wait": "queue/batch-forming dominated — raise the slot "
+                    "table (MMLSPARK_TPU_ASERVE_SLOTS) or add a worker; "
+                    "check cluster_autoscale_hint at the gateway",
+    "score": "scoring-bound — see /debug/roofline for compute- vs "
+             "memory-bound (a memory-bound predict wants the int8 lane, "
+             "a compute-bound one wants more chips)",
+    "admission": "edge parse + enqueue dominated — oversized request "
+                 "bodies or admission-control churn; check shed "
+                 "counters and request sizes",
+    "write": "reply serialization / socket write dominated — oversized "
+             "responses or a slow client; check payload sizes and "
+             "keep-alive reuse",
+}
+
+
+def _table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(header)]
+    def line(cells: List[str]) -> str:
+        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+    out = [line(header), line(["-" * w for w in widths])]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
+def tail_payload(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Accept a raw ``/debug/tail`` body or any wrapper carrying one
+    under a ``"tail"`` key."""
+    if isinstance(doc.get("samples"), list) and "attribution" in doc:
+        return doc
+    tail = doc.get("tail")
+    if isinstance(tail, dict) and isinstance(tail.get("samples"), list):
+        return tail
+    return None
+
+
+def dominant_stage(payload: Dict[str, Any]) -> Optional[str]:
+    return (payload.get("attribution") or {}).get("dominant_stage")
+
+
+def render_text(payload: Dict[str, Any]) -> str:
+    """The attribution breakdown + remediation hint as text."""
+    attr = payload.get("attribution") or {}
+    samples = payload.get("samples") or []
+    lines = [f"tail attribution "
+             f"(sampled={payload.get('sampled_total', len(samples))}, "
+             f"retained={len(samples)}, "
+             f"dropped={payload.get('dropped_total', 0)}, "
+             f"capacity={payload.get('capacity', '-')})"]
+    shares = attr.get("stage_share_pct") or {}
+    if not shares:
+        lines.append("  (no sampled timelines — no objective breaches "
+                     "observed, or no SLO configured)")
+        return "\n".join(lines)
+    seconds = attr.get("stage_seconds") or {}
+    order = {"admission": 0, "forming_wait": 1, "score": 2, "write": 3}
+    rows = [[stage, f"{shares[stage]:.1f}%",
+             f"{seconds.get(stage, 0.0) * 1e3:.3f} ms"]
+            for stage in sorted(shares, key=lambda s: order.get(s, 9))]
+    lines.append(_table(rows, ["stage", "share", "sampled total"]))
+    dom = dominant_stage(payload)
+    if dom is not None:
+        lines.append(f"tail is {shares.get(dom, 0.0):.0f}% {dom} -> "
+                     + REMEDIATION.get(dom, "no runbook entry for this "
+                                            "stage"))
+    slow = [s for s in samples if s.get("stages")]
+    if slow:
+        worst = max(slow, key=lambda s: s.get("seconds") or 0.0)
+        st = worst["stages"]
+        timeline = " / ".join(f"{k}={st[k] * 1e3:.3f}ms"
+                              for k in sorted(st, key=lambda k:
+                                              order.get(k, 9)))
+        lines.append(f"worst sample: api={worst.get('api')} "
+                     f"{(worst.get('seconds') or 0) * 1e3:.3f} ms "
+                     f"(status {worst.get('status')}, "
+                     f"trace {worst.get('trace_id')}): {timeline}")
+    return "\n".join(lines)
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) < 2 or argv[1] in ("-h", "--help"):
+        print(__doc__ or "", file=sys.stderr)
+        print(f"usage: {argv[0]} <tail.json> [more.json ...]",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    for path in argv[1:]:
+        with open(path) as f:
+            doc = json.load(f)
+        payload = tail_payload(doc)
+        if payload is None:
+            print(f"{path}: no tail payload found (expected a "
+                  "/debug/tail body or a wrapper with a 'tail' key)",
+                  file=sys.stderr)
+            rc = 2
+            continue
+        prefix = f"== {path} ==\n" if len(argv) > 2 else ""
+        try:
+            print(prefix + render_text(payload))
+        except BrokenPipeError:             # | head closed the pipe
+            pass
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))
