@@ -111,6 +111,11 @@ def _dump_metrics_snapshot(leg: str, wall_start: float = 0.0) -> None:
         from mmlspark_tpu.observability import tailsampler as _obs_tail
         payload["slo"] = _obs_slo.snapshot_payload()
         payload["tail"] = _obs_tail.snapshot_payload()
+        # auto-tuner provenance: which knobs were measured-resolved (and
+        # from where — calibration vs store vs pinned) during this leg,
+        # so an A/B round is attributable to tuning rather than noise
+        from mmlspark_tpu import tuning as _tuning
+        payload["tuning"] = _tuning.provenance()
         with open(path, "w") as f:
             json.dump(payload, f, indent=2)
     except Exception as e:  # noqa: BLE001 — telemetry must not fail a bench
@@ -708,6 +713,16 @@ def _run_leg(on_tpu: bool) -> None:
         out[f"imagelime_perturbations_per_sec{sfx}"] = \
             lime_rates["perturbations_per_sec"]
     out.update(_measured_roofline_keys())
+
+    def _tuning_provenance():
+        from mmlspark_tpu import tuning as _tuning
+        return _tuning.provenance()
+
+    # auto-tuner provenance on the round line itself (None when no store
+    # is configured): tools/bench_regression.py annotates — never gates —
+    # provenance flips, so a moved number is attributable to "the tuner
+    # flipped a knob" before it's read as "the code got slower"
+    out["tuning"] = _guard(_tuning_provenance, None)
     print(json.dumps(out))
     _dump_metrics_snapshot("tpu" if on_tpu else "cpu", leg_wall_start)
     _dump_flight_snapshot("tpu" if on_tpu else "cpu")

@@ -46,6 +46,12 @@ def main(argv=None) -> int:
                         "(f32,bf16,int8; default f32) — match the "
                         "fleet's MMLSPARK_TPU_PREDICT_DTYPE so the "
                         "quantized executables warm-start too")
+    b.add_argument("--tuned-from", default=None, metavar="STORE_DIR",
+                   help="tuning store directory (MMLSPARK_TPU_TUNING_DIR "
+                        "of a measured deployment): bakes the measured "
+                        "bucket ladder into the enumeration next to the "
+                        "pow2 grid and stamps tuning provenance into the "
+                        "manifest (docs/performance.md §Auto-tuning)")
     b.add_argument("--force", action="store_true",
                    help="replace an existing bundle directory")
 
@@ -64,6 +70,14 @@ def main(argv=None) -> int:
         return 0
 
     from .bundle import build_bundle
+    if getattr(args, "tuned_from", None):
+        from .. import tuning as _tuning
+        # point the tuner at the measured store BEFORE the enumeration
+        # runs; the model hash joins the fingerprint check so a store
+        # measured against a different model degrades loudly
+        from .bundle import model_hash
+        _tuning.configure(store_dir=args.tuned_from,
+                          model_sha256=model_hash(args.model))
     batch_sizes = None
     if args.batch_sizes:
         batch_sizes = [int(x) for x in args.batch_sizes.split(",") if x]

@@ -175,16 +175,25 @@ def load_model_boosters(model_path: str) -> List[Any]:
 
 
 def _default_batch_sizes(max_batch: int) -> List[int]:
-    """The pow2 ladder serving actually dispatches: both engines bucket
-    micro-batches to powers of two up to the batch cap
-    (``bucket_size`` / ``SlotTable.bucket_view``), so these are the only
-    batch shapes a warmed worker will ever look up."""
+    """The ladder serving actually dispatches: both engines bucket
+    micro-batches up to the batch cap (``bucket_size`` /
+    ``SlotTable.bucket_view``), so these are the only batch shapes a
+    warmed worker will ever look up. Unions the pow2 grid with the
+    auto-tuner's measured rungs when a tuning store is wired
+    (``bundles build --tuned-from <store>``) — a worker serving a tuned
+    ladder must find its rung-shaped executables prewarmed, and the
+    pow2 grid stays in because out-of-distribution batches fall back
+    to it (``tuning.ladder_pad``)."""
+    from .. import tuning as _tuning
+
     sizes, b = [], 1
     while b < max_batch:
         sizes.append(b)
         b *= 2
     sizes.append(max_batch)
-    return sizes
+    ladder = _tuning.resolve_bucket_ladder() or ()
+    sizes.extend(r for r in ladder if r <= max_batch)
+    return sorted(set(sizes))
 
 
 # ---------------------------------------------------------------------------
@@ -283,6 +292,12 @@ def build_bundle(model_path: str, out_dir: str,
             {p for e in entries for p in e.pop("_platforms")}),
         "entries": entries,
     }
+    # tuning provenance: which measured decisions shaped this bundle's
+    # enumeration (the ladder above) — inspect/compare tooling can tell a
+    # tuner flip from a model change
+    from .. import tuning as _tuning
+    if _tuning.enabled():
+        manifest["tuning"] = _tuning.provenance()
     with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
         json.dump(manifest, f, indent=2, sort_keys=True)
     if os.path.exists(out_dir):          # force=True: replace atomically-ish

@@ -52,6 +52,7 @@ from ...observability import watchdog as _watchdog
 from ...observability.logging import get_logger
 from ...robustness import failpoints as _failpoints
 from ...robustness import policy as _policy
+from ... import tuning as _tuning
 from ..serving import (_BATCH_SIZE_BUCKETS, debug_body, debug_route,
                        observe_request_stages, stage_breakdown)
 from .http import BadRequest, ParsedRequest, read_request, write_response
@@ -128,13 +129,19 @@ class AsyncServingServer:
         self.max_queue_depth = (
             max_queue_depth if max_queue_depth is not None
             else _policy.env_int("MMLSPARK_TPU_MAX_QUEUE_DEPTH", 512))
-        self.slots = resolve_slots(slots)
+        row_bytes = (row_spec.width * np.dtype(row_spec.dtype).itemsize
+                     if row_spec is not None else None)
+        self.slots = resolve_slots(slots, row_bytes=row_bytes)
         self.row_spec = row_spec
         self.slot_table: Optional[SlotTable] = None
         if row_spec is not None:
             self.slot_table = SlotTable(self.slots, row_spec.width,
                                         row_spec.dtype,
                                         quantizer=row_spec.quantizer)
+        # tuning evidence: the geometry the slot-sizing decision (site 4)
+        # reconciles against the aserve_slots HBM claim headroom
+        if row_bytes:
+            _tuning.note_slot_geometry(row_bytes, self.slots)
         self.host = host
         self.port = port
         self._lock = threading.Lock()
@@ -223,6 +230,9 @@ class AsyncServingServer:
             self._thread.join(timeout=5)
         if self.slot_table is not None:
             self.slot_table.release_claim()
+        # persist tuning evidence + any pending decisions so the NEXT
+        # process starts tuned (no-op when tuning is disabled)
+        _tuning.flush()
 
     def _shutdown(self) -> None:
         # on the loop: close the listener, then stop — run_forever's
@@ -278,6 +288,7 @@ class AsyncServingServer:
     def observe_batch(self, n: int, seconds: float) -> None:
         if n > 0:
             self._service_ewma.update(seconds / n)
+            _tuning.observe_score(seconds)
 
     def retry_after_hint(self) -> Dict[str, str]:
         per_req = self._service_ewma.value or 0.0
@@ -382,12 +393,51 @@ class AsyncServingServer:
             pass
 
     # -- batch take (scoring thread) ---------------------------------------
+    def _hold_forming(self, hold: float) -> None:
+        """Tuning site 3 (dispatch pacing): keep the forming buffer open
+        up to ``hold`` seconds past its first arrival so a memory-bound,
+        under-occupied score stage dispatches fuller batches — the extra
+        rows ride the same HBM sweep. Exits early the moment the buffer
+        fills, drain starts, or the endpoint's SLO fast-window burn
+        exceeds 1 (a breaching endpoint is NEVER held — latency budget
+        already gone)."""
+        waited = False
+        while True:
+            with self._lock:
+                n = len(self._forming)
+                if n == 0 or n >= self.slots or self._draining:
+                    break
+                deadline = self._first_arrival + hold
+            if _slo.current_burn(self.api_name) > 1.0:
+                _metrics.safe_counter("tuning_hold_outcomes_total",
+                                      api=self.api_name,
+                                      outcome="burn_bypass").inc()
+                return
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            waited = True
+            # ride the admission wake event, not a bare sleep: a new
+            # arrival re-checks occupancy immediately (a buffer that
+            # fills mid-hold dispatches early), and the slice bound
+            # keeps the burn check fresh while idle
+            self._wake.clear()
+            self._wake.wait(min(remaining, max(hold / 4.0, 0.0002)))
+        if waited:
+            _metrics.safe_counter("tuning_hold_outcomes_total",
+                                  api=self.api_name, outcome="held").inc()
+
     def take_batch(self, timeout: float):
         """``(batch, buffer)`` the moment anything has formed — the
-        continuous half: no latency window, the device's readiness IS
-        the dispatch trigger. ``buffer`` is the dispatched staging array
-        in rows mode (None in dataset mode)."""
+        continuous half: no latency window by default, the device's
+        readiness IS the dispatch trigger (the auto-tuner's hold window,
+        when one is decided, is the measured exception — see
+        :meth:`_hold_forming`). ``buffer`` is the dispatched staging
+        array in rows mode (None in dataset mode)."""
         self._wake.wait(timeout)
+        hold = _tuning.resolve_hold_window()
+        if hold > 0.0:
+            self._hold_forming(hold)
         with self._lock:
             if not self._forming:
                 self._wake.clear()
@@ -403,6 +453,10 @@ class AsyncServingServer:
         _metrics.safe_histogram("serving_batch_assembly_seconds",
                                 api=self.api_name).observe(
             max(0.0, now - t_first))
+        # tuning evidence feeds (sites 2/3/4): admitted-batch rows +
+        # forming wait, matched against observe_batch's score wall
+        _tuning.observe_batch_size(len(batch))
+        _tuning.observe_forming_wait(max(0.0, now - t_first))
         wait_h = _metrics.safe_histogram("serving_queue_wait_seconds",
                                          api=self.api_name)
         for r in batch:

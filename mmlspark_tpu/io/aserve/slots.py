@@ -39,10 +39,25 @@ def _pow2_ceil(n: int) -> int:
     return 1 << (max(1, int(n)) - 1).bit_length()
 
 
-def resolve_slots(max_batch: int) -> int:
+def resolve_slots(max_batch: int, row_bytes: "int | None" = None) -> int:
     """The effective slot count: the env override when set (>0), else
     ``max_batch``; always rounded up to a power of two so the bucket
-    ladder is exact."""
+    ladder is exact.
+
+    ``MMLSPARK_TPU_ASERVE_SLOTS=auto`` asks the auto-tuner (tuning
+    site 4) for the measured size — the p99.9 of observed admitted-batch
+    rows reconciled against the ``aserve_slots`` HBM claim headroom. A
+    first process with no measured decision sizes statically (the
+    untuned rule); the raw-string check matters because ``env_int``
+    maps any unparseable value to its default, which would silently turn
+    ``auto`` into the static path with no tuner consult."""
+    import os
+
+    raw = (os.environ.get(SLOTS_ENV) or "").strip().lower()
+    if raw == "auto":
+        from ... import tuning as _tuning
+        tuned = _tuning.resolve_slots_auto(max_batch, row_bytes=row_bytes)
+        return _pow2_ceil(tuned if tuned else max_batch)
     n = env_int(SLOTS_ENV, 0)
     if n <= 0:
         n = max_batch

@@ -58,6 +58,7 @@ from ..observability import watchdog as _watchdog
 from ..observability.logging import get_logger
 from ..robustness import failpoints as _failpoints
 from ..robustness import policy as _policy
+from .. import tuning as _tuning
 from .http import to_jsonable
 
 logger = get_logger("mmlspark_tpu.io.serving")
@@ -85,6 +86,8 @@ AUTOSCALE_PATH = "/debug/autoscale"
 SLO_PATH = "/debug/slo"
 #: bounded reservoir of objective-breaching request stage timelines
 TAIL_PATH = "/debug/tail"
+#: auto-tuner decisions + the evidence behind them (tuning store view)
+TUNING_PATH = "/debug/tuning"
 
 #: (route name, path) table shared by the serving server and the gateway
 DEBUG_ROUTES = (
@@ -97,6 +100,7 @@ DEBUG_ROUTES = (
     ("autoscale", AUTOSCALE_PATH),
     ("slo", SLO_PATH),
     ("tail", TAIL_PATH),
+    ("tuning", TUNING_PATH),
 )
 
 
@@ -288,6 +292,8 @@ def debug_body(route: str, api_name: str,
             payload["cluster"] = federation.slo_overview()
     elif route == "tail":
         payload = _tailsampler.snapshot_payload()
+    elif route == "tuning":
+        payload = _tuning.snapshot_payload()
     else:
         payload = _flight.snapshot()
     return (json.dumps(payload, default=repr).encode("utf-8"),
@@ -673,6 +679,9 @@ class ServingServer:
             self._started = False
         self._httpd.shutdown()
         self._httpd.server_close()
+        # persist tuning evidence + any pending decisions so the NEXT
+        # process starts tuned (no-op when tuning is disabled)
+        _tuning.flush()
 
     @property
     def url(self) -> str:
@@ -885,8 +894,17 @@ def make_reply(entity: Any, status_code: int = 200) -> Dict[str, Any]:
 
 
 def bucket_size(n: int, max_batch: int) -> int:
-    """Smallest power-of-two >= n (capped): static shapes under jit, so the
-    compiled program cache holds log2(max_batch) entries, not one per size."""
+    """Smallest bucket >= n (capped): static shapes under jit, so the
+    compiled program cache holds a bounded set of entries, not one per
+    size. Consults the auto-tuner's measured ladder (tuning site 2) when
+    one is decided — the SAME resolution ``Booster.predict_plan`` does,
+    so the batcher and the predictor cache key can never disagree on
+    rung geometry — else the static pow2 grid."""
+    ladder = _tuning.resolve_bucket_ladder()
+    if ladder:
+        for rung in ladder:
+            if rung >= n:
+                return min(int(rung), max_batch)
     b = 1
     while b < n and b < max_batch:
         b *= 2
@@ -1055,6 +1073,9 @@ class ServingQuery:
             _metrics.safe_histogram("serving_batch_size", api=api,
                                     buckets=_BATCH_SIZE_BUCKETS).observe(
                 len(batch))
+            # tuning evidence (site 2): the batch-size histogram the
+            # measured bucket ladder derives from — fed by BOTH engines
+            _tuning.observe_batch_size(len(batch))
             ds = requests_to_dataset(batch)
             t0 = time.perf_counter()
             # the queue crosses a thread boundary, so the handler threads'
@@ -1087,6 +1108,7 @@ class ServingQuery:
                 self.server._progress.set()
                 dt = time.perf_counter() - t0
                 self.server.observe_batch(len(batch), dt)
+                _tuning.observe_score(dt)
                 _metrics.safe_counter("serving_batches_total", api=api).inc()
                 _metrics.safe_histogram("serving_transform_seconds",
                                         api=api).observe(dt)

@@ -35,6 +35,7 @@ from ...observability import spans as _spans
 from ...observability import watchdog as _watchdog
 from ...observability.logging import console as _console
 from ...robustness.failpoints import fault_point as _failpoint
+from ... import tuning as _tuning
 from ...utils import compile_cache as _compile_cache
 from ...ops.binning import QuantileBinner, bin_cols_device
 from ...parallel import mesh as meshlib
@@ -975,12 +976,19 @@ class Booster:
         if num_iteration is None or num_iteration < 0:
             num_iteration = self.num_iterations
         t_end = min(num_iteration * self.num_class, self.num_trees)
-        # power-of-two row bucket for SMALL batches only: serving's varying
-        # micro-batch sizes hit log2 cached executables instead of one
-        # trace per size. Large batch scoring keeps its exact shape —
-        # padding 600k rows to 1M would waste up to 2x forest compute.
+        # row bucket for SMALL batches only: serving's varying micro-batch
+        # sizes hit a bounded set of cached executables instead of one
+        # trace per size. The bucket ladder is resolved HERE, before the
+        # cache key below (the PR 4 rule, lint-anchored): the auto-tuner's
+        # measured ladder (tuning site 2 — rungs at the observed
+        # workload's batch-size percentiles, pow2 above them) when one is
+        # decided, else the static pow2 grid. Large batch scoring keeps
+        # its exact shape — padding 600k rows to 1M would waste up to 2x
+        # forest compute.
+        ladder = _tuning.resolve_bucket_ladder()
         if 0 < n <= 8192:
-            n_pad = 1 << (n - 1).bit_length()
+            n_pad = (_tuning.ladder_pad(n, ladder) if ladder
+                     else 1 << (n - 1).bit_length())
         else:
             n_pad = max(n, 1)
         T_pad = self._tree_bucket(t_end)
@@ -1531,6 +1539,32 @@ def _grow_axis_for(mesh, cfg) -> "str | None":
             else None)
 
 
+def _measure_hist_engine(engine: str, binned_d, stats_d,
+                         num_bins: int) -> float:
+    """One measured histogram round for the auto-tuner's engine
+    calibration: compile + warm, then time a single steady-state
+    execution of ``histogram_cols`` under the candidate engine. Runs a
+    standalone jit over an unsharded, undonated calibration slice — the
+    full step program (sharded, donated buffers) is never replayed here,
+    and the hint is always restored before returning."""
+    from ...ops import histogram as _hist
+    _hist.set_tuned_engine(engine)
+    try:
+        fn = jax.jit(lambda b, s: _hist.histogram_cols(b, s, num_bins))
+        jax.block_until_ready(fn(binned_d, stats_d))
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(binned_d, stats_d))
+        return time.perf_counter() - t0
+    finally:
+        _hist.set_tuned_engine("")
+
+
+#: row cap for the calibration slice: large enough that engine ranking
+#: matches full-dataset behavior, small enough that calibration stays a
+#: negligible fraction of the first fit
+_HIST_CAL_ROWS = 16384
+
+
 def train_booster(
     X: Optional[np.ndarray] = None,
     y: Optional[np.ndarray] = None,
@@ -1819,6 +1853,34 @@ def train_booster(
     cfg = cfg._replace(hist_blocks=placement.resolve_hist_blocks(
         cfg.hist_blocks, mesh, n_pad, voting=cfg.voting))
     deterministic = isinstance(cfg.hist_blocks, int) and cfg.hist_blocks > 1
+
+    # auto-tuned histogram engine (tuning site 1) — resolved HERE, before
+    # the compiled-program cache key below, because the hint flows into
+    # that key through resolve_engine(). Only `auto` consults the tuner
+    # (an explicit MMLSPARK_TPU_HIST_ENGINE pin is the opt-out); the
+    # first tuned fit of a shape bucket calibrates each candidate engine
+    # with one real histogram round over a slice of this dataset's own
+    # binned columns, later fits/processes answer from the store.
+    from ...ops import histogram as _hist
+    _hist_env = (os.environ.get("MMLSPARK_TPU_HIST_ENGINE")
+                 or "auto").strip().lower()
+    if _tuning.enabled() and _hist_env in ("auto", ""):
+        _cal: Dict[str, tuple] = {}
+
+        def _measure(eng: str) -> float:
+            if "data" not in _cal:
+                rows = int(min(n_pad, _HIST_CAL_ROWS))
+                # gather once, share across candidates; unsharded (the
+                # calibration program must not depend on the mesh)
+                xbt = np.asarray(placement.to_host(Xbt_d))[:, :rows]
+                _cal["data"] = (placement.to_device(np.ascontiguousarray(xbt)),
+                                placement.to_device(
+                                    np.ones((2, rows), np.float32)))
+            return _measure_hist_engine(eng, *_cal["data"], max_bin)
+
+        _hist.set_tuned_engine(_tuning.resolve_hist_engine(
+            n_pad, F, max_bin, _hist.engine_candidates(),
+            measure=_measure) or "")
 
     # base score (replicated scalar per class). Computed on device from the
     # already-sharded label/weight arrays, then broadcast to the initial

@@ -179,11 +179,19 @@ def resolve_growth_backend(cfg: GrowConfig) -> GrowConfig:
             f"hist_subtraction must be True, False or 'auto', got {hs!r}")
     if hs == "auto" or cs == "auto":
         from ...ops.histogram import _on_tpu_device
-        on_tpu = _on_tpu_device()
+        from ... import tuning as _tuning
+        # the auto-tuner's measured engine winner carries more signal
+        # than the backend name: a box whose measured histogram winner is
+        # the MXU-shaped pallas path wants the TPU-side tri-state
+        # resolution (full-width passes, argsort compaction) even if the
+        # platform string is a tunneled plugin — and vice versa. No
+        # measurement -> today's backend-name rule, unchanged.
+        hint = _tuning.growth_tristate_hint()
+        tpu_like = (hint == "pallas") if hint else _on_tpu_device()
         if hs == "auto":
-            hs = not on_tpu
+            hs = not tpu_like
         if cs == "auto":
-            cs = "argsort" if on_tpu else "searchsorted"
+            cs = "argsort" if tpu_like else "searchsorted"
         cfg = cfg._replace(hist_subtraction=bool(hs), compact_selector=cs)
     return cfg
 

@@ -262,8 +262,38 @@ REGISTRY: Tuple[EnvVar, ...] = (
            doc="async engine slot-table size — rows per pre-pinned "
                "staging buffer, i.e. the device batch cap the compiled "
                "predictor sees (pow2-rounded; 0 follows the query's "
-               "`max_batch`); the admission backlog bound stays "
+               "`max_batch`; `auto` sizes from the auto-tuner's measured "
+               "p99.9 admitted-batch rows reconciled against HBM "
+               "headroom — needs `MMLSPARK_TPU_TUNING_DIR`); the "
+               "admission backlog bound stays "
                "`MMLSPARK_TPU_MAX_QUEUE_DEPTH`"),
+    # -- auto-tuning (docs/performance.md §Auto-tuning) --------------------
+    EnvVar(name="MMLSPARK_TPU_TUNING_DIR", default="(off)",
+           section="performance",
+           doc="directory of the auto-tuner's decision store — setting "
+               "it enables the measure→decide loop (engine selection, "
+               "bucket ladder, dispatch hold window, slot sizing); "
+               "decisions persist here so the second process starts "
+               "tuned, fingerprinted on device kind + model hash + "
+               "framework version (skew degrades loudly to the static "
+               "rules)"),
+    EnvVar(name="MMLSPARK_TPU_TUNE_MIN_SAMPLES", default="64",
+           section="performance",
+           doc="observed-batch evidence bar: the serving-side tuning "
+               "decisions (ladder / slots / hold window) are taken once "
+               "this many admitted batches have been recorded"),
+    EnvVar(name="MMLSPARK_TPU_TUNE_HOLD_MS", default="(tuner decides)",
+           section="performance",
+           doc="pin the async dispatch hold window in ms (`0` disables "
+               "holding entirely) — the opt-out for tuning site 3; "
+               "unset lets the tuner derive it from the roofline "
+               "`bound` verdict and stage EWMAs"),
+    EnvVar(name="MMLSPARK_TPU_TUNE_HOLD_CAP_MS", default="2.0",
+           section="performance",
+           doc="upper bound on the tuner-computed dispatch hold window "
+               "(the latency the pacing decision may spend forming a "
+               "fuller batch; the SLO-burn override dispatches "
+               "immediately regardless)"),
     # -- explainability ----------------------------------------------------
     EnvVar(name="MMLSPARK_TPU_SHAP_HOST", default="(auto by backend)",
            section="performance",

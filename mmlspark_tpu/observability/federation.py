@@ -375,27 +375,36 @@ class MetricsFederator:
                 st = self._workers[label] = _WorkerState(label)
             return st
 
+    def _fresh_states(self, max_age: Optional[float] = None
+                      ) -> List[Tuple[str, "_WorkerState"]]:
+        """THE freshness rule for every federated read: workers whose
+        last scrape failed, never happened, or is older than ``max_age``
+        (default 3 sweep intervals) are omitted. One filter — the
+        routing feed, the SLO burn fold, and the autoscale hint's
+        queue-wait read all pass through here, so a ghost worker ages
+        out of every derived signal at the same instant instead of
+        lingering in whichever reader had the laxest rule (the staleness
+        contract is pinned in tests/test_federation.py)."""
+        if max_age is None:
+            max_age = 3.0 * self.interval
+        now = time.time()
+        with self._lock:
+            states = list(self._workers.items())
+        return [(label, st) for label, st in states
+                if st.error is None and st.last_success
+                and now - st.last_success <= max_age]
+
     def gauge_values(self, family: str,
                      max_age: Optional[float] = None) -> Dict[str, float]:
         """Per-worker value of one gauge family from each worker's last
         successful scrape — the feed for load-aware gateway routing
         (``cluster_serving_queue_depth`` is ``serving_queue_depth`` seen
-        from here). Workers whose scrape is stale (older than
-        ``max_age``, default 3 sweep intervals) or failed are omitted,
-        so the caller can tell "depth 0" apart from "no fresh data" and
-        fall back. Series within a family (label sets, e.g. one per
-        api) sum per worker."""
-        if max_age is None:
-            max_age = 3.0 * self.interval
-        now = time.time()
+        from here). Stale/failed workers are omitted (see
+        :meth:`_fresh_states`), so the caller can tell "depth 0" apart
+        from "no fresh data" and fall back. Series within a family
+        (label sets, e.g. one per api) sum per worker."""
         out: Dict[str, float] = {}
-        with self._lock:
-            states = list(self._workers.items())
-        for label, st in states:
-            if st.error is not None or not st.last_success:
-                continue
-            if now - st.last_success > max_age:
-                continue
+        for label, st in self._fresh_states(max_age):
             fam = st.families.get(family)
             if fam is None:
                 continue
@@ -409,21 +418,13 @@ class MetricsFederator:
                          max_age: Optional[float] = None
                          ) -> Dict[str, float]:
         """Per-worker MAX across one gauge family's series from each
-        fresh scrape. The burn-rate fold reads ``slo_burn_rate`` this
-        way: a worker exports one series per (api, window) and summing
-        them (``gauge_values``' queue-depth semantics) would double a
-        breach just for having two windows."""
-        if max_age is None:
-            max_age = 3.0 * self.interval
-        now = time.time()
+        fresh scrape (same freshness rule: :meth:`_fresh_states`). The
+        burn-rate fold reads ``slo_burn_rate`` this way: a worker
+        exports one series per (api, window) and summing them
+        (``gauge_values``' queue-depth semantics) would double a breach
+        just for having two windows."""
         out: Dict[str, float] = {}
-        with self._lock:
-            states = list(self._workers.items())
-        for label, st in states:
-            if st.error is not None or not st.last_success:
-                continue
-            if now - st.last_success > max_age:
-                continue
+        for label, st in self._fresh_states(max_age):
             fam = st.families.get(family)
             if fam is None:
                 continue
@@ -464,9 +465,11 @@ class MetricsFederator:
         ones. Also sets the ``cluster_autoscale_hint`` gauge."""
         depths = self.gauge_values("serving_queue_depth")
         waits: Dict[str, Optional[float]] = {}
-        with self._lock:
-            states = list(self._workers.items())
-        for label, st in states:
+        # the queue-wait read rides the SAME freshness filter as the
+        # depth and burn feeds (one _fresh_states rule, not a raw
+        # st.families walk): a ghost worker's last samples age out of
+        # every component of the hint together
+        for label, st in self._fresh_states():
             if label not in depths:
                 continue
             mean = None

@@ -314,6 +314,29 @@ def observe_request(api: str, seconds: float, status: int,
                             breach=signal)
 
 
+def current_burn(api: str, window: str = "fast5m") -> float:
+    """Live burn rate for one endpoint's window — 0.0 when no SLO is
+    configured for it (an unconfigured endpoint cannot be "breaching").
+
+    Computed from the ring directly, not read back from gauges: the
+    dispatch-pacing override in ``io/aserve`` checks this per dispatch
+    and must see a breach the moment it starts, not after the export
+    throttle. Cost is one bounded ring scan under the lock.
+    """
+    _ensure_env()
+    obj = _objectives.get(api)
+    if obj is None:
+        return 0.0
+    span = dict(WINDOWS).get(window)
+    if span is None:
+        raise ValueError(f"unknown SLO window {window!r} "
+                         f"(have {[w for w, _ in WINDOWS]})")
+    now = time.monotonic()
+    with _lock:
+        verdict = _window_verdict(obj, _window_counts_locked(api, now, span))
+    return float(verdict["burn_rate"])
+
+
 def refresh() -> None:
     """Force a gauge recompute for every configured api (tests and the
     federation-facing callers that must not wait out the throttle)."""

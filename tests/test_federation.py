@@ -166,6 +166,44 @@ class TestFederatorScrapes:
         assert got == {"w1": 5.0}, got          # series summed; only fresh
         assert fed.gauge_values("no_such_family") == {}
 
+    def test_ghost_worker_ages_out_of_every_feed(self):
+        """The one ``_fresh_states`` rule: a worker whose last success
+        is older than 3 sweep intervals vanishes from ``gauge_values``,
+        ``gauge_max_values``, and the autoscale hint's queue-wait read
+        at the same instant — no derived signal keeps its own laxer
+        staleness window."""
+        from mmlspark_tpu.observability.federation import \
+            parse_prometheus_text
+
+        fed = MetricsFederator(lambda: [], interval=1.0)
+        now = time.time()
+        exposition = (
+            "# TYPE serving_queue_depth gauge\n"
+            'serving_queue_depth{api="a"} 4\n'
+            "# TYPE slo_burn_rate gauge\n"
+            'slo_burn_rate{api="a",window="fast5m"} 2.5\n'
+            'slo_burn_rate{api="a",window="slow1h"} 0.5\n'
+            "# TYPE serving_queue_wait_seconds histogram\n"
+            'serving_queue_wait_seconds_bucket{api="a",le="+Inf"} 2\n'
+            'serving_queue_wait_seconds_sum{api="a"} 1.0\n'
+            'serving_queue_wait_seconds_count{api="a"} 2\n')
+        live = fed._worker("live")
+        live.families = parse_prometheus_text(exposition)
+        live.last_success = now
+        ghost = fed._worker("ghost")
+        ghost.families = parse_prometheus_text(exposition)
+        ghost.last_success = now - 3.2          # > 3 sweep intervals
+        assert set(fed.gauge_values("serving_queue_depth")) == {"live"}
+        # max across windows (not summed), ghost aged out
+        assert fed.gauge_max_values("slo_burn_rate") == {"live": 2.5}
+        hint = fed.autoscale_hint()
+        assert hint["live_workers"] == 1
+        assert set(hint["workers"]) == {"live"}
+        assert hint["workers"]["live"]["queue_wait_mean_seconds"] == 0.5
+        # a wider explicit max_age readmits it — one parameter, one rule
+        assert set(fed.gauge_max_values("slo_burn_rate",
+                                        max_age=3600)) == {"live", "ghost"}
+
     def test_disabled_sweep_is_inert(self):
         calls = []
 

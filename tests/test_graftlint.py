@@ -281,6 +281,47 @@ class TestFunnelRules:
         assert not hits(active, "retry-sleep-funnel",
                         "mmlspark_tpu/models/trainer.py")
 
+    def test_tuning_store_funnel(self, tmp_path):
+        active, suppressed = run_rule(tmp_path, "tuning-store-funnel", {
+            "mmlspark_tpu/tuning/store.py": """\
+                def load_store(dirpath):
+                    return {}
+
+                def save_store(dirpath, payload):
+                    name = "tuning.json"
+                    return name
+            """,
+            "mmlspark_tpu/tuning/__init__.py": """\
+                from .store import load_store, save_store
+
+                def resolve_bucket_ladder():
+                    return load_store("/tmp")
+            """,
+            "mmlspark_tpu/io/rogue.py": """\
+                import json
+                import os
+
+                def peek(dirpath):
+                    path = os.path.join(dirpath, "tuning.json")
+                    with open(path) as fh:
+                        return json.load(fh)
+
+                def rewrite(dirpath, payload):
+                    save_store(dirpath, payload)
+                    ok = load_store(dirpath)  # graftlint: disable=tuning-store-funnel (test)
+                    return ok
+            """})
+        got = hits(active, "tuning-store-funnel", "mmlspark_tpu/io/rogue.py")
+        assert [f.line for f in got] == [5, 10], active
+        assert "tuning.json" in got[0].message
+        assert "save_store(" in got[1].message
+        assert [f.line for f in suppressed] == [11]
+        # the tuning package is the sanctioned owner of the store
+        assert not hits(active, "tuning-store-funnel",
+                        "mmlspark_tpu/tuning/store.py")
+        assert not hits(active, "tuning-store-funnel",
+                        "mmlspark_tpu/tuning/__init__.py")
+
 
 # --------------------------------------------------------------------------
 # metric rules
@@ -572,18 +613,26 @@ _BOOSTER_PIN_OK = """\
     def resolve_predict_dtype(d):
         return d or "f32"
 
+    def resolve_hist_engine(r, f, b):
+        return ""
+
+    def resolve_bucket_ladder():
+        return ()
+
     def _cached_program(key, build):
         return build()
 
     def train_booster(cfg):
+        hint = resolve_hist_engine(8, 8, 255)
         cfg = resolve_growth_backend(cfg)
         cache_key = (cfg,)
-        return _cached_program(cache_key, lambda: cfg)
+        return _cached_program(cache_key, lambda: (cfg, hint))
 
     def predict_plan(self, n, predict_dtype=None):
+        ladder = resolve_bucket_ladder()
         predict_dtype = resolve_predict_dtype(predict_dtype)
         key = (n, predict_dtype)
-        return key
+        return key, ladder
 """
 
 _API_PIN_OK = """\
@@ -678,6 +727,68 @@ class TestResolveBeforeCacheKey:
         got = hits(active, "resolve-before-cache-key",
                    "mmlspark_tpu/models/gbdt/booster.py")
         assert any("resolve_predict_dtype call missing" in f.message
+                   for f in got), active
+
+    def test_tuning_hist_pin_inversion(self, tmp_path):
+        inverted = _BOOSTER_PIN_OK.replace(
+            "        hint = resolve_hist_engine(8, 8, 255)\n"
+            "        cfg = resolve_growth_backend(cfg)\n"
+            "        cache_key = (cfg,)",
+            "        cfg = resolve_growth_backend(cfg)\n"
+            "        cache_key = (cfg,)\n"
+            "        hint = resolve_hist_engine(8, 8, 255)")
+        assert inverted != _BOOSTER_PIN_OK
+        active, _sup = run_rule(tmp_path, "resolve-before-cache-key", {
+            "mmlspark_tpu/models/gbdt/booster.py": inverted,
+            "mmlspark_tpu/models/gbdt/api.py": _API_PIN_OK})
+        got = hits(active, "resolve-before-cache-key",
+                   "mmlspark_tpu/models/gbdt/booster.py")
+        assert any("tuning.resolve_hist_engine" in f.message
+                   and "before the first cache-key" in f.message
+                   for f in got), active
+
+    def test_tuning_hist_pin_missing_resolver(self, tmp_path):
+        unresolved = _BOOSTER_PIN_OK.replace(
+            "        hint = resolve_hist_engine(8, 8, 255)\n", "")
+        assert unresolved != _BOOSTER_PIN_OK
+        active, _sup = run_rule(tmp_path, "resolve-before-cache-key", {
+            "mmlspark_tpu/models/gbdt/booster.py": unresolved,
+            "mmlspark_tpu/models/gbdt/api.py": _API_PIN_OK})
+        got = hits(active, "resolve-before-cache-key",
+                   "mmlspark_tpu/models/gbdt/booster.py")
+        assert any("resolve_hist_engine call missing" in f.message
+                   for f in got), active
+
+    def test_tuning_ladder_pin_inversion(self, tmp_path):
+        inverted = _BOOSTER_PIN_OK.replace(
+            "        ladder = resolve_bucket_ladder()\n"
+            "        predict_dtype = resolve_predict_dtype(predict_dtype)\n"
+            "        key = (n, predict_dtype)",
+            "        predict_dtype = resolve_predict_dtype(predict_dtype)\n"
+            "        key = (n, predict_dtype)\n"
+            "        ladder = resolve_bucket_ladder()")
+        assert inverted != _BOOSTER_PIN_OK
+        active, _sup = run_rule(tmp_path, "resolve-before-cache-key", {
+            "mmlspark_tpu/models/gbdt/booster.py": inverted,
+            "mmlspark_tpu/models/gbdt/api.py": _API_PIN_OK})
+        got = hits(active, "resolve-before-cache-key",
+                   "mmlspark_tpu/models/gbdt/booster.py")
+        assert any("tuning.resolve_bucket_ladder" in f.message
+                   and "predict_plan's key assembly" in f.message
+                   for f in got), active
+
+    def test_tuning_ladder_pin_missing_resolver(self, tmp_path):
+        unresolved = _BOOSTER_PIN_OK.replace(
+            "        ladder = resolve_bucket_ladder()\n", "")
+        unresolved = unresolved.replace("        return key, ladder",
+                                        "        return key")
+        assert unresolved != _BOOSTER_PIN_OK
+        active, _sup = run_rule(tmp_path, "resolve-before-cache-key", {
+            "mmlspark_tpu/models/gbdt/booster.py": unresolved,
+            "mmlspark_tpu/models/gbdt/api.py": _API_PIN_OK})
+        got = hits(active, "resolve-before-cache-key",
+                   "mmlspark_tpu/models/gbdt/booster.py")
+        assert any("resolve_bucket_ladder call missing" in f.message
                    for f in got), active
 
 

@@ -194,6 +194,35 @@ def roofline_lines(prev_rounds: List[Dict], cur: Dict) -> List[str]:
     return out
 
 
+def tuning_lines(prev_rounds: List[Dict], cur: Dict) -> List[str]:
+    """Report-only auto-tuner provenance diff. bench.py stamps the round
+    line with ``"tuning": {"status": ..., "<site>": <choice>, ...}``
+    when a tuning store is configured (absent/None otherwise). NEVER
+    part of the gate: a flipped knob is attribution for a throughput
+    move, not a regression by itself — a round that regressed AND
+    flipped a knob reads "the tuner moved" before "the code got
+    slower"."""
+    cur_t = cur.get("tuning")
+    if not isinstance(cur_t, dict):
+        return []
+    prev_t = None
+    for r in reversed(prev_rounds):  # newest baseline with a stamp wins
+        if isinstance(r.get("tuning"), dict):
+            prev_t = r["tuning"]
+            break
+    if prev_t is None:
+        return [f"tuning: {json.dumps(cur_t, sort_keys=True)} "
+                "(report-only, no baseline provenance)"]
+    out = []
+    for key in sorted(set(prev_t) | set(cur_t)):
+        old, new = prev_t.get(key), cur_t.get(key)
+        if old != new:
+            out.append(f"tuning[{key}]: {old!r} -> {new!r} (report-only)")
+    if not out:
+        out.append("tuning: provenance unchanged vs baseline (report-only)")
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="bench_regression")
     p.add_argument("directory", nargs="?",
@@ -241,7 +270,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     label = f"median({','.join(prev_names)})" if len(prev_names) > 1 \
         else prev_names[0]
     regressions = compare(prev, cur, args.threshold)
-    trends = roofline_lines(prev_lines, cur)
+    trends = roofline_lines(prev_lines, cur) + tuning_lines(prev_lines, cur)
     if regressions:
         print(f"bench_regression: r{n_cur:02d} regressed vs {label}:")
         for line in regressions:

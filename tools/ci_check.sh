@@ -15,6 +15,7 @@
 #   CI_SKIP_QUANT=1 tools/ci_check.sh      # skip the int8 quantized smoke
 #   CI_SKIP_ROOFLINE=1 tools/ci_check.sh   # skip the introspection smoke
 #   CI_SKIP_SLO=1 tools/ci_check.sh        # skip the SLO-breach smoke
+#   CI_SKIP_TUNING=1 tools/ci_check.sh     # skip the auto-tuner smoke
 set -u -o pipefail
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -570,6 +571,80 @@ EOF
     fi
 fi
 
+# tuning smoke lane: the measure→decide loop across two processes — the
+# first process calibrates the histogram engine (one real round per
+# candidate) and persists the decision to a shared store; the second
+# process warm-starts the same knob from the store with ZERO calibration
+# runs, and the snapshot (/debug/tuning's payload) reports the decision
+# with its per-engine evidence.
+if [ "${CI_SKIP_TUNING:-0}" != "1" ]; then
+    if (cd "$ROOT" && env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+            python - <<'EOF'
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+SNIPPET = r'''
+import json
+import numpy as np
+from mmlspark_tpu.models.gbdt.booster import train_booster
+from mmlspark_tpu.models.gbdt.growth import GrowConfig
+from mmlspark_tpu.observability import flight
+from mmlspark_tpu import tuning
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(600, 6)).astype(np.float32)
+y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+train_booster(X=X, y=y, num_iterations=2, objective="binary",
+              cfg=GrowConfig(num_leaves=7, min_data_in_leaf=5))
+events = [e for e in flight.events() if e.get("kind") == "tuning"]
+cal = [e for e in events if e.get("event") == "calibrate"]
+dec = [(e["choice"], e["source"]) for e in events
+       if e.get("site") == "hist_engine" and e.get("choice")
+       and e["choice"] != "static"]
+print(json.dumps({"calibrations": len(cal), "decisions": dec,
+                  "snapshot": tuning.snapshot_payload()}))
+'''
+
+with tempfile.TemporaryDirectory() as d:
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               MMLSPARK_TPU_TUNING_DIR=d)
+
+    def run():
+        p = subprocess.run([sys.executable, "-c", SNIPPET], env=env,
+                           capture_output=True, text=True, timeout=600)
+        assert p.returncode == 0, p.stderr[-2000:]
+        return json.loads(p.stdout.splitlines()[-1])
+
+    first = run()
+    assert first["calibrations"] >= 2, first  # one round per candidate
+    assert first["decisions"] and all(
+        src == "calibration" for _c, src in first["decisions"]), first
+    assert os.path.exists(os.path.join(d, "tuning.json")), os.listdir(d)
+
+    second = run()
+    assert second["calibrations"] == 0, second  # zero re-calibration
+    assert second["decisions"] and all(
+        src == "store" for _c, src in second["decisions"]), second
+    assert [c for c, _s in second["decisions"]] == \
+        [c for c, _s in first["decisions"]], (first, second)
+    snap = second["snapshot"]
+    site = next(k for k in snap["decisions"]
+                if k.startswith("hist_engine/"))
+    assert snap["decisions"][site].get("evidence"), snap["decisions"][site]
+print("tuning smoke: first process calibrated and persisted, second "
+      "process warm-started from the store with zero calibration")
+EOF
+    ); then
+        :
+    else
+        echo "ci_check: tuning smoke FAILED" >&2
+        rc=1
+    fi
+fi
+
 # dryrun_multichip lane: the cross-device-count tree-identity suite on a
 # virtual 8-device CPU mesh (xla_force_host_platform_device_count) — the
 # full histogram-engine matrix, including the tiers tier-1 deselects as
@@ -588,7 +663,7 @@ if [ "${CI_SKIP_MULTICHIP:-0}" != "1" ]; then
 fi
 
 if [ "$rc" -ne 0 ]; then
-    echo "ci_check: FAILED (graftlint findings, env-docs drift, chaos/async/bundle/roofline/SLO smoke, or multichip dry run)" >&2
+    echo "ci_check: FAILED (graftlint findings, env-docs drift, chaos/async/bundle/roofline/SLO/tuning smoke, or multichip dry run)" >&2
 else
     echo "ci_check: clean"
 fi

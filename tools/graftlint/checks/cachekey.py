@@ -187,6 +187,50 @@ class ResolveBeforeCacheKey(Checker):
                 f"resolve_predict_dtype (line {pp_resolver_ln}) must run "
                 f"before predict_plan's key assembly (line {pp_key_ln})")
 
+        # tuning resolvers (PR 19): the auto-tuner's measured decisions
+        # flow INTO the keys — the hist-engine hint keys the train step
+        # cache through resolve_engine(), and the measured bucket ladder
+        # decides predict_plan's n_pad — so both resolve_* calls must
+        # run strictly before their key is assembled. A hint installed
+        # after the key would alias tuned and untuned programs under one
+        # entry (the exact incident class this rule exists for).
+        def is_tuning_hist_resolver(n: ast.AST) -> bool:
+            return (isinstance(n, ast.Call)
+                    and call_name(n)[1] == "resolve_hist_engine")
+
+        th_ln = first_lineno(tb, is_tuning_hist_resolver)
+        if th_ln is None:
+            yield self.finding(
+                booster, tb.lineno,
+                "train_booster no longer consults the auto-tuner's "
+                "measured histogram engine (tuning.resolve_hist_engine "
+                "call missing) — the hint keys the step cache via "
+                "resolve_engine() and must be installed before the key")
+        elif th_ln >= cache_ln:
+            yield self.finding(
+                booster, th_ln,
+                f"tuning.resolve_hist_engine (line {th_ln}) must run "
+                f"before the first cache-key construction "
+                f"(line {cache_ln})")
+
+        def is_ladder_resolver(n: ast.AST) -> bool:
+            return (isinstance(n, ast.Call)
+                    and call_name(n)[1] == "resolve_bucket_ladder")
+
+        pl_ln = first_lineno(pp, is_ladder_resolver)
+        if pl_ln is None:
+            yield self.finding(
+                booster, pp.lineno,
+                "predict_plan no longer resolves the tuned bucket ladder "
+                "(tuning.resolve_bucket_ladder call missing) — n_pad "
+                "joins the key, so an unresolved ladder aliases tuned "
+                "and pow2 programs")
+        elif pl_ln >= pp_key_ln:
+            yield self.finding(
+                booster, pl_ln,
+                f"tuning.resolve_bucket_ladder (line {pl_ln}) must run "
+                f"before predict_plan's key assembly (line {pp_key_ln})")
+
         gc = next((n for n in ast.walk(api.tree)
                    if isinstance(n, ast.FunctionDef)
                    and n.name == "_grow_config"), None)

@@ -30,6 +30,11 @@ walks in ``tests/test_lint.py``:
   ``io/`` is an unjittered, deadline-blind retry (or a poll that should
   ride an Event); the sanctioned delays are ``robustness/policy.py``'s
   ``backoff`` / ``RetryPolicy.sleep_before``.
+* ``tuning-store-funnel`` — the auto-tuner's decision store is read and
+  written only by ``mmlspark_tpu/tuning/``; an ad-hoc ``load_store`` /
+  ``save_store`` call (or a re-spelled ``tuning.json``) bypasses the
+  format-version and fingerprint checks that make a stale store degrade
+  loudly to static rules.
 * ``placement-funnel`` — ``parallel/placement.py`` is THE device-placement
   layer (ROADMAP item 6): only it may call ``jax.device_put`` or construct
   ``NamedSharding``/``PartitionSpec``/``SingleDeviceSharding``
@@ -172,6 +177,22 @@ def _match_jax_export(mod: Module) -> Matches:
             yield node.lineno, "jax.export"
 
 
+def _match_tuning_store(mod: Module) -> Matches:
+    """The tuning store surface: calling its (de)serializers by name or
+    re-spelling the store filename. Either is one step from reading
+    decisions without the format-version + fingerprint checks that make
+    a stale or foreign store degrade loudly instead of mis-tuning."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            _qual, name = call_name(node)
+            if name in ("load_store", "save_store"):
+                yield node.lineno, f"{name}("
+        elif isinstance(node, ast.Constant) \
+                and isinstance(node.value, str) \
+                and node.value.strip().lower() == "tuning.json":
+            yield node.lineno, repr(node.value)
+
+
 def _match_loop_sleep(mod: Module) -> Matches:
     owner = mod.owner_map()
     for node in ast.walk(mod.tree):
@@ -298,6 +319,21 @@ FUNNEL_RULES: Tuple[FunnelRule, ...] = (
                "ad-hoc deserialize bypasses the manifest's fingerprint, "
                "checksum, and key-recomputation checks",
         anchors=(("mmlspark_tpu/bundles/bundle.py", "build_bundle"),),
+    ),
+    FunnelRule(
+        rule="tuning-store-funnel",
+        description="the tuning store (load_store / save_store / the "
+                    "tuning.json filename) only via mmlspark_tpu/tuning",
+        scope=("mmlspark_tpu",),
+        allow=("mmlspark_tpu/tuning/store.py",
+               "mmlspark_tpu/tuning/__init__.py"),
+        match=_match_tuning_store,
+        remedy="route through mmlspark_tpu.tuning (resolve_* / "
+               "snapshot_payload / provenance) — an ad-hoc store reader "
+               "bypasses the format-version and fingerprint checks that "
+               "make a stale store degrade to static rules instead of "
+               "mis-tuning the process",
+        anchors=(("mmlspark_tpu/tuning/store.py", "save_store"),),
     ),
     FunnelRule(
         rule="retry-sleep-funnel",
