@@ -53,8 +53,8 @@ from ...observability.logging import get_logger
 from ...robustness import failpoints as _failpoints
 from ...robustness import policy as _policy
 from ... import tuning as _tuning
-from ..serving import (_BATCH_SIZE_BUCKETS, debug_body, debug_route,
-                       observe_request_stages, stage_breakdown)
+from ..serving import (_BATCH_SIZE_BUCKETS, debug_body, debug_query,
+                       debug_route, observe_request_stages, stage_breakdown)
 from .http import BadRequest, ParsedRequest, read_request, write_response
 from .slots import SlotTable, resolve_slots
 
@@ -510,7 +510,8 @@ class AsyncServingServer:
         if _metrics.enabled():
             route = debug_route(parsed.method, parsed.path, api)
             if route is not None:
-                body, ctype = debug_body(route, api)
+                body, ctype = debug_body(route, api,
+                                         query=debug_query(parsed.path))
                 counter = (None if route == "metrics"
                            else "debug_requests_total")
                 if counter:

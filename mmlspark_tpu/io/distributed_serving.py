@@ -50,7 +50,7 @@ from ..observability.logging import get_logger
 from ..robustness import failpoints as _failpoints
 from ..robustness import policy as _policy
 from .http import HTTPConnectionPool
-from .serving import (ServingQuery, ServingServer, debug_route,
+from .serving import (ServingQuery, ServingServer, debug_query, debug_route,
                       write_debug_response, write_http_response)
 
 logger = get_logger("mmlspark_tpu.io.distributed_serving")
@@ -240,7 +240,8 @@ class GatewayServer:
                         # carries the federated cluster_* families and
                         # /debug/cluster the per-worker scrape health.
                         write_debug_response(self, route, outer.api_name,
-                                             federation=outer.federation)
+                                             federation=outer.federation,
+                                             query=debug_query(self.path))
                         return
                 # edge hop: adopt the client's trace or mint one; the
                 # active context is what _route injects into the worker

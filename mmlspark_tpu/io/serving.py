@@ -40,12 +40,14 @@ import queue
 import sys
 import threading
 import time
+import urllib.parse
 import uuid
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional
 
 from ..core.dataset import Dataset
+from ..observability import blackbox as _blackbox
 from ..observability import flight as _flight
 from ..observability import hbm as _hbm
 from ..observability import metrics as _metrics
@@ -88,6 +90,14 @@ SLO_PATH = "/debug/slo"
 TAIL_PATH = "/debug/tail"
 #: auto-tuner decisions + the evidence behind them (tuning store view)
 TUNING_PATH = "/debug/tuning"
+#: fleet black-box: every worker's flight deltas + lifecycle transitions
+#: merged in causal order (gateway federation view; a "no federation"
+#: note elsewhere)
+TIMELINE_PATH = "/debug/timeline"
+#: one stitched edge→gateway→worker trace (``?id=<trace_id>``; the
+#: gateway assembles from the fleet timeline, a worker answers with its
+#: own hop only)
+TRACE_PATH = "/debug/trace"
 
 #: (route name, path) table shared by the serving server and the gateway
 DEBUG_ROUTES = (
@@ -101,6 +111,8 @@ DEBUG_ROUTES = (
     ("slo", SLO_PATH),
     ("tail", TAIL_PATH),
     ("tuning", TUNING_PATH),
+    ("timeline", TIMELINE_PATH),
+    ("trace", TRACE_PATH),
 )
 
 
@@ -122,6 +134,16 @@ def debug_route(method: str, path: str, api_name: str) -> Optional[str]:
         if path_only in (route, f"/{api_name}{route}"):
             return name
     return None
+
+
+def debug_query(path: str) -> Dict[str, str]:
+    """Single-valued query params of a debug request path (the cursor
+    grammar ``/debug/flight?since=<seq>`` and ``/debug/trace?id=<id>``
+    ride on). ``debug_route`` drops the query before matching, so both
+    engines parse it here — one grammar, last value wins."""
+    query = urllib.parse.urlsplit(path).query
+    return {k: v[-1] for k, v in
+            urllib.parse.parse_qs(query).items() if v}
 
 
 def write_http_response(handler: BaseHTTPRequestHandler, status: int,
@@ -259,11 +281,16 @@ def varz_payload(api_name: str, federation: Optional[Any] = None
 
 
 def debug_body(route: str, api_name: str,
-               federation: Optional[Any] = None) -> tuple:
+               federation: Optional[Any] = None,
+               query: Optional[Dict[str, str]] = None) -> tuple:
     """``(body_bytes, content_type)`` for any debug route — the one
     payload builder both serving engines (the threaded handler below and
     the asyncio front in ``io/aserve``) answer debug traffic from, so
-    the exposition formats cannot drift between engines."""
+    the exposition formats cannot drift between engines. ``query`` is
+    the request's parsed query string (:func:`debug_query`): it carries
+    the ``/debug/flight?since=<seq>`` incremental-scrape cursor and the
+    ``/debug/trace?id=<trace_id>`` selector."""
+    query = query or {}
     if route == "metrics":
         extra = b"" if federation is None else federation.render_metrics()
         return (render_metrics() + extra,
@@ -294,21 +321,39 @@ def debug_body(route: str, api_name: str,
         payload = _tailsampler.snapshot_payload()
     elif route == "tuning":
         payload = _tuning.snapshot_payload()
+    elif route == "timeline":
+        payload = (federation.timeline_payload() if federation is not None
+                   else {"federation": None,
+                         "note": "no federation in this process (the "
+                                 "fleet timeline lives on the "
+                                 "distributed-serving gateway)"})
+    elif route == "trace":
+        trace_id = query.get("id")
+        payload = (federation.trace_payload(trace_id)
+                   if federation is not None
+                   else _blackbox.local_trace_payload(trace_id))
     else:
-        payload = _flight.snapshot()
+        since = None
+        try:
+            since = int(query["since"])
+        except (KeyError, ValueError):    # absent/garbage cursor: full ring
+            pass
+        payload = _flight.snapshot(since=since)
     return (json.dumps(payload, default=repr).encode("utf-8"),
             "application/json")
 
 
 def write_debug_response(handler: BaseHTTPRequestHandler, route: str,
                          api_name: str,
-                         federation: Optional[Any] = None) -> None:
+                         federation: Optional[Any] = None,
+                         query: Optional[Dict[str, str]] = None) -> None:
     """Answer any debug route in-band (never queued: these must work
     even when the batching worker or every backend worker is wedged).
     ``federation`` is the gateway's :class:`MetricsFederator`: it extends
     ``/metrics`` with the merged ``cluster_*`` families, ``/varz`` with
-    the scrape-health section, and backs ``/debug/cluster``."""
-    body, ctype = debug_body(route, api_name, federation)
+    the scrape-health section, and backs ``/debug/cluster``,
+    ``/debug/timeline`` and ``/debug/trace``."""
+    body, ctype = debug_body(route, api_name, federation, query)
     if route == "metrics":
         write_http_response(handler, 200, body, {"Content-Type": ctype})
         return
@@ -487,7 +532,8 @@ class ServingServer:
                     if route is not None:
                         # answered in-band, never queued: these must work
                         # even when the batching worker is wedged
-                        write_debug_response(self, route, outer.api_name)
+                        write_debug_response(self, route, outer.api_name,
+                                             query=debug_query(self.path))
                         return
                 # fault site: admission-side chaos (synthetic 5xx, added
                 # latency, connection-drop crash); ordered AFTER the

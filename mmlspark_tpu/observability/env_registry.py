@@ -116,7 +116,16 @@ REGISTRY: Tuple[EnvVar, ...] = (
                "`flight.set_capacity`)"),
     EnvVar(name="MMLSPARK_TPU_FLIGHT_DIR", default="(system temp dir)",
            doc="directory flight-ring dumps land in (crash, SIGUSR2, "
-               "watchdog stall, `/debug/flight`)"),
+               "watchdog stall, `/debug/flight`); shared-dir safe — "
+               "every dump is suffixed pid + per-process counter"),
+    EnvVar(name="MMLSPARK_TPU_TIMELINE_EVENTS", default="8192",
+           doc="fleet-timeline ring capacity on the gateway (merged "
+               "worker flight deltas + lifecycle events; "
+               "`/debug/timeline`)"),
+    EnvVar(name="MMLSPARK_TPU_FLIGHT_SCRAPE", default="1",
+           doc="`0` disables the federation sweep's incremental "
+               "`/debug/flight?since=` pull into the fleet timeline "
+               "(the `/metrics` scrape itself is unaffected)"),
     # -- federation / watchdog --------------------------------------------
     EnvVar(name="MMLSPARK_TPU_FEDERATION_INTERVAL_SECONDS", default="5.0",
            doc="gateway metrics-federation sweep period over registered "

@@ -31,11 +31,14 @@ deployment.
 from __future__ import annotations
 
 import http.client
+import json
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from . import blackbox as _blackbox
 from . import device as _device
+from . import flight as _flight
 from . import metrics as _metrics
 
 __all__ = [
@@ -287,6 +290,12 @@ class MetricsFederator:
         #: installs its BreakerBoard view so /debug/cluster shows which
         #: workers the routing plane is currently refusing
         self.breaker_states: Optional[Callable[[], Dict[str, str]]] = None
+        #: fleet black-box: worker flight deltas + lifecycle transitions
+        #: merged in causal order (/debug/timeline, /debug/trace); fed by
+        #: the sweep below when MMLSPARK_TPU_FLIGHT_SCRAPE allows
+        self.timeline = _blackbox.FleetTimeline()
+        # previous autoscale hint, for crossing-1.0 lifecycle events
+        self._prev_hint = 0.0
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "MetricsFederator":
@@ -301,9 +310,13 @@ class MetricsFederator:
                     target=self._run, args=(self._stop,),
                     name="mmlspark-federation", daemon=True)
                 self._thread.start()
+        # a crash/SIGUSR2 dump of THIS process also leaves the fleet
+        # timeline on disk, next to its own ring (same naming funnel)
+        self.timeline.install_dump_hook()
         return self
 
     def stop(self) -> None:
+        self.timeline.uninstall_dump_hook()
         # swap the handles under the lock, signal + join outside it: the
         # sweep thread takes _lock in scrape_once, so joining under it
         # could stall stop() for a full scrape timeout
@@ -327,14 +340,29 @@ class MetricsFederator:
                 pass
 
     # -- scraping ------------------------------------------------------------
+    #: consecutive scrape failures before a worker is declared
+    #: scrape-dead on the timeline (the freshness rule's 3-sweep horizon)
+    SCRAPE_DEAD_AFTER = 3
+
     def scrape_once(self) -> None:
         """One synchronous sweep over the current target set (tests call
-        this directly for determinism)."""
+        this directly for determinism). Besides ``/metrics``, the sweep
+        pulls each worker's flight delta (``/debug/flight?since=``) into
+        the fleet timeline and records lifecycle transitions — both
+        gated so the disabled deployment's sweep is byte-identical to
+        the pre-timeline one."""
+        pull = _metrics.enabled() and _blackbox.flight_scrape_enabled()
         targets = list(self.targets())
         seen = set()
         for label, host, port in targets:
             seen.add(label)
+            with self._lock:
+                known = label in self._workers
             st = self._worker(label)
+            if pull and not known:
+                self.timeline.lifecycle("worker_registered", worker=label,
+                                        addr=f"{host}:{port}")
+            was_failing = st.consecutive_failures
             st.last_attempt = time.time()
             try:
                 conn = http.client.HTTPConnection(host, int(port),
@@ -352,21 +380,93 @@ class MetricsFederator:
                 st.error = None
                 _metrics.safe_counter("federation_scrapes_total",
                                       worker=label, outcome="ok").inc()
+                if pull and was_failing:
+                    self.timeline.lifecycle("worker_scrape_recovered",
+                                            worker=label,
+                                            after_failures=was_failing)
+                if pull:
+                    self._pull_flight(label, host, int(port))
             except Exception as e:  # noqa: BLE001 — a sick worker is data
                 st.consecutive_failures += 1
                 st.error = f"{type(e).__name__}: {e}"
                 _metrics.safe_counter("federation_scrapes_total",
                                       worker=label, outcome="error").inc()
+                if pull and was_failing == 0:
+                    self.timeline.lifecycle("worker_scrape_failed",
+                                            worker=label, error=st.error)
+                if pull and st.consecutive_failures == self.SCRAPE_DEAD_AFTER:
+                    # the same horizon _fresh_states ages the worker out
+                    # of every derived signal at — a SIGKILLed worker's
+                    # death certificate on the timeline
+                    self.timeline.lifecycle("worker_scrape_dead",
+                                            worker=label, error=st.error,
+                                            consecutive_failures=st
+                                            .consecutive_failures)
         with self._lock:
             # deregistered workers leave the cluster view at the sweep
             # AFTER they leave the registry — no ghost series
-            for label in list(self._workers):
-                if label not in seen:
-                    del self._workers[label]
+            gone = [label for label in self._workers if label not in seen]
+            for label in gone:
+                del self._workers[label]
+        if pull:
+            for label in gone:
+                self.timeline.lifecycle("worker_deregistered", worker=label)
+            # the gateway's own ring joins the fleet timeline the same
+            # incremental way (no HTTP, same (worker, seq) dedup key) —
+            # breaker flips, failovers and deadline drops recorded by the
+            # routing plane become timeline events automatically
+            self.timeline.extend(
+                "gateway",
+                _flight.snapshot(since=self.timeline.cursor("gateway")))
         try:
-            self.autoscale_hint()       # refresh the gauge every sweep
+            hint_payload = self.autoscale_hint()  # refresh every sweep
+            if pull:
+                hint = float(hint_payload.get("hint") or 0.0)
+                if self._prev_hint < 1.0 <= hint:
+                    self.timeline.lifecycle("autoscale_pressure_high",
+                                            hint=hint)
+                elif hint < 1.0 <= self._prev_hint:
+                    self.timeline.lifecycle("autoscale_pressure_cleared",
+                                            hint=hint)
+                self._prev_hint = hint
         except Exception:  # noqa: BLE001 — advisory signal only
             pass
+
+    def _pull_flight(self, label: str, host: str, port: int) -> None:
+        """Incremental flight scrape of one worker into the timeline.
+        Failures are counted but never fail the sweep — the /metrics
+        scrape already succeeded, and flight data is forensics, not
+        health."""
+        try:
+            conn = http.client.HTTPConnection(host, port,
+                                              timeout=self.timeout)
+            conn.request(
+                "GET",
+                f"/debug/flight?since={self.timeline.cursor(label)}")
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            if resp.status != 200:
+                raise OSError(f"HTTP {resp.status}")
+            snap = json.loads(body.decode("utf-8", "replace"))
+            added = self.timeline.extend(label, snap)
+            _metrics.safe_counter("timeline_events_total",
+                                  worker=label).inc(added)
+            _metrics.safe_counter("timeline_scrapes_total",
+                                  worker=label, outcome="ok").inc()
+        except Exception:  # noqa: BLE001 — forensics must not fail health
+            _metrics.safe_counter("timeline_scrapes_total",
+                                  worker=label, outcome="error").inc()
+
+    # -- timeline / trace views (the /debug/timeline and /debug/trace
+    # bodies; thin delegates so debug_body only ever holds the federator)
+    def timeline_payload(self) -> Dict[str, Any]:
+        payload = self.timeline.snapshot_payload()
+        payload["interval_seconds"] = self.interval
+        return payload
+
+    def trace_payload(self, trace_id: Optional[str]) -> Dict[str, Any]:
+        return self.timeline.trace_payload(trace_id)
 
     def _worker(self, label: str) -> _WorkerState:
         with self._lock:

@@ -28,14 +28,15 @@ import sys
 import tempfile
 import threading
 import time
-from typing import Any, Deque, Dict, List, Optional
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 from . import metrics as _metrics
 from . import tracing as _tracing
 
 __all__ = [
     "record", "events", "clear", "dropped", "capacity", "set_capacity",
-    "set_default_fields", "snapshot", "dump", "dump_json",
+    "set_default_fields", "snapshot", "dump", "dump_json", "dump_path",
+    "add_dump_callback", "remove_dump_callback",
     "install", "uninstall", "DEFAULT_CAPACITY",
 ]
 
@@ -135,20 +136,33 @@ def set_default_fields(**fields: Any) -> None:
     _default_fields = {k: v for k, v in merged.items() if v is not None}
 
 
-def snapshot() -> Dict[str, Any]:
+def snapshot(since: Optional[int] = None) -> Dict[str, Any]:
     """JSON-safe view: events plus enough process identity to merge dumps
-    from several workers (this is the ``/debug/flight`` payload)."""
+    from several workers (this is the ``/debug/flight`` payload).
+
+    ``since`` is the incremental-scrape cursor: only events with
+    ``seq > since`` are included, and the payload's ``last_seq`` is the
+    highest ``seq`` ever assigned — the scraper passes it back as the
+    next ``?since=`` so repeated scrapes are deltas, not full rings."""
     with _lock:
-        evs = [dict(e) for e in _buf]
+        if since is None:
+            evs = [dict(e) for e in _buf]
+        else:
+            evs = [dict(e) for e in _buf if e.get("seq", 0) > since]
         drop = _dropped
-    return {
+        last = _seq
+    out = {
         "pid": os.getpid(),
         "time": time.time(),
         "capacity": capacity(),
         "dropped": drop,
+        "last_seq": last,
         "default_fields": dict(_default_fields),
         "events": evs,
     }
+    if since is not None:
+        out["since"] = since
+    return out
 
 
 def dump_json() -> bytes:
@@ -161,13 +175,34 @@ def _dump_dir() -> str:
     return os.environ.get(_DIR_ENV) or tempfile.gettempdir()
 
 
+_dump_seq = 0
+
+
+def dump_path(prefix: str = "flight") -> str:
+    """A fresh, collision-free dump path:
+    ``$MMLSPARK_TPU_FLIGHT_DIR/{prefix}-{pid}-{ts}-{n}.json``.
+
+    Every dump producer (explicit :func:`dump`, the SIGUSR2/excepthook
+    crash hooks, the watchdog's stall dump, the fleet timeline) names
+    files through this one funnel. The pid plus a per-process monotonic
+    counter make the name unique even when a gateway and several workers
+    share one ``MMLSPARK_TPU_FLIGHT_DIR`` and dump within the same
+    second (a wall-clock-only suffix silently overwrote the earlier
+    dump — exactly the forensics a post-mortem needed)."""
+    global _dump_seq
+    with _lock:
+        _dump_seq += 1
+        n = _dump_seq
+    return os.path.join(
+        _dump_dir(),
+        f"{prefix}-{os.getpid()}-{int(time.time())}-{n:04d}.json")
+
+
 def dump(path: Optional[str] = None) -> str:
-    """Write the snapshot to ``path`` (default:
-    ``$MMLSPARK_TPU_FLIGHT_DIR/flight-{pid}-{ts}.json``); returns the
-    path written."""
+    """Write the snapshot to ``path`` (default: :func:`dump_path`);
+    returns the path written."""
     if path is None:
-        path = os.path.join(
-            _dump_dir(), f"flight-{os.getpid()}-{int(time.time())}.json")
+        path = dump_path()
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     with open(path, "wb") as f:
@@ -183,12 +218,41 @@ _prev_excepthook = None
 _prev_signal = None
 _installed_signum: Optional[int] = None
 
+# companions dumped alongside the ring by both crash hooks — e.g. the
+# gateway's fleet timeline registers here so a SIGUSR2 poke or an
+# unhandled exception leaves the cluster-wide story next to the local one
+_dump_callbacks: List[Callable[[], Any]] = []
+
+
+def add_dump_callback(fn: Callable[[], Any]) -> None:
+    """Register ``fn`` to run whenever a crash hook dumps the ring
+    (SIGUSR2 / excepthook). Idempotent; exceptions are swallowed —
+    a companion dump must never abort the primary one."""
+    if fn not in _dump_callbacks:
+        _dump_callbacks.append(fn)
+
+
+def remove_dump_callback(fn: Callable[[], Any]) -> None:
+    try:
+        _dump_callbacks.remove(fn)
+    except ValueError:
+        pass
+
+
+def _run_dump_callbacks() -> None:
+    for fn in list(_dump_callbacks):
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — never kill the crash hook
+            pass
+
 
 def _on_signal(signum, frame) -> None:  # noqa: ARG001 — signal signature
     try:
         from . import logging as _logging  # lazy: logging imports flight
         record("signal_dump", signum=int(signum))
         path = dump()
+        _run_dump_callbacks()
         _logging.console(f"[flight] dumped {len(events())} events to {path}",
                          err=True)
     except Exception:  # noqa: BLE001 — a dump hook must never kill the host
@@ -201,6 +265,7 @@ def _on_unhandled(exc_type, exc, tb) -> None:
         record("unhandled_exception",
                error=f"{exc_type.__name__}: {exc}")
         path = dump()
+        _run_dump_callbacks()
         _logging.console(f"[flight] unhandled exception; dumped to {path}",
                          err=True)
     except Exception:  # noqa: BLE001

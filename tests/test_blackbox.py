@@ -1,0 +1,733 @@
+"""Fleet black-box recorder: cursor scrapes, timeline merge, post-mortems.
+
+Covers observability/blackbox.py and its wiring end to end:
+
+* flight-ring cursor semantics (``snapshot(since=)`` / ``last_seq``),
+  shrink-resize ordering, gap-free ``seq`` under concurrent writers, and
+  mid-record SIGUSR2 dump self-consistency;
+* the collision-free dump naming funnel (pid + per-process counter)
+  shared by dump()/SIGUSR2/excepthook, plus companion dump callbacks;
+* ``FleetTimeline``: (worker, seq) dedup, causal merge order, restart
+  detection, bounded eviction, lifecycle gating, trace assembly with the
+  Chrome export;
+* the federation sweep pulling flight deltas + recording lifecycle
+  transitions, and the ``MMLSPARK_TPU_FLIGHT_SCRAPE=0`` byte-identical
+  no-op contract;
+* ``tools/postmortem.py`` reconstructing a failure from artifacts alone
+  (offline fast path here; the 3-process SIGKILL acceptance is the
+  slow-marked chaos test at the bottom).
+"""
+
+import glob
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from mmlspark_tpu.io.serving import (DEBUG_ROUTES, ServingQuery,
+                                     ServingServer, TIMELINE_PATH,
+                                     TRACE_PATH, debug_body, debug_query)
+from mmlspark_tpu.observability import blackbox, flight, metrics, spans, \
+    tracing
+from mmlspark_tpu.observability.federation import MetricsFederator
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACE_ID = "c" * 32
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    prev = metrics.set_enabled(True)
+    metrics.reset()
+    flight.clear()
+    spans.clear_trace()
+    yield
+    flight.uninstall()
+    flight.set_capacity(flight.DEFAULT_CAPACITY)
+    metrics.set_enabled(prev)
+    metrics.reset()
+    flight.clear()
+    spans.clear_trace()
+
+
+def _record_n(n, kind="ev", **fields):
+    for i in range(n):
+        flight.record(kind, i=i, **fields)
+
+
+# ---------------------------------------------------------------------------
+# Flight ring: cursor, resize, concurrency, crash dumps
+# ---------------------------------------------------------------------------
+
+
+class TestFlightCursor:
+    def test_since_filters_and_last_seq_advances(self):
+        _record_n(5)
+        full = flight.snapshot()
+        assert [e["seq"] for e in full["events"]] == [1, 2, 3, 4, 5]
+        assert full["last_seq"] == 5 and "since" not in full
+        delta = flight.snapshot(since=3)
+        assert [e["seq"] for e in delta["events"]] == [4, 5]
+        assert delta["since"] == 3 and delta["last_seq"] == 5
+        # cursor past the end -> empty delta, but last_seq still tells
+        # the scraper where the ring is
+        assert flight.snapshot(since=5)["events"] == []
+
+    def test_since_sees_only_events_survived_by_the_ring(self):
+        flight.set_capacity(4)
+        _record_n(10)
+        delta = flight.snapshot(since=2)
+        # seqs 3..6 were evicted by the ring: the delta is what survived
+        assert [e["seq"] for e in delta["events"]] == [7, 8, 9, 10]
+        assert delta["last_seq"] == 10
+
+    def test_capacity_shrink_drops_oldest_first_seq_monotonic(self):
+        _record_n(10)
+        before = flight.dropped()
+        flight.set_capacity(4)
+        seqs = [e["seq"] for e in flight.events()]
+        # oldest-first eviction: exactly the newest 4 survive, in order
+        assert seqs == [7, 8, 9, 10]
+        assert flight.dropped() == before + 6
+        # seq keeps counting monotonically across the resize
+        _record_n(2, kind="post")
+        seqs = [e["seq"] for e in flight.events()]
+        assert seqs == [9, 10, 11, 12]
+        assert seqs == sorted(seqs)
+
+    def test_concurrent_writers_gap_free_duplicate_free_under_wrap(self):
+        flight.set_capacity(256)
+        n_threads, per_thread = 8, 100
+        barrier = threading.Barrier(n_threads)
+
+        def writer(t):
+            barrier.wait()
+            for i in range(per_thread):
+                flight.record("w", t=t, i=i)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = n_threads * per_thread
+        seqs = [e["seq"] for e in flight.events()]
+        # the retained window is EXACTLY the densest possible suffix:
+        # no gap, no duplicate, no reordering — under wrap
+        assert seqs == list(range(total - 256 + 1, total + 1))
+        assert flight.dropped() == total - 256
+        assert flight.snapshot()["last_seq"] == total
+
+    def test_mid_record_sigusr2_dump_stays_self_consistent(
+            self, tmp_path, monkeypatch):
+        if not hasattr(signal, "SIGUSR2"):
+            pytest.skip("no SIGUSR2 on this platform")
+        monkeypatch.setenv("MMLSPARK_TPU_FLIGHT_DIR", str(tmp_path))
+        flight.set_capacity(128)
+        flight.install(excepthook=False)
+        stop = threading.Event()
+
+        def writer(t):
+            i = 0
+            while not stop.is_set():
+                flight.record("w", t=t, i=i)
+                i += 1
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(3):
+                time.sleep(0.05)
+                os.kill(os.getpid(), signal.SIGUSR2)
+            time.sleep(0.05)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        dumps = sorted(glob.glob(str(tmp_path / "flight-*.json")))
+        # three signals -> three files (the pid+counter suffix means the
+        # same second can't collapse them into one)
+        assert len(dumps) == 3, dumps
+        for path in dumps:
+            with open(path) as f:
+                doc = json.load(f)          # a torn dump would not parse
+            seqs = [e["seq"] for e in doc["events"] if "seq" in e]
+            # the RLock lets the in-signal dump observe at most one
+            # half-appended event; the ring itself must stay ordered and
+            # duplicate-free
+            assert seqs == sorted(seqs)
+            assert len(seqs) == len(set(seqs))
+            assert any(e.get("kind") == "signal_dump"
+                       for e in doc["events"])
+
+
+class TestDumpNamingFunnel:
+    _NAME = re.compile(r"flight-(\d+)-(\d+)-(\d{4})\.json$")
+
+    def test_paths_are_unique_within_one_second(self, tmp_path,
+                                                monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_FLIGHT_DIR", str(tmp_path))
+        flight.record("x")
+        paths = {flight.dump() for _ in range(5)}
+        assert len(paths) == 5               # same second, five files
+        for p in paths:
+            m = self._NAME.search(p)
+            assert m, p
+            assert int(m.group(1)) == os.getpid()
+
+    def test_crash_hooks_use_the_funnel_and_run_callbacks(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_FLIGHT_DIR", str(tmp_path))
+        companion = []
+        flight.add_dump_callback(lambda: companion.append(1))
+        try:
+            flight._on_unhandled(ValueError, ValueError("boom"), None)
+        finally:
+            flight.remove_dump_callback(
+                next(iter(flight._dump_callbacks), None) or (lambda: 0))
+        dumps = glob.glob(str(tmp_path / "flight-*.json"))
+        assert len(dumps) == 1
+        assert self._NAME.search(dumps[0])
+        with open(dumps[0]) as f:
+            doc = json.load(f)
+        assert any(e.get("kind") == "unhandled_exception"
+                   for e in doc["events"])
+        assert companion == [1]
+
+    def test_callbacks_are_idempotent_and_removable(self):
+        calls = []
+
+        def cb():
+            calls.append(1)
+
+        flight.add_dump_callback(cb)
+        flight.add_dump_callback(cb)         # second add is a no-op
+        flight._run_dump_callbacks()
+        assert calls == [1]
+        flight.remove_dump_callback(cb)
+        flight.remove_dump_callback(cb)      # double remove is safe
+        flight._run_dump_callbacks()
+        assert calls == [1]
+
+
+# ---------------------------------------------------------------------------
+# FleetTimeline
+# ---------------------------------------------------------------------------
+
+
+class TestFleetTimeline:
+    def test_worker_seq_dedup_across_repeat_scrapes(self):
+        _record_n(3)
+        tl = blackbox.FleetTimeline(capacity=64)
+        snap = flight.snapshot()
+        assert tl.extend("w1", snap) == 3
+        # the same payload again (a retried scrape) adds nothing
+        assert tl.extend("w1", snap) == 0
+        assert tl.cursor("w1") == 3
+        # the incremental path picks up only the new tail
+        _record_n(2, kind="late")
+        assert tl.extend("w1", flight.snapshot(since=tl.cursor("w1"))) == 2
+        kinds = [e["kind"] for e in tl.events()]
+        assert kinds == ["ev", "ev", "ev", "late", "late"]
+
+    def test_eviction_jump_advances_cursor_past_the_hole(self):
+        tl = blackbox.FleetTimeline(capacity=64)
+        # worker ring wrapped: events 1..90 evicted, 91..92 survive
+        tl.extend("w1", {"pid": 7, "last_seq": 92, "events": [
+            {"kind": "a", "ts": 1.0, "seq": 91},
+            {"kind": "b", "ts": 2.0, "seq": 92}]})
+        assert tl.cursor("w1") == 92
+        # an empty delta with a further last_seq still advances
+        tl.extend("w1", {"pid": 7, "last_seq": 120, "events": []})
+        assert tl.cursor("w1") == 120
+
+    def test_pid_change_resets_cursor_and_records_restart(self):
+        tl = blackbox.FleetTimeline(capacity=64)
+        tl.extend("w1", {"pid": 7, "last_seq": 5, "events": [
+            {"kind": "a", "ts": 1.0, "seq": 5}]})
+        assert tl.cursor("w1") == 5
+        # same label, new pid: a restarted worker starts a new seq space
+        added = tl.extend("w1", {"pid": 8, "last_seq": 1, "events": [
+            {"kind": "b", "ts": 2.0, "seq": 1}]})
+        assert added == 1
+        assert tl.cursor("w1") == 1
+        kinds = [e["kind"] for e in tl.events()]
+        assert "worker_restarted" in kinds
+
+    def test_causal_merge_order_across_workers(self):
+        tl = blackbox.FleetTimeline(capacity=64)
+        tl.extend("w2", {"pid": 2, "events": [
+            {"kind": "second", "ts": 20.0, "seq": 1}]})
+        tl.extend("w1", {"pid": 1, "events": [
+            {"kind": "first", "ts": 10.0, "seq": 1},
+            {"kind": "third", "ts": 30.0, "seq": 2}]})
+        kinds = [e["kind"] for e in tl.events()]
+        # wall-clock causal order, not arrival order
+        assert kinds == ["first", "second", "third"]
+
+    def test_bounded_with_drop_count(self):
+        tl = blackbox.FleetTimeline(capacity=3)
+        tl.extend("w1", {"pid": 1, "events": [
+            {"kind": f"k{i}", "ts": float(i), "seq": i + 1}
+            for i in range(5)]})
+        assert [e["kind"] for e in tl.events()] == ["k2", "k3", "k4"]
+        assert tl.dropped() == 2
+        payload = tl.snapshot_payload()
+        assert payload["capacity"] == 3 and payload["dropped"] == 2
+
+    def test_lifecycle_events_gated_by_kill_switch(self):
+        tl = blackbox.FleetTimeline(capacity=8)
+        metrics.set_enabled(False)
+        try:
+            tl.lifecycle("worker_registered", worker="w1")
+        finally:
+            metrics.set_enabled(True)
+        assert tl.events() == []
+        tl.lifecycle("worker_registered", worker="w1", addr="h:1")
+        ev, = tl.events()
+        assert ev["kind"] == "worker_registered"
+        assert ev["worker"] == "w1" and ev["source"] == "lifecycle"
+
+    def test_trace_assembly_tree_and_chrome_export(self):
+        tl = blackbox.FleetTimeline(capacity=64)
+        tl.extend("gateway", {"pid": 1, "events": [
+            {"kind": "span_end", "name": "gateway_request", "ts": 10.0,
+             "dur_us": 5000, "seq": 1, "trace_id": TRACE_ID}]})
+        tl.extend("w1", {"pid": 2, "events": [
+            {"kind": "span_end", "name": "serving_request", "ts": 10.002,
+             "dur_us": 2000, "seq": 1, "trace_id": TRACE_ID},
+            {"kind": "other_trace", "ts": 11.0, "seq": 2,
+             "trace_id": "d" * 32}]})
+        payload = tl.trace_payload(TRACE_ID)
+        assert payload["found"] is True
+        assert payload["hops"] == ["gateway", "w1"]     # causal order
+        roles = [h["role"] for h in payload["tree"]]
+        assert roles == ["gateway", "worker"]
+        assert all(e["trace_id"] == TRACE_ID for e in payload["events"])
+        chrome = payload["chrome_trace"]
+        names = {e.get("name") for e in chrome["traceEvents"]}
+        assert {"gateway_request", "serving_request",
+                "process_name"} <= names
+        slice_ = next(e for e in chrome["traceEvents"]
+                      if e.get("name") == "gateway_request")
+        assert slice_["ph"] == "X" and slice_["dur"] == 5000.0
+        assert slice_["ts"] == pytest.approx(10.0 * 1e6 - 5000)
+        # no id -> the listing, newest first
+        listing = tl.trace_payload(None)
+        assert listing["trace_ids"] == ["d" * 32, TRACE_ID]
+
+    def test_timeline_dump_rides_the_flight_crash_hook(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_FLIGHT_DIR", str(tmp_path))
+        tl = blackbox.FleetTimeline(capacity=8)
+        tl.lifecycle("worker_registered", worker="w1")
+        tl.install_dump_hook()
+        try:
+            flight._on_unhandled(RuntimeError, RuntimeError("die"), None)
+        finally:
+            tl.uninstall_dump_hook()
+        timelines = glob.glob(str(tmp_path / "timeline-*.json"))
+        assert len(timelines) == 1
+        with open(timelines[0]) as f:
+            doc = json.load(f)
+        assert [e["kind"] for e in doc["events"]] == ["worker_registered"]
+        # the ring dump landed next to it, neither overwrote the other
+        assert glob.glob(str(tmp_path / "flight-*.json"))
+
+
+# ---------------------------------------------------------------------------
+# Debug routes: cursor + timeline/trace through debug_body
+# ---------------------------------------------------------------------------
+
+
+class TestDebugRoutes:
+    def test_new_routes_are_registered(self):
+        paths = {path for _name, path in DEBUG_ROUTES}
+        assert TIMELINE_PATH in paths and TRACE_PATH in paths
+
+    def test_flight_route_since_cursor(self):
+        _record_n(4)
+        body, ctype = debug_body(
+            "flight", "api", query=debug_query("/debug/flight?since=2"))
+        assert ctype == "application/json"
+        payload = json.loads(body)
+        assert [e["seq"] for e in payload["events"]] == [3, 4]
+        # a garbage cursor degrades to the full ring, never a 500
+        body, _ = debug_body("flight", "api",
+                             query=debug_query("/debug/flight?since=nope"))
+        assert len(json.loads(body)["events"]) == 4
+
+    def test_timeline_and_trace_note_payloads_off_gateway(self):
+        body, _ = debug_body("timeline", "api")
+        assert json.loads(body)["federation"] is None
+        ctx = tracing.TraceContext(trace_id=TRACE_ID, span_id="b" * 16)
+        token = tracing.activate(ctx)
+        try:
+            flight.record("local_mark")
+        finally:
+            tracing.deactivate(token)
+        body, _ = debug_body(
+            "trace", "api",
+            query=debug_query(f"/debug/trace?id={TRACE_ID}"))
+        payload = json.loads(body)
+        assert payload["found"] is True and payload["federation"] is None
+        assert payload["hops"] == [f"local:{os.getpid()}"]
+        # and the listing form surfaces the id
+        body, _ = debug_body("trace", "api")
+        assert TRACE_ID in json.loads(body)["trace_ids"]
+
+
+# ---------------------------------------------------------------------------
+# Federation sweep: flight pull, lifecycle, kill-switch no-op
+# ---------------------------------------------------------------------------
+
+
+class _RecordingWorker:
+    """Minimal scrape target that logs every path asked of it."""
+
+    def __init__(self):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                outer.paths.append(self.path)
+                if self.path.startswith("/metrics"):
+                    body = b"# TYPE served_total counter\nserved_total 1\n"
+                    ctype = "text/plain"
+                else:
+                    body = json.dumps(
+                        {"pid": 424242, "last_seq": 2, "events": [
+                            {"kind": "w_ev", "ts": time.time(), "seq": 1},
+                            {"kind": "w_ev", "ts": time.time(), "seq": 2},
+                        ]}).encode()
+                    ctype = "application/json"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.paths = []
+        self.httpd = ThreadingHTTPServer(("localhost", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestFederationTimeline:
+    def test_sweep_pulls_deltas_and_records_lifecycle(self):
+        w = _RecordingWorker()
+        targets = [("w1", "localhost", w.port)]
+        fed = MetricsFederator(lambda: list(targets), interval=999)
+        try:
+            fed.scrape_once()
+            assert "/debug/flight?since=0" in w.paths
+            evs = fed.timeline.events()
+            kinds = [e["kind"] for e in evs]
+            assert kinds.count("worker_registered") == 1
+            assert kinds.count("w_ev") == 2
+            assert all(e["worker"] == "w1" for e in evs
+                       if e["kind"] == "w_ev")
+            # second sweep: cursor advanced, no re-registration, no dupes
+            fed.scrape_once()
+            assert "/debug/flight?since=2" in w.paths
+            kinds = [e["kind"] for e in fed.timeline.events()]
+            assert kinds.count("w_ev") == 2
+            assert kinds.count("worker_registered") == 1
+            # the gateway's own ring joins under the "gateway" label
+            flight.record("gateway_failover", worker="w1")
+            fed.scrape_once()
+            gw = [e for e in fed.timeline.events()
+                  if e.get("worker") == "gateway"]
+            assert any(e["kind"] == "gateway_failover" for e in gw)
+            # scrape death: kill the worker, fail three sweeps
+            w.stop()
+            for _ in range(3):
+                fed.scrape_once()
+            kinds = [e["kind"] for e in fed.timeline.events()]
+            assert "worker_scrape_failed" in kinds
+            assert "worker_scrape_dead" in kinds
+            # deregistration (registry drops it) is a timeline event too
+            targets[:] = []
+            fed.scrape_once()
+            kinds = [e["kind"] for e in fed.timeline.events()]
+            assert "worker_deregistered" in kinds
+            payload = fed.timeline_payload()
+            assert payload["cursors"]["w1"] == 2
+            assert payload["worker_pids"]["w1"] == 424242
+        finally:
+            fed.stop()
+            w.stop()
+
+    def test_flight_scrape_toggle_is_byte_identical_noop(
+            self, monkeypatch):
+        w = _RecordingWorker()
+        fed = MetricsFederator(lambda: [("w1", "localhost", w.port)],
+                               interval=999)
+        try:
+            monkeypatch.setenv("MMLSPARK_TPU_FLIGHT_SCRAPE", "0")
+            fed.scrape_once()
+            # the sweep asked for /metrics and NOTHING else: no flight
+            # request, no timeline writes, no lifecycle events — the
+            # pre-timeline sweep, byte for byte
+            assert w.paths == ["/metrics"]
+            assert fed.timeline.events() == []
+            assert fed.timeline.snapshot_payload()["scrape_enabled"] \
+                is False
+            # metrics federation itself is untouched by the toggle
+            assert b"cluster_served_total" in fed.render_metrics()
+            monkeypatch.delenv("MMLSPARK_TPU_FLIGHT_SCRAPE")
+            fed.scrape_once()
+            assert "/debug/flight?since=0" in w.paths
+            assert fed.timeline.events() != []
+        finally:
+            fed.stop()
+            w.stop()
+
+    def test_disabled_telemetry_skips_the_pull(self):
+        w = _RecordingWorker()
+        fed = MetricsFederator(lambda: [("w1", "localhost", w.port)],
+                               interval=999)
+        metrics.set_enabled(False)
+        try:
+            fed.scrape_once()
+            assert all("/debug/flight" not in p for p in w.paths)
+            assert fed.timeline.events() == []
+        finally:
+            metrics.set_enabled(True)
+            fed.stop()
+            w.stop()
+
+
+class TestServingScrapeRoundTrip:
+    def test_incremental_scrape_against_a_live_server(self):
+        """The wire-level contract the federator depends on: a real
+        ServingServer answers ?since= with exactly the delta, on the
+        shared debug funnel."""
+        import http.client as hc
+
+        server = ServingServer("localhost", 0, "bb")
+        q = ServingQuery(server, lambda ds: ds.with_column("reply", [
+            {"entity": {"i": v["i"]}, "statusCode": 200}
+            for v in ds["value"]]), max_batch=4, max_latency=0.005)
+        q.start()
+        try:
+            flight.record("mark_a")
+
+            def get(path):
+                conn = hc.HTTPConnection(server.host, server.port,
+                                         timeout=10)
+                conn.request("GET", path)
+                r = conn.getresponse()
+                body = r.read()
+                conn.close()
+                assert r.status == 200
+                return json.loads(body)
+
+            first = get("/debug/flight")
+            cursor = first["last_seq"]
+            assert any(e["kind"] == "mark_a" for e in first["events"])
+            flight.record("mark_b")
+            delta = get(f"/debug/flight?since={cursor}")
+            kinds = [e["kind"] for e in delta["events"]]
+            assert "mark_b" in kinds and "mark_a" not in kinds
+            # the new routes answer on a plain worker too (note payloads)
+            assert get(TIMELINE_PATH)["federation"] is None
+            assert get(f"{TRACE_PATH}?id={'e' * 32}")["found"] is False
+        finally:
+            q.stop()
+
+
+# ---------------------------------------------------------------------------
+# tools/postmortem.py — offline, artifacts only
+# ---------------------------------------------------------------------------
+
+
+def _timeline_dump(tmp_path, worker="127.0.0.1:9901"):
+    base = time.time()
+    events = [
+        {"kind": "worker_registered", "ts": base, "worker": worker,
+         "source": "lifecycle", "timeline_seq": 1},
+        {"kind": "span_end", "name": "serving_request", "ts": base + 1.0,
+         "dur_us": 1500, "seq": 41, "worker": worker,
+         "trace_id": TRACE_ID, "source": "flight", "timeline_seq": 2},
+        {"kind": "span_end", "name": "gateway_request", "ts": base + 1.001,
+         "dur_us": 2500, "seq": 7, "worker": "gateway",
+         "trace_id": TRACE_ID, "source": "flight", "timeline_seq": 3},
+        {"kind": "batch_error", "ts": base + 1.5, "seq": 42,
+         "worker": worker, "error": "KABOOM", "source": "flight",
+         "timeline_seq": 4},
+        {"kind": "breaker_transition", "ts": base + 2.0, "seq": 8,
+         "worker": "gateway", "breaker": worker, "to": "open",
+         "source": "flight", "timeline_seq": 5},
+        {"kind": "gateway_failover", "ts": base + 2.1, "seq": 9,
+         "worker": "gateway", "addr": worker, "reason": "connect",
+         "source": "flight", "timeline_seq": 6},
+        {"kind": "worker_scrape_dead", "ts": base + 3.0, "worker": worker,
+         "error": "ConnectionRefusedError", "source": "lifecycle",
+         "timeline_seq": 7},
+    ]
+    doc = {"pid": 999, "time": base + 4, "capacity": 8192, "dropped": 0,
+           "scrape_enabled": True, "cursors": {worker: 42, "gateway": 9},
+           "worker_pids": {worker: 1234}, "events": events}
+    path = tmp_path / "timeline-999-1000-0001.json"
+    path.write_text(json.dumps(doc))
+    return doc
+
+
+class TestPostmortemOffline:
+    def test_reconstructs_failure_from_artifacts_alone(self, tmp_path):
+        """The acceptance bar, minus the subprocesses: every process
+        already dead, only MMLSPARK_TPU_FLIGHT_DIR artifacts left — one
+        invocation names the window, the worker, its final events, the
+        breaker/failover sequence, and stitches the trace."""
+        worker = "127.0.0.1:9901"
+        _timeline_dump(tmp_path, worker)
+        out = tmp_path / "pm"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "postmortem.py"),
+             "--flight-dir", str(tmp_path), "--out", str(out)],
+            capture_output=True, text=True, timeout=120,
+            cwd=str(tmp_path))
+        assert proc.returncode == 0, proc.stderr
+        report = (out / "report.txt").read_text()
+        assert f"Implicated worker: {worker}" in report
+        assert "DEAD at collection" in report
+        # the dead worker's final pre-kill flight events, with their seqs
+        assert "batch_error" in report and "KABOOM" in report
+        assert "Failure window" in report
+        # breaker/failover sequence in order
+        seq_section = report.split("## Breaker / failover sequence")[1]
+        assert seq_section.index("breaker_transition") \
+            < seq_section.index("gateway_failover")
+        # one stitched trace, gateway hop + worker hop
+        assert f"trace {TRACE_ID} across 2 hop(s)" in report
+        assert "gateway_request" in report and "serving_request" in report
+        # and the archive keeps the dump copies next to the report
+        assert (out / "dumps").is_dir()
+
+    def test_usage_error_without_sources(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "postmortem.py")],
+            capture_output=True, text=True, timeout=60,
+            env={**os.environ, "MMLSPARK_TPU_FLIGHT_DIR": ""})
+        assert proc.returncode == 2
+        assert "--gateway" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# 3-process chaos acceptance (slow: subprocess spawns + kill + scrapes)
+# ---------------------------------------------------------------------------
+
+
+class TestChaosPostmortem:
+    @pytest.mark.chaos
+    @pytest.mark.slow
+    def test_sigkill_postmortem_reconstructs_from_artifacts(
+            self, tmp_path):
+        """The ISSUE acceptance: 2 workers + gateway, injected 503s,
+        one worker SIGKILLed mid-traffic. With the worker dead, one
+        postmortem.py run reconstructs its final pre-kill flight events
+        (pulled into the gateway timeline before the kill), the
+        failover, and a stitched edge→gateway→worker trace."""
+        from tests.test_resilience import (TRACE_ID as CHAOS_TRACE_ID,
+                                           TRACEPARENT, _gateway_env,
+                                           _request, _spawn_gateway,
+                                           _spawn_worker, _warm_workers)
+
+        registry = tmp_path / "registry"
+        flight_dir = tmp_path / "flight"
+        env = _gateway_env({
+            "MMLSPARK_TPU_FEDERATION_INTERVAL_SECONDS": "0.2",
+            "MMLSPARK_TPU_GATEWAY_HEALTH_INTERVAL_SECONDS": "0.3",
+            "MMLSPARK_TPU_FLIGHT_DIR": str(flight_dir),
+        })
+        genv = dict(env)
+        genv["MMLSPARK_TPU_FAILPOINTS"] = "gateway.route:error_503:0.05"
+        genv["MMLSPARK_TPU_FAILPOINTS_SEED"] = "7"
+        wa, porta = _spawn_worker(registry, env)
+        wb, portb = _spawn_worker(registry, env)
+        gw, host, port = _spawn_gateway(registry, genv)
+        killed = f"localhost:{porta}"
+        try:
+            _warm_workers(host, port, 2)
+            # traced traffic so span_end events carry one trace id
+            # end to end, then plain traffic to spread load
+            for k in range(30):
+                _request(host, port, "/serving", json.dumps({"i": k}),
+                         headers={"traceparent": TRACEPARENT})
+            # let the sweep pull both workers' rings into the timeline
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                status, body, _ = _request(host, port, "/debug/timeline")
+                assert status == 200
+                cursors = json.loads(body).get("cursors") or {}
+                if cursors.get(killed, 0) > 0 and \
+                        cursors.get(f"localhost:{portb}", 0) > 0:
+                    break
+                time.sleep(0.2)
+            else:
+                pytest.fail(f"timeline never saw both workers: {cursors}")
+            wa.kill()                        # SIGKILL: no drain, no dump
+            wa.wait(timeout=30)
+            # traffic continues; the gateway fails over off the corpse
+            for k in range(40):
+                _request(host, port, "/serving", json.dumps({"i": 100 + k}))
+            # wait for the timeline to certify the death
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                _status, body, _ = _request(host, port, "/debug/timeline")
+                kinds = {e.get("kind")
+                         for e in json.loads(body).get("events") or []}
+                if "worker_scrape_dead" in kinds:
+                    break
+                time.sleep(0.2)
+            out = tmp_path / "pm"
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(ROOT, "tools", "postmortem.py"),
+                 "--gateway", f"{host}:{port}",
+                 "--flight-dir", str(flight_dir),
+                 "--out", str(out), "--trace", CHAOS_TRACE_ID],
+                capture_output=True, text=True, timeout=120, env=env)
+            assert proc.returncode == 0, proc.stderr
+            report = (out / "report.txt").read_text()
+            # the killed worker is named, and named DEAD
+            assert f"Implicated worker: {killed}" in report
+            assert "DEAD at collection" in report
+            # its final pre-kill flight events survived it (scraped into
+            # the gateway timeline before the SIGKILL)
+            assert "serving_request" in report
+            # the failure window and the failover story are there
+            assert "Failure window" in report
+            assert "worker_scrape_dead" in report
+            # one fully stitched edge→gateway→worker trace
+            m = re.search(rf"trace {CHAOS_TRACE_ID} across (\d+) hop", report)
+            assert m, report
+            assert int(m.group(1)) >= 2
+            hops_block = report.split("## Stitched trace")[1]
+            assert "gateway:" in hops_block
+            assert "gateway_request" in hops_block
+            assert "serving_request" in hops_block
+        finally:
+            for p in (wa, wb, gw):
+                p.terminate()
+            for p in (wb, gw):
+                p.wait(timeout=30)

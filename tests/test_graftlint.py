@@ -1229,6 +1229,56 @@ class TestDebugRouteRegistry:
         got = hits(active, "debug-route-registry", "<graftlint>")
         assert len(got) == 1 and "lint-rot" in got[0].message, active
 
+    def test_real_table_declares_timeline_and_trace(self):
+        # the fleet black-box routes ride the same funnel: the live table
+        # must declare them, or the corpus rule above couldn't vouch for
+        # the real handlers
+        from tools.graftlint.checks.debugroutes import _declared_paths
+        declared = _declared_paths(core.Repo(ROOT))
+        assert {"/debug/flight", "/debug/timeline",
+                "/debug/trace"} <= declared
+
+
+# --------------------------------------------------------------------------
+# postmortem-scrape-only
+# --------------------------------------------------------------------------
+
+class TestPostmortemScrapeOnly:
+    def test_stdlib_only_collector_clean(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "postmortem-scrape-only", {
+            "mmlspark_tpu/__init__.py": "",
+            "tools/postmortem.py": """\
+                import json
+                import urllib.request
+
+                def fetch(addr, path):
+                    with urllib.request.urlopen(
+                            f"http://{addr}{path}") as r:
+                        return json.load(r)
+            """})
+        assert not hits(active, "postmortem-scrape-only"), active
+
+    def test_framework_imports_flagged(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "postmortem-scrape-only", {
+            "mmlspark_tpu/__init__.py": "",
+            "tools/postmortem.py": """\
+                import json
+                import mmlspark_tpu.observability.flight as _flight
+                from mmlspark_tpu.io.serving import debug_body
+
+                def collect():
+                    return debug_body("flight", "pm")
+            """})
+        got = hits(active, "postmortem-scrape-only", "tools/postmortem.py")
+        assert [f.line for f in got] == [2, 3], active
+        assert "scrape-read-only" in got[0].message
+
+    def test_rots_when_tool_vanishes(self, tmp_path):
+        active, _sup = run_rule(tmp_path, "postmortem-scrape-only", {
+            "mmlspark_tpu/__init__.py": ""})
+        got = hits(active, "postmortem-scrape-only", "<graftlint>")
+        assert len(got) == 1 and "lint-rot" in got[0].message, active
+
 
 # --------------------------------------------------------------------------
 # infrastructure

@@ -16,6 +16,7 @@
 #   CI_SKIP_ROOFLINE=1 tools/ci_check.sh   # skip the introspection smoke
 #   CI_SKIP_SLO=1 tools/ci_check.sh        # skip the SLO-breach smoke
 #   CI_SKIP_TUNING=1 tools/ci_check.sh     # skip the auto-tuner smoke
+#   CI_SKIP_POSTMORTEM=1 tools/ci_check.sh # skip the post-mortem smoke
 set -u -o pipefail
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
@@ -645,6 +646,157 @@ EOF
     fi
 fi
 
+# postmortem smoke lane: the fleet black-box story end to end, in real
+# processes — a gateway with fast federation sweeps pulls an echo
+# worker's flight ring into the fleet timeline, fault injection lands at
+# least one 503, then the worker is SIGKILLed (no drain, no dump of its
+# own) and tools/postmortem.py runs against what's left: the report must
+# name the dead worker and carry its pre-kill flight events, recovered
+# from the gateway timeline alone.
+if [ "${CI_SKIP_POSTMORTEM:-0}" != "1" ]; then
+    if (cd "$ROOT" && env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+            python - <<'EOF'
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+ROOT = os.getcwd()
+TRACE_ID = "f" * 32
+TRACEPARENT = f"00-{TRACE_ID}-{'b' * 16}-01"
+
+
+def wait_line(proc, pattern, timeout=120):
+    deadline = time.monotonic() + timeout
+    seen = []
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            time.sleep(0.05)
+            continue
+        seen.append(line)
+        m = re.search(pattern, line)
+        if m:
+            return m
+    raise AssertionError(
+        f"no {pattern!r} from child: {''.join(seen)[-2000:]}")
+
+
+def request(host, port, path, body=None, headers=None):
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}",
+        data=body.encode() if body else None, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+with tempfile.TemporaryDirectory() as d:
+    registry = os.path.join(d, "registry")
+    flight_dir = os.path.join(d, "flight")
+    out_dir = os.path.join(d, "pm")
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu",
+               PALLAS_AXON_POOL_IPS="",
+               MMLSPARK_TPU_FLIGHT_DIR=flight_dir,
+               MMLSPARK_TPU_FEDERATION_INTERVAL_SECONDS="0.2")
+    env.pop("MMLSPARK_TPU_FAILPOINTS", None)
+    env.pop("MMLSPARK_TPU_FAILPOINTS_SEED", None)
+    genv = dict(env, MMLSPARK_TPU_FAILPOINTS="gateway.route:error_503:0.2",
+                MMLSPARK_TPU_FAILPOINTS_SEED="5")
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "tests._chaos_worker",
+         "--registry", registry],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    gateway = None
+    try:
+        m = wait_line(worker, r"worker \w+ serving on ([\w.]+):(\d+)")
+        wlabel = f"localhost:{m.group(2)}"
+        gateway = subprocess.Popen(
+            [sys.executable, "-m", "mmlspark_tpu.io.serving_main",
+             "gateway", "--registry", registry,
+             "--host", "localhost", "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=genv)
+        m = wait_line(gateway, r"gateway on ([\w.]+):(\d+)")
+        host, port = m.group(1), int(m.group(2))
+
+        statuses = []
+        for k in range(40):
+            st, _ = request(host, port, "/serving",
+                            json.dumps({"i": k}),
+                            {"traceparent": TRACEPARENT})
+            statuses.append(st)
+        assert statuses.count(200) >= 1, statuses
+        # the injected 503s fire at the gateway.route fault site and are
+        # absorbed by retry/failover — the client sees 200s, the flight
+        # ring sees the faults
+        st, body = request(host, port, "/debug/flight")
+        assert st == 200 and any(
+            e.get("kind") == "failpoint"
+            for e in json.loads(body)["events"]), body[:500]
+
+        # the sweep must pull the worker's ring before the kill
+        deadline = time.monotonic() + 60
+        cursors = {}
+        while time.monotonic() < deadline:
+            st, body = request(host, port, "/debug/timeline")
+            assert st == 200, body[:500]
+            cursors = json.loads(body).get("cursors") or {}
+            if cursors.get(wlabel, 0) > 0:
+                break
+            time.sleep(0.2)
+        assert cursors.get(wlabel, 0) > 0, cursors
+
+        worker.kill()                    # SIGKILL: no drain, no dump
+        worker.wait(timeout=30)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            _st, body = request(host, port, "/debug/timeline")
+            kinds = {e.get("kind")
+                     for e in json.loads(body).get("events") or []}
+            if "worker_scrape_dead" in kinds:
+                break
+            time.sleep(0.2)
+        assert "worker_scrape_dead" in kinds, sorted(kinds)
+
+        pm = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "postmortem.py"),
+             "--gateway", f"{host}:{port}", "--flight-dir", flight_dir,
+             "--out", out_dir],
+            capture_output=True, text=True, timeout=300, env=env)
+        assert pm.returncode == 0, pm.stderr[-2000:]
+        with open(os.path.join(out_dir, "report.txt")) as f:
+            report = f.read()
+        assert f"Implicated worker: {wlabel}" in report, report
+        assert "DEAD at collection" in report, report
+        # pre-kill flight events recovered from the fleet timeline
+        assert "serving_request" in report, report
+        assert "worker_scrape_dead" in report, report
+    finally:
+        for p in (worker, gateway):
+            if p is not None:
+                p.terminate()
+        if gateway is not None:
+            gateway.wait(timeout=30)
+print("postmortem smoke: SIGKILLed worker named with its pre-kill "
+      "flight events, from the gateway timeline + dumps alone")
+EOF
+    ); then
+        :
+    else
+        echo "ci_check: postmortem smoke FAILED" >&2
+        rc=1
+    fi
+fi
+
 # dryrun_multichip lane: the cross-device-count tree-identity suite on a
 # virtual 8-device CPU mesh (xla_force_host_platform_device_count) — the
 # full histogram-engine matrix, including the tiers tier-1 deselects as
@@ -663,7 +815,7 @@ if [ "${CI_SKIP_MULTICHIP:-0}" != "1" ]; then
 fi
 
 if [ "$rc" -ne 0 ]; then
-    echo "ci_check: FAILED (graftlint findings, env-docs drift, chaos/async/bundle/roofline/SLO/tuning smoke, or multichip dry run)" >&2
+    echo "ci_check: FAILED (graftlint findings, env-docs drift, chaos/async/bundle/roofline/SLO/tuning/postmortem smoke, or multichip dry run)" >&2
 else
     echo "ci_check: clean"
 fi

@@ -99,4 +99,42 @@ class DebugRouteRegistry(Checker):
         return iter(findings)
 
 
+class PostmortemScrapeOnly(Checker):
+    rule = "postmortem-scrape-only"
+    description = "tools/postmortem.py reads scrapes and dump files " \
+                  "only — it never imports the framework (no " \
+                  "debug_body bypass; it must run against dead fleets)"
+
+    _TOOL_REL = "tools/postmortem.py"
+
+    def check(self, repo: Repo) -> Iterator[Finding]:
+        mod = repo.module(self._TOOL_REL)
+        if mod is None:
+            raise CheckerRotError(
+                f"{self._TOOL_REL} is gone — the post-mortem collector "
+                "must exist (docs/observability.md documents it)")
+        for node in ast.walk(mod.tree):
+            names: List[str] = []
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                names = [node.module or ""]
+                if node.level:
+                    # any relative import from tools/ reaches sideways
+                    # out of the stdlib — same bypass, flag it
+                    names = [f"{'.' * node.level}{node.module or ''}"]
+            for name in names:
+                top = name.lstrip(".").split(".")[0]
+                if top == "mmlspark_tpu" or name.startswith("."):
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"postmortem.py imports {name!r} — the "
+                        "post-mortem path is scrape-read-only (plain "
+                        "HTTP to /debug/* + dump files) so it can run "
+                        "against a dead fleet from any machine; "
+                        "rendering belongs here, payload building "
+                        "belongs in debug_body")
+
+
 register(DebugRouteRegistry())
+register(PostmortemScrapeOnly())

@@ -22,7 +22,7 @@ from ..core import Checker, CheckerRotError, Finding, Repo, register
 #: sibling modules observability/* may import relatively at top level
 _SIBLINGS = frozenset({"metrics", "spans", "device", "tracing", "flight",
                        "logging", "watchdog", "federation", "env_registry",
-                       "roofline", "hbm", ""})
+                       "roofline", "hbm", "blackbox", ""})
 
 
 def _top_level_imports(tree: ast.AST) -> List[Tuple[str, int, int]]:

@@ -1,0 +1,404 @@
+#!/usr/bin/env python3
+"""One-command fleet post-mortem from black-box artifacts.
+
+Collects the gateway's fleet timeline plus per-worker
+flight/metrics/SLO/tuning snapshots — live scrapes where processes still
+answer, ``MMLSPARK_TPU_FLIGHT_DIR`` dump files where they don't — into
+one archive directory, and renders a human report naming:
+
+- the failure window (first to last failure-class timeline event),
+- the implicated worker (who the failovers/breaker-opens/scrape-deaths
+  point at) and its final pre-kill flight events,
+- the breaker/failover sequence around the window,
+- the dominant tail stage (from the gateway's /debug/tail attribution),
+- one stitched edge→gateway→worker trace.
+
+Usage::
+
+    python tools/postmortem.py --gateway localhost:8900 \\
+        --flight-dir /var/tmp/flight --out postmortem/
+    python tools/postmortem.py --flight-dir /var/tmp/flight   # all dead
+
+The tool is scrape-read-only: it talks plain HTTP to the same
+``/debug/*`` endpoints an operator would curl and reads dump files —
+it never imports the framework (pinned by graftlint's
+``postmortem-scrape-only`` rule), so it runs against a fleet of corpses
+from any machine that has the artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+#: timeline event kinds that mark "something went wrong" — the failure
+#: window is the span from the first to the last of these
+FAILURE_KINDS = frozenset({
+    "gateway_failover", "breaker_transition", "worker_scrape_failed",
+    "worker_scrape_dead", "worker_deregistered", "worker_restarted",
+    "unhandled_exception", "signal_dump", "watchdog_stall",
+    "gateway_error", "deadline_expired",
+})
+
+#: per-endpoint artifacts pulled from the gateway and from each worker
+GATEWAY_ENDPOINTS = ("/debug/timeline", "/debug/cluster", "/debug/flight",
+                     "/debug/slo", "/debug/tail", "/debug/tuning", "/varz")
+WORKER_ENDPOINTS = ("/debug/flight", "/debug/slo", "/debug/tuning",
+                    "/healthz")
+
+
+def _fetch(addr: str, path: str, timeout: float = 5.0) -> Optional[Any]:
+    """GET one debug endpoint; None when the process is dead/unreachable
+    (being dead is data here, not an error)."""
+    try:
+        with urllib.request.urlopen(f"http://{addr}{path}",
+                                    timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8", "replace"))
+    except Exception:  # noqa: BLE001 — dead process == artifact-only mode
+        return None
+
+
+def _ts(v: Any) -> str:
+    try:
+        return time.strftime("%H:%M:%S", time.localtime(float(v))) \
+            + f".{int(float(v) * 1000) % 1000:03d}"
+    except (TypeError, ValueError):
+        return "-"
+
+
+def _table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(header)]
+
+    def line(cells: List[str]) -> str:
+        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+
+    out = [line(header), line(["-" * w for w in widths])]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Collection
+# ---------------------------------------------------------------------------
+
+def load_dumps(flight_dir: str) -> Dict[str, List[Dict[str, Any]]]:
+    """All ``flight-*.json`` / ``timeline-*.json`` dumps in the shared
+    dump directory, newest last per kind (the collision-free pid+counter
+    naming means nothing here ever overwrote anything)."""
+    out: Dict[str, List[Dict[str, Any]]] = {"flight": [], "timeline": []}
+    for kind in out:
+        for path in sorted(glob.glob(os.path.join(flight_dir,
+                                                  f"{kind}-*.json"))):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            doc["_path"] = path
+            out[kind].append(doc)
+    return out
+
+
+def collect(gateway: Optional[str], flight_dir: Optional[str],
+            out_dir: str) -> Dict[str, Any]:
+    """Gather every reachable artifact into ``out_dir`` and return the
+    in-memory bundle the report renders from."""
+    os.makedirs(out_dir, exist_ok=True)
+    art: Dict[str, Any] = {"gateway": gateway, "flight_dir": flight_dir,
+                           "collected_at": time.time(),
+                           "gateway_live": False, "workers": {},
+                           "dumps": {"flight": [], "timeline": []}}
+
+    if gateway:
+        for ep in GATEWAY_ENDPOINTS:
+            doc = _fetch(gateway, ep)
+            key = ep.strip("/").replace("debug/", "")
+            if doc is not None:
+                art["gateway_live"] = True
+                art[f"gateway_{key}"] = doc
+                _write_json(out_dir, f"gateway_{key}.json", doc)
+
+    if flight_dir and os.path.isdir(flight_dir):
+        art["dumps"] = load_dumps(flight_dir)
+        dump_dir = os.path.join(out_dir, "dumps")
+        os.makedirs(dump_dir, exist_ok=True)
+        for docs in art["dumps"].values():
+            for doc in docs:
+                try:
+                    shutil.copy(doc["_path"], dump_dir)
+                except OSError:
+                    pass
+
+    # the timeline names every worker the gateway ever scraped — scrape
+    # the live ones, record the dead ones (their last seconds are already
+    # in the timeline; that is the whole point)
+    timeline = art.get("gateway_timeline")
+    if timeline is None and art["dumps"]["timeline"]:
+        timeline = art["dumps"]["timeline"][-1]
+        art["gateway_timeline"] = timeline
+        art["timeline_source"] = timeline.get("_path", "dump")
+    else:
+        art["timeline_source"] = "live scrape" if timeline else None
+
+    labels = sorted((timeline or {}).get("cursors") or {})
+    for label in labels:
+        if label == "gateway" or ":" not in label:
+            continue
+        worker: Dict[str, Any] = {"label": label}
+        for ep in WORKER_ENDPOINTS:
+            doc = _fetch(label, ep)
+            if doc is not None:
+                worker[ep.strip("/").replace("debug/", "")] = doc
+        worker["live"] = any(k != "label" and k != "live" for k in worker)
+        art["workers"][label] = worker
+        if worker["live"]:
+            _write_json(out_dir, f"worker_{label.replace(':', '_')}.json",
+                        worker)
+    return art
+
+
+def _write_json(out_dir: str, name: str, doc: Any) -> None:
+    try:
+        with open(os.path.join(out_dir, name), "w") as f:
+            json.dump(doc, f, default=repr)
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Analysis (offline re-implementation on purpose: this tool must work
+# against artifacts alone, with no framework on the path)
+# ---------------------------------------------------------------------------
+
+def timeline_events(art: Dict[str, Any]) -> List[Dict[str, Any]]:
+    tl = art.get("gateway_timeline") or {}
+    evs = list(tl.get("events") or [])
+    evs.sort(key=lambda e: (float(e.get("ts") or 0.0),
+                            e.get("timeline_seq") or 0))
+    return evs
+
+
+def failure_window(evs: List[Dict[str, Any]]
+                   ) -> Optional[Tuple[float, float]]:
+    bad = [float(e.get("ts") or 0.0) for e in evs
+           if e.get("kind") in FAILURE_KINDS]
+    return (min(bad), max(bad)) if bad else None
+
+
+def implicated_worker(evs: List[Dict[str, Any]],
+                      art: Dict[str, Any]) -> Optional[str]:
+    """Who the failure events point at: score each worker label by the
+    failure-class events naming it; dead-at-collection workers break
+    ties (a SIGKILLed worker is both implicated and unreachable)."""
+    score: Dict[str, float] = {}
+    for e in evs:
+        if e.get("kind") not in FAILURE_KINDS:
+            continue
+        label = e.get("worker") or e.get("addr") or e.get("breaker")
+        if not label or label == "gateway":
+            continue
+        score[str(label)] = score.get(str(label), 0.0) + 1.0
+    for label, w in art.get("workers", {}).items():
+        if not w.get("live"):
+            score[label] = score.get(label, 0.0) + 0.5
+    if not score:
+        return None
+    return max(sorted(score), key=lambda k: score[k])
+
+
+def breaker_failover_sequence(evs: List[Dict[str, Any]]
+                              ) -> List[Dict[str, Any]]:
+    return [e for e in evs
+            if e.get("kind") in ("breaker_transition", "gateway_failover",
+                                 "worker_scrape_dead",
+                                 "worker_deregistered",
+                                 "worker_restarted")]
+
+
+def pick_trace(evs: List[Dict[str, Any]],
+               want: Optional[str] = None) -> Optional[str]:
+    """The trace to stitch: the requested one, else the newest trace id
+    that crossed the most hops (a trace seen by both the gateway and a
+    worker is the stitched story the report wants)."""
+    if want:
+        return want
+    hops: Dict[str, set] = {}
+    newest: Dict[str, float] = {}
+    for e in evs:
+        tid = e.get("trace_id")
+        if not tid:
+            continue
+        hops.setdefault(tid, set()).add(str(e.get("worker") or "local"))
+        newest[tid] = max(newest.get(tid, 0.0), float(e.get("ts") or 0.0))
+    if not hops:
+        return None
+    return max(hops, key=lambda t: (len(hops[t]), newest[t]))
+
+
+def stitch_trace(trace_id: str, evs: List[Dict[str, Any]]
+                 ) -> List[Tuple[str, List[Dict[str, Any]]]]:
+    """Group one trace's events by hop, in causal (first-seen) order —
+    the same edge→gateway→worker tree /debug/trace serves, rebuilt from
+    the timeline so it works with every process dead."""
+    order: List[str] = []
+    hops: Dict[str, List[Dict[str, Any]]] = {}
+    for e in evs:
+        if e.get("trace_id") != trace_id:
+            continue
+        w = str(e.get("worker") or "local")
+        if w not in hops:
+            hops[w] = []
+            order.append(w)
+        hops[w].append(e)
+    return [(w, hops[w]) for w in order]
+
+
+# ---------------------------------------------------------------------------
+# Report
+# ---------------------------------------------------------------------------
+
+def _describe(e: Dict[str, Any]) -> str:
+    skip = {"kind", "ts", "tid", "seq", "timeline_seq", "worker", "source",
+            "trace_id", "span_id"}
+    bits = [f"{k}={e[k]}" for k in sorted(e) if k not in skip]
+    return ", ".join(bits)[:90]
+
+
+def render_report(art: Dict[str, Any],
+                  trace_id: Optional[str] = None) -> str:
+    evs = timeline_events(art)
+    lines: List[str] = ["# Fleet post-mortem", ""]
+    lines.append(f"gateway: {art.get('gateway') or '(none)'} "
+                 f"({'live' if art.get('gateway_live') else 'dead/offline'})"
+                 f"; timeline source: {art.get('timeline_source')}; "
+                 f"{len(evs)} timeline events")
+    dead = sorted(l for l, w in art.get("workers", {}).items()
+                  if not w.get("live"))
+    live = sorted(l for l, w in art.get("workers", {}).items()
+                  if w.get("live"))
+    lines.append(f"workers: live={live or '[]'} dead={dead or '[]'}")
+    n_dumps = {k: len(v) for k, v in art.get("dumps", {}).items()}
+    lines.append(f"dump files: {n_dumps}")
+    lines.append("")
+
+    if not evs:
+        lines.append("NO timeline events — nothing to reconstruct "
+                     "(was MMLSPARK_TPU_FLIGHT_SCRAPE disabled, or the "
+                     "gateway never swept?)")
+        return "\n".join(lines)
+
+    window = failure_window(evs)
+    if window:
+        lines.append(f"## Failure window: {_ts(window[0])} → "
+                     f"{_ts(window[1])} "
+                     f"({window[1] - window[0]:.3f}s)")
+    else:
+        lines.append("## Failure window: none detected (no failure-class "
+                     "timeline events)")
+    lines.append("")
+
+    culprit = implicated_worker(evs, art)
+    if culprit:
+        state = ("DEAD at collection"
+                 if not art.get("workers", {}).get(culprit, {}).get("live")
+                 else "still live")
+        lines.append(f"## Implicated worker: {culprit} ({state})")
+        final = [e for e in evs if str(e.get("worker")) == culprit][-15:]
+        if final:
+            lines.append("final events recovered from the fleet timeline "
+                         "(the worker's own ring died with it):")
+            lines.append(_table(
+                [[_ts(e.get("ts")), str(e.get("kind")),
+                  str(e.get("seq", "-")), _describe(e)] for e in final],
+                ["time", "kind", "seq", "detail"]))
+    else:
+        lines.append("## Implicated worker: none (no failure events name "
+                     "a worker)")
+    lines.append("")
+
+    seq = breaker_failover_sequence(evs)
+    lines.append("## Breaker / failover sequence")
+    if seq:
+        lines.append(_table(
+            [[_ts(e.get("ts")), str(e.get("kind")),
+              str(e.get("worker") or e.get("breaker") or "-"),
+              _describe(e)] for e in seq],
+            ["time", "event", "worker", "detail"]))
+    else:
+        lines.append("(none recorded)")
+    lines.append("")
+
+    tail = (art.get("gateway_tail") or {}).get("attribution") or {}
+    dom = tail.get("dominant_stage")
+    lines.append("## Dominant tail stage")
+    if dom:
+        share = (tail.get("stage_share_pct") or {}).get(dom)
+        pct = f"{share:.1f}% " if isinstance(share, (int, float)) else ""
+        lines.append(f"{pct}{dom} — run tools/tail_report.py on "
+                     "gateway_tail.json for the full attribution + "
+                     "remediation")
+    else:
+        lines.append("(no tail samples — no SLO breaches observed, or no "
+                     "objective configured)")
+    lines.append("")
+
+    tid = pick_trace(evs, trace_id)
+    lines.append("## Stitched trace")
+    if tid:
+        hops = stitch_trace(tid, evs)
+        lines.append(f"trace {tid} across {len(hops)} hop(s) "
+                     "(edge→gateway→worker order = causal order):")
+        for w, hop_evs in hops:
+            names = [str(e.get("name") or e.get("kind")) for e in hop_evs]
+            lines.append(f"  {w}: {len(hop_evs)} events "
+                         f"[{', '.join(names[:8])}"
+                         f"{', ...' if len(names) > 8 else ''}]")
+    else:
+        lines.append("(no trace ids on the timeline)")
+    return "\n".join(lines)
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog=os.path.basename(argv[0]),
+        description="fleet post-mortem from black-box artifacts")
+    ap.add_argument("--gateway", default=None,
+                    help="gateway host:port to scrape (omit if dead)")
+    ap.add_argument("--flight-dir",
+                    default=os.environ.get("MMLSPARK_TPU_FLIGHT_DIR"),
+                    help="shared dump dir (default: "
+                         "$MMLSPARK_TPU_FLIGHT_DIR)")
+    ap.add_argument("--out", default="postmortem",
+                    help="archive directory (default: ./postmortem)")
+    ap.add_argument("--trace", default=None,
+                    help="trace id to stitch (default: auto-pick the "
+                         "widest)")
+    args = ap.parse_args(argv[1:])
+    if not args.gateway and not args.flight_dir:
+        ap.print_usage(sys.stderr)
+        print("need --gateway and/or --flight-dir", file=sys.stderr)
+        return 2
+    art = collect(args.gateway, args.flight_dir, args.out)
+    report = render_report(art, args.trace)
+    path = os.path.join(args.out, "report.txt")
+    with open(path, "w") as f:
+        f.write(report + "\n")
+    try:
+        print(report)
+        print(f"\narchive: {args.out}/ (report: {path})")
+    except BrokenPipeError:                     # | head closed the pipe
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))
